@@ -1,0 +1,25 @@
+"""chainermn_tpu — TPU-native distributed deep-learning framework.
+
+Rebuilds the capabilities of Chainer + ChainerMN (see SURVEY.md) on
+JAX/XLA: define-by-run-feel parameter containers compiled into single
+jitted SPMD train steps, with ChainerMN's full distributed surface —
+communicators, differentiable collectives, model-parallel chain lists,
+multi-node BN/optimizer/evaluator/iterators, dataset scattering, and
+consensus-resume checkpointing — lowered to ICI/DCN mesh collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (Parameter, Link, Chain, ChainList, Sequential,
+                   Optimizer, SGD, MomentumSGD, Adam, AdamW,
+                   Reporter, report, report_scope,
+                   global_config, config, using_config)
+from . import nn
+from .nn import functions as F
+from .nn import links as L
+from .nn import initializers
+from . import dataset
+from .dataset import (TupleDataset, SubDataset, SerialIterator,
+                      concat_examples)
+from . import serializers
+from . import training

@@ -1,0 +1,7 @@
+from .datasets import (DatasetMixin, TupleDataset, DictDataset, SubDataset,
+                       TransformDataset, ConcatenatedDataset, split_dataset,
+                       split_dataset_random, get_mnist, get_cifar10,
+                       get_synthetic_imagenet)
+from .iterators import (Iterator, SerialIterator, MultiprocessIterator,
+                        MultithreadIterator)
+from .convert import concat_examples, to_device

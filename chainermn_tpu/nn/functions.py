@@ -1,0 +1,463 @@
+"""Neural-network functions (consumed-Chainer surface: ``chainer.functions``).
+
+Reference anchors: ``chainer/functions/ · relu, softmax_cross_entropy,
+convolution_2d, max_pooling_2d, batch_normalization, ...`` (SURVEY.md §2.8).
+All functions are pure ``jnp`` programs: differentiable by ``jax.grad``,
+fusible by XLA, layout NCHW to match the reference's convention (XLA
+re-layouts internally for the MXU; the API contract is what matters here).
+Stochastic functions (``dropout``) take an explicit ``key`` — the idiomatic
+JAX replacement for the reference's hidden global RNG; if omitted, a
+trace-time constant key is drawn (deterministic across steps — fine for
+smoke tests, pass real keys for training).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "relu", "leaky_relu", "elu", "sigmoid", "tanh", "softplus", "gelu", "silu",
+    "softmax", "log_softmax", "softmax_cross_entropy", "sigmoid_cross_entropy",
+    "mean_squared_error", "mean_absolute_error", "huber_loss", "accuracy",
+    "dropout", "linear", "embed_id",
+    "convolution_2d", "deconvolution_2d", "depthwise_convolution_2d",
+    "max_pooling_2d", "average_pooling_2d", "unpooling_2d",
+    "global_average_pooling_2d", "resize_images",
+    "batch_normalization", "fixed_batch_normalization", "layer_normalization",
+    "concat", "stack", "hstack", "vstack", "split_axis", "separate",
+    "reshape", "flatten", "transpose", "expand_dims", "squeeze", "tile",
+    "broadcast_to", "sum", "mean", "max", "min", "argmax", "sqrt", "exp",
+    "log", "clip", "matmul", "batch_matmul", "where", "pad",
+]
+
+
+# -- activations -----------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def leaky_relu(x, slope=0.2):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def elu(x, alpha=1.0):
+    return jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1))
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softplus(x, beta=1.0):
+    return jax.nn.softplus(beta * x) / beta
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def softmax(x, axis=1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# -- losses ----------------------------------------------------------------
+
+def softmax_cross_entropy(x, t, ignore_label=-1, reduce="mean",
+                          normalize=True):
+    """Softmax + NLL with ignore-label masking.
+
+    Matches the reference semantics (``F.softmax_cross_entropy``): ``t`` holds
+    int class ids; entries equal to ``ignore_label`` contribute zero loss and
+    are excluded from the normalizer.
+    """
+    logp = jax.nn.log_softmax(x, axis=1)
+    t_safe = jnp.where(t == ignore_label, 0, t)
+    # gather the log-prob of the target class along axis 1
+    nll = -jnp.take_along_axis(
+        logp, t_safe[:, None] if logp.ndim == 2 else jnp.expand_dims(t_safe, 1), axis=1
+    ).squeeze(1)
+    mask = (t != ignore_label)
+    nll = jnp.where(mask, nll, 0.0)
+    if reduce == "no":
+        return nll
+    if normalize:
+        count = jnp.maximum(mask.sum(), 1)
+    else:
+        count = x.shape[0]
+    return nll.sum() / count
+
+
+def sigmoid_cross_entropy(x, t, reduce="mean"):
+    t = t.astype(x.dtype)
+    loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if reduce == "no":
+        return loss
+    return loss.mean()
+
+
+def mean_squared_error(x, t):
+    return jnp.mean((x - t) ** 2)
+
+
+def mean_absolute_error(x, t):
+    return jnp.mean(jnp.abs(x - t))
+
+
+def huber_loss(x, t, delta=1.0, reduce="sum_along_second_axis"):
+    d = x - t
+    abs_d = jnp.abs(d)
+    loss = jnp.where(abs_d <= delta, 0.5 * d * d, delta * (abs_d - 0.5 * delta))
+    if reduce == "no":
+        return loss
+    return loss.sum(axis=1)
+
+
+def accuracy(y, t, ignore_label=None):
+    pred = jnp.argmax(y, axis=1)
+    if ignore_label is not None:
+        mask = (t != ignore_label)
+        correct = jnp.where(mask, pred == t, False)
+        return correct.sum() / jnp.maximum(mask.sum(), 1)
+    return jnp.mean((pred == t).astype(jnp.float32))
+
+
+# -- stochastic ------------------------------------------------------------
+
+def dropout(x, ratio=0.5, key=None, train: bool | None = None):
+    from ..core.config import config
+    if train is None:
+        train = config.train
+    if not train or ratio == 0.0:
+        return x
+    if key is None:
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+# -- linear / embedding ----------------------------------------------------
+
+def linear(x, W, b=None, n_batch_axes=1):
+    if n_batch_axes > 1:
+        batch_shape = x.shape[:n_batch_axes]
+        x = x.reshape((int(np.prod(batch_shape)), -1))
+    elif x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+        batch_shape = None
+    else:
+        batch_shape = None
+    y = x @ W.T
+    if b is not None:
+        y = y + b
+    if n_batch_axes > 1:
+        y = y.reshape(batch_shape + (W.shape[0],))
+    return y
+
+
+def embed_id(x, W, ignore_label=None):
+    if ignore_label is not None:
+        safe = jnp.where(x == ignore_label, 0, x)
+        emb = W[safe]
+        return jnp.where((x == ignore_label)[..., None], 0.0, emb)
+    return W[x]
+
+
+# -- convolutions (NCHW, kernel OIHW — reference layout) --------------------
+
+def _pair(v):
+    return (v, v) if np.isscalar(v) else tuple(v)
+
+
+def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1):
+    sy, sx = _pair(stride)
+    ph, pw = _pair(pad)
+    dy, dx = _pair(dilate)
+    y = lax.conv_general_dilated(
+        x, W,
+        window_strides=(sy, sx),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dy, dx),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def deconvolution_2d(x, W, b=None, stride=1, pad=0, outsize=None):
+    """Transposed convolution; kernel layout IOHW like the reference
+    (``L.Deconvolution2D`` stores W as (in_ch, out_ch, kh, kw))."""
+    sy, sx = _pair(stride)
+    ph, pw = _pair(pad)
+    kh, kw = W.shape[2], W.shape[3]
+    # lax.conv_transpose with IOHW spec handles the kernel-flip convention
+    y = lax.conv_transpose(
+        x, W,
+        strides=(sy, sx),
+        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    if outsize is not None:
+        oh, ow = outsize
+        y = y[:, :, :oh, :ow]
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def depthwise_convolution_2d(x, W, b=None, stride=1, pad=0):
+    # W: (channel_multiplier, in_channels, kh, kw) in the reference
+    cm, ic, kh, kw = W.shape
+    Wg = W.transpose(1, 0, 2, 3).reshape(ic * cm, 1, kh, kw)
+    return convolution_2d(x, Wg, b, stride, pad, groups=ic)
+
+
+# -- pooling ---------------------------------------------------------------
+
+def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    if cover_all:
+        # reference semantics: pad enough that every element is covered
+        h, w = x.shape[2], x.shape[3]
+        eh = max(0, (-(h + 2 * ph - kh) % sy)) if sy > 1 else 0
+        ew = max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
+    else:
+        eh = ew = 0
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, neg, lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sy, sx),
+        padding=((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)),
+    )
+
+
+def average_pooling_2d(x, ksize, stride=None, pad=0):
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sy, sx),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+    # reference divides by the full window size (count_include_pad=True)
+    return summed / (kh * kw)
+
+
+def unpooling_2d(x, ksize, stride=None, pad=0, outsize=None, cover_all=True):
+    """Inverse of sum-pooling: each value scatter-adds over its k×k window.
+
+    Reference semantics (``F.unpooling_2d``): output size
+    ``s*(in-1)+k-2p`` (minus ``s-1`` under ``cover_all``).  Implemented as
+    the VJP of sum-pooling — the transposed scatter-add XLA compiles to a
+    single fused kernel.
+    """
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    h, w = x.shape[2], x.shape[3]
+    if outsize is None:
+        oh = sy * (h - 1) + kh - 2 * ph - (sy - 1 if cover_all else 0)
+        ow = sx * (w - 1) + kw - 2 * pw - (sx - 1 if cover_all else 0)
+    else:
+        oh, ow = outsize
+    if (sy, sx) == (kh, kw) and (ph, pw) == (0, 0) and (oh, ow) == (h * kh, w * kw):
+        return jnp.repeat(jnp.repeat(x, kh, axis=2), kw, axis=3)
+    # trailing pad so that pooling the (oh, ow) plane yields exactly (h, w)
+    prh = (h - 1) * sy + kh - oh - ph
+    prw = (w - 1) * sx + kw - ow - pw
+
+    def pool(y):
+        return lax.reduce_window(
+            y, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, sy, sx),
+            padding=((0, 0), (0, 0), (ph, prh), (pw, prw)))
+
+    zeros = jnp.zeros(x.shape[:2] + (oh, ow), x.dtype)
+    _, vjp = jax.vjp(pool, zeros)
+    (y,) = vjp(x)
+    return y
+
+
+def global_average_pooling_2d(x):
+    return x.mean(axis=(2, 3))
+
+
+def resize_images(x, output_shape):
+    n, c, _, _ = x.shape
+    oh, ow = output_shape
+    return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+
+# -- normalization ---------------------------------------------------------
+
+def batch_normalization(x, gamma, beta, eps=2e-5, axis=None):
+    if axis is None:
+        axis = (0,) + tuple(range(2, x.ndim))
+    mean = x.mean(axis=axis)
+    var = x.var(axis=axis)
+    return _apply_bn(x, gamma, beta, mean, var, eps, axis)
+
+
+def fixed_batch_normalization(x, gamma, beta, mean, var, eps=2e-5, axis=None):
+    if axis is None:
+        axis = (0,) + tuple(range(2, x.ndim))
+    return _apply_bn(x, gamma, beta, mean, var, eps, axis)
+
+
+def _apply_bn(x, gamma, beta, mean, var, eps, axis):
+    shape = [1] * x.ndim
+    kept = [d for d in range(x.ndim) if d not in axis]
+    for d in kept:
+        shape[d] = x.shape[d]
+    mean = mean.reshape(shape)
+    var = var.reshape(shape)
+    gamma = gamma.reshape(shape)
+    beta = beta.reshape(shape)
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * gamma + beta
+
+
+def layer_normalization(x, gamma, beta, eps=1e-5):
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+
+# -- shape / array ops (thin jnp aliases, reference names) ------------------
+
+def concat(xs, axis=1):
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+def hstack(xs):
+    return jnp.hstack(list(xs))
+
+
+def vstack(xs):
+    return jnp.vstack(list(xs))
+
+
+def split_axis(x, indices_or_sections, axis):
+    return tuple(jnp.split(x, indices_or_sections, axis=axis))
+
+
+def separate(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x):
+    return jnp.reshape(x, (-1,))
+
+
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes)
+
+
+def expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def sum(x, axis=None, keepdims=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+def mean(x, axis=None, keepdims=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+def max(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+def min(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+def argmax(x, axis=None):
+    return jnp.argmax(x, axis=axis)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def clip(x, x_min, x_max):
+    return jnp.clip(x, x_min, x_max)
+
+
+def matmul(a, b, transa=False, transb=False):
+    if transa:
+        a = jnp.swapaxes(a, -1, -2)
+    if transb:
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+def batch_matmul(a, b, transa=False, transb=False):
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if b.ndim == 2:
+        b = b[:, :, None]
+    return matmul(a, b, transa, transb)
+
+
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def pad(x, pad_width, mode="constant", **kwargs):
+    return jnp.pad(x, pad_width, mode=mode, **kwargs)

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Multi-chip behavior is tested on a simulated 8-device CPU mesh
+(SURVEY.md §4: the TPU analog of the reference's ``mpiexec -n N`` on one
+host).
+
+Environment subtlety: the axon sitecustomize imports jax at interpreter
+startup with ``JAX_PLATFORMS=axon`` (one real TPU chip via a tunnel), so
+env vars set here are too late — ``jax.config.update`` is the reliable
+lever, and ``XLA_FLAGS`` still applies because the CPU backend reads it
+at first initialization (which happens after this file runs).
+"""
+
+import os
+
+import jax
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", ""))
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,140 @@
+"""Dataset / iterator / converter tests."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.dataset import (TupleDataset, SubDataset, TransformDataset,
+                                   split_dataset, SerialIterator,
+                                   MultithreadIterator, concat_examples,
+                                   get_mnist)
+
+
+def test_tuple_dataset():
+    x = np.arange(10, dtype=np.float32)
+    y = np.arange(10, dtype=np.int32) * 2
+    ds = TupleDataset(x, y)
+    assert len(ds) == 10
+    assert ds[3] == (3.0, 6)
+    sliced = ds[2:5]
+    assert len(sliced) == 3 and sliced[0] == (2.0, 4)
+
+
+def test_sub_dataset_with_order():
+    base = np.arange(10)
+    order = np.array([9, 8, 7, 6, 5, 4, 3, 2, 1, 0])
+    sub = SubDataset(base, 2, 5, order=order)
+    assert len(sub) == 3
+    assert [sub[i] for i in range(3)] == [7, 6, 5]
+
+
+def test_split_dataset():
+    base = np.arange(10)
+    a, b = split_dataset(base, 4)
+    assert len(a) == 4 and len(b) == 6
+    assert a[0] == 0 and b[0] == 4
+
+
+def test_transform_dataset():
+    ds = TransformDataset(np.arange(5), lambda x: x * 10)
+    assert ds[2] == 20
+
+
+def test_serial_iterator_epochs():
+    ds = np.arange(10)
+    it = SerialIterator(ds, batch_size=4, shuffle=False)
+    seen = []
+    for _ in range(5):
+        seen.append(it.next())
+    assert it.epoch == 2
+    assert len(seen[0]) == 4
+
+
+def test_serial_iterator_no_repeat():
+    it = SerialIterator(np.arange(6), 4, repeat=False, shuffle=False)
+    b1 = it.next()
+    b2 = it.next()
+    assert len(b1) == 4 and len(b2) == 2
+    with pytest.raises(StopIteration):
+        it.next()
+
+
+def test_serial_iterator_shuffle_covers_all():
+    it = SerialIterator(np.arange(8), 4, shuffle=True, seed=0)
+    batch = it.next() + it.next()
+    assert sorted(batch) == list(range(8))
+
+
+def test_serial_iterator_serialize(tmp_path):
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    it = SerialIterator(np.arange(10), 3, shuffle=True, seed=1)
+    it.next()
+    s = DictionarySerializer()
+    it.serialize(s)
+    np.savez(str(tmp_path / "it.npz"), **s.target)
+    it2 = SerialIterator(np.arange(10), 3, shuffle=True, seed=2)
+    with np.load(str(tmp_path / "it.npz")) as npz:
+        it2.serialize(NpzDeserializer(npz))
+    np.testing.assert_array_equal(it._order, it2._order)
+    assert it2.current_position == it.current_position
+
+
+def test_multithread_iterator():
+    it = MultithreadIterator(np.arange(20), 5, shuffle=False)
+    batches = [it.next() for _ in range(4)]
+    assert sum(len(b) for b in batches) == 20
+    it.finalize()
+
+
+def test_concat_examples_tuples():
+    batch = [(np.ones(3), 1), (np.zeros(3), 2)]
+    x, y = concat_examples(batch)
+    assert x.shape == (2, 3)
+    np.testing.assert_array_equal(y, [1, 2])
+
+
+def test_concat_examples_padding():
+    batch = [np.ones(2), np.ones(4)]
+    x = concat_examples(batch, padding=0)
+    assert x.shape == (2, 4)
+    np.testing.assert_array_equal(x[0], [1, 1, 0, 0])
+
+
+def test_get_mnist_learnable_shapes():
+    train, test = get_mnist(n_train=100, n_test=20)
+    assert len(train) == 100 and len(test) == 20
+    x, y = train[0]
+    assert x.shape == (784,) and 0 <= y < 10
+
+
+def test_multithread_iterator_reset():
+    it = MultithreadIterator(np.arange(8), 4, repeat=False, shuffle=False)
+    batches = []
+    try:
+        while True:
+            batches.append(it.next())
+    except StopIteration:
+        pass
+    assert len(batches) == 2
+    it.reset()
+    again = it.next()
+    assert len(again) == 4
+    it.finalize()
+
+
+def test_trainer_default_stop_trigger_is_callable():
+    from chainermn_tpu.training.trainer import Trainer
+
+    class _FakeUpdater:
+        iteration = 0
+        epoch = 0
+        epoch_detail = 0.0
+
+        def get_all_optimizers(self):
+            return {}
+
+        def connect_trainer(self, trainer):
+            pass
+
+    t = Trainer(_FakeUpdater())
+    assert t.stop_trigger(t) is False

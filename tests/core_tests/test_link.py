@@ -1,0 +1,142 @@
+"""Link/Parameter container tests (reference test model: chainer link tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu as ct
+from chainermn_tpu import L, F
+from chainermn_tpu.core.link import (extract_state, apply_state, bind_state,
+                                     param_tree, load_param_tree)
+
+
+class _MLP(ct.Chain):
+    def __init__(self):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(4, 8, seed=0)
+            self.l2 = L.Linear(8, 3, seed=1)
+
+    def forward(self, x):
+        return self.l2(F.relu(self.l1(x)))
+
+
+def test_param_registration():
+    m = _MLP()
+    names = [n for n, _ in m.namedparams()]
+    assert sorted(names) == ["/l1/W", "/l1/b", "/l2/W", "/l2/b"]
+    assert m.count_params() == 4 * 8 + 8 + 8 * 3 + 3
+
+
+def test_outside_init_scope_not_registered():
+    m = _MLP()
+    m.extra = ct.Parameter(jnp.zeros(3))
+    assert "/extra" not in [n for n, _ in m.namedparams()]
+
+
+def test_cleargrads():
+    m = _MLP()
+    for p in m.params():
+        p.grad = jnp.zeros_like(p.array)
+    m.cleargrads()
+    assert all(p.grad is None for p in m.params())
+
+
+def test_extract_and_apply_state():
+    m = _MLP()
+    state = extract_state(m)
+    assert set(state["params"]) == {"/l1/W", "/l1/b", "/l2/W", "/l2/b"}
+    x = jnp.ones((2, 4))
+    y_direct = m(x)
+    y_fn, _ = apply_state(m, state, x)
+    np.testing.assert_allclose(np.asarray(y_direct), np.asarray(y_fn))
+
+
+def test_apply_state_is_jittable_and_differentiable():
+    m = _MLP()
+    state = extract_state(m)
+    x = jnp.ones((2, 4))
+
+    @jax.jit
+    def loss_fn(params, x):
+        y, _ = apply_state(m, {"params": params, "state": {}}, x)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss_fn)(state["params"], x)
+    assert set(g) == set(state["params"])
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+
+
+def test_bn_persistent_state_threads_through_jit():
+    bn = L.BatchNormalization(3)
+    state = extract_state(bn)
+    assert "/avg_mean" in state["state"] and "/avg_var" in state["state"]
+    x = jnp.asarray(np.random.RandomState(0).normal(2.0, 3.0, (16, 3)).astype(np.float32))
+
+    @jax.jit
+    def step(state, x):
+        y, new_state = apply_state(bn, state, x)
+        return y, new_state
+
+    y, new_state = step(state, x)
+    # running stats moved toward batch moments
+    assert not np.allclose(np.asarray(new_state["state"]["/avg_mean"]), 0.0)
+    # normalized output: ~zero mean, ~unit var
+    np.testing.assert_allclose(np.asarray(y.mean(axis=0)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y.var(axis=0)), 1.0, atol=1e-2)
+
+
+def test_bn_test_mode_uses_running_stats():
+    bn = L.BatchNormalization(3)
+    x = jnp.asarray(np.random.RandomState(1).normal(0, 1, (8, 3)).astype(np.float32))
+    with ct.using_config("train", False):
+        y = bn(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-4)
+
+
+def test_chainlist_and_sequential():
+    cl = ct.ChainList(L.Linear(2, 3, seed=0), L.Linear(3, 4, seed=1))
+    assert len(cl) == 2
+    names = [n for n, _ in cl.namedparams()]
+    assert "/0/W" in names and "/1/W" in names
+    seq = ct.Sequential(L.Linear(2, 5, seed=0), F.relu, L.Linear(5, 2, seed=1))
+    y = seq(jnp.ones((3, 2)))
+    assert y.shape == (3, 2)
+
+
+def test_copyparams():
+    a, b = _MLP(), _MLP()
+    b.l1.W.array = jnp.zeros_like(b.l1.W.array)
+    b.copyparams(a)
+    np.testing.assert_allclose(np.asarray(b.l1.W.array), np.asarray(a.l1.W.array))
+
+
+def test_lazy_linear_initializes_on_first_call():
+    layer = L.Linear(None, 7)
+    assert layer.W.array is None
+    y = layer(jnp.ones((2, 5)))
+    assert layer.W.array.shape == (7, 5)
+    assert y.shape == (2, 7)
+
+
+def test_conv2d_two_arg_form():
+    # Chainer-style Convolution2D(out_channels, ksize) with lazy in_channels
+    conv = L.Convolution2D(16, 3)
+    y = conv(jnp.ones((2, 5, 8, 8)))
+    assert conv.W.array.shape == (16, 5, 3, 3)
+    assert y.shape == (2, 16, 6, 6)
+
+
+def test_unpooling_2d_stride_pad():
+    x = jnp.arange(8.0).reshape(1, 1, 2, 4)
+    y = F.unpooling_2d(x, 2, stride=2, pad=0, cover_all=False)
+    assert y.shape == (1, 1, 4, 8)
+    np.testing.assert_allclose(np.asarray(y[0, 0, :2, :2]),
+                               [[0, 0], [0, 0]])
+    # overlapping windows sum: ksize=3, stride=1
+    x2 = jnp.ones((1, 1, 3, 3))
+    y2 = F.unpooling_2d(x2, 3, stride=1, pad=0, cover_all=False)
+    assert y2.shape == (1, 1, 5, 5)
+    # center cell covered by all 9 windows
+    assert float(y2[0, 0, 2, 2]) == 9.0
