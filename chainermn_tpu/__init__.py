@@ -23,3 +23,10 @@ from .dataset import (TupleDataset, SubDataset, SerialIterator,
                       concat_examples)
 from . import serializers
 from . import training
+from . import communicators
+from .communicators import (create_communicator, CommunicatorBase,
+                            MeshCommunicator, DummyCommunicator)
+from .optimizers import create_multi_node_optimizer
+from .evaluators import create_multi_node_evaluator
+from .datasets import (scatter_dataset, create_empty_dataset, scatter_index,
+                       get_n_iterations_for_one_epoch)

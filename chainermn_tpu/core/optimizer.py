@@ -24,8 +24,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from .link import (Link, apply_state, extract_state, load_param_tree,
-                   _persistent_slots)
+from .link import (Link, apply_state, bind_state, extract_state,
+                   load_param_tree, _persistent_slots)
 from .config import config
 
 __all__ = ["Optimizer", "GradientMethod", "SGD", "MomentumSGD", "Adam",
@@ -116,6 +116,79 @@ class GradientScaling(_Hook):
 # Optimizer base
 # ---------------------------------------------------------------------------
 
+def make_loss_and_grad(target, lossfun):
+    """Build the traced loss/grad body shared by the single-device and
+    multi-node compiled steps.
+
+    Returns ``f(params, pstate, args, kwargs) -> (loss, new_pstate, obs,
+    grads)``.  In-forward ``report`` calls are captured into ``obs`` (keys
+    prefixed via the reporter active at trace time; standalone use gets a
+    fresh reporter with the target registered as ``main`` so keys match
+    trainer runs).
+    """
+    from . import reporter as reporter_module
+
+    def resolve_reporter():
+        stack = reporter_module._reporter_stack()
+        if stack:
+            return stack[-1]
+        rep = reporter_module.Reporter()
+        rep.add_observer("main", target)
+        rep.add_observers("main", target.namedlinks(skipself=True))
+        return rep
+
+    def loss_and_grad(params, pstate, args, kwargs):
+        def loss_on(p):
+            with bind_state(target, {"params": p, "state": pstate}) as handle:
+                obs = {}
+                with resolve_reporter().scope(obs):
+                    loss = lossfun(*args, **kwargs)
+                new_pstate = handle.collect()
+            if isinstance(loss, tuple):
+                loss = loss[0]
+            return loss, (new_pstate, obs)
+
+        (loss, (new_pstate, obs)), grads = jax.value_and_grad(
+            loss_on, has_aux=True)(params)
+        return loss, new_pstate, obs, grads
+
+    return loss_and_grad
+
+
+def apply_transform_update(tx, grads, opt_state, params, lr):
+    """Shared tail of every compiled step: hook-chained transform, then the
+    -lr scaling (lr is a traced argument — schedule changes don't recompile)."""
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+    updates = jax.tree.map(lambda u: -lr * u, updates)
+    return optax.apply_updates(params, updates), new_opt_state
+
+
+class _LRUCache(OrderedDict):
+    """Bounded compiled-step cache.
+
+    Keys include ``id(lossfun)``: per-iteration closure lambdas would
+    otherwise grow the cache without bound while pinning their captured
+    batches.  (Pass data via ``update(lossfun, *args)`` — a fresh closure
+    per step forces a retrace by construction.)
+    """
+
+    def __init__(self, maxsize=16):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)
+
+
 class Optimizer:
     """Base optimizer with the reference's lifecycle vocabulary.
 
@@ -135,14 +208,13 @@ class Optimizer:
         self._hooks = OrderedDict()
         self._opt_state = None
         self._tx = None
-        self._step_cache = {}
-        self._grads_transform = None  # set by multi-node wrapper (psum)
+        self._step_cache = _LRUCache()
 
     # -- lifecycle ---------------------------------------------------------
     def setup(self, link: Link):
         self.target = link
         self._opt_state = None
-        self._step_cache = {}
+        self._step_cache = _LRUCache()
         return self
 
     def add_hook(self, hook, name=None, timing="pre"):
@@ -151,13 +223,13 @@ class Optimizer:
         self._hooks[name or hook.name] = hook
         self._tx = None
         self._opt_state = None
-        self._step_cache = {}
+        self._step_cache = _LRUCache()
 
     def remove_hook(self, name):
         del self._hooks[name]
         self._tx = None
         self._opt_state = None
-        self._step_cache = {}
+        self._step_cache = _LRUCache()
 
     def new_epoch(self):
         self.epoch += 1
@@ -186,37 +258,16 @@ class Optimizer:
     # -- compiled full step ------------------------------------------------
     def _make_step(self, lossfun):
         tx = self._transform()
-        target = self.target
-        grads_transform = self._grads_transform
+        loss_and_grad = make_loss_and_grad(self.target, lossfun)
 
         def step(params, pstate, opt_state, hyper, args, kwargs):
-            def loss_on(p):
-                from .link import bind_state
-                from . import reporter as reporter_module
-                with bind_state(target, {"params": p, "state": pstate}) as handle:
-                    # capture in-forward ``report`` calls (tracers) so they
-                    # become outputs of the compiled step — the jit-era
-                    # equivalent of the reference's eager Reporter writes
-                    obs = {}
-                    rep = reporter_module.get_current_reporter()
-                    with rep.scope(obs):
-                        loss = lossfun(*args, **kwargs)
-                    new_pstate = handle.collect()
-                if isinstance(loss, tuple):
-                    loss = loss[0]
-                return loss, (new_pstate, obs)
-
-            (loss, (new_pstate, obs)), grads = jax.value_and_grad(
-                loss_on, has_aux=True)(params)
-            if grads_transform is not None:
-                grads = grads_transform(grads)
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            lr = hyper["lr"]
-            updates = jax.tree.map(lambda u: -lr * u, updates)
-            new_params = optax.apply_updates(params, updates)
+            loss, new_pstate, obs, grads = loss_and_grad(
+                params, pstate, args, kwargs)
+            new_params, new_opt_state = apply_transform_update(
+                tx, grads, opt_state, params, hyper["lr"])
             return new_params, new_pstate, new_opt_state, loss, grads, obs
 
-        return jax.jit(step, static_argnames=())
+        return jax.jit(step)
 
     def _cache_key(self, lossfun, args, kwargs):
         shapes = tuple(

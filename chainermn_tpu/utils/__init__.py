@@ -1,0 +1,1 @@
+from .platform import use_platform, simulate_devices

@@ -1,0 +1,210 @@
+"""End-to-end data-parallel MNIST (BASELINE config #1; SURVEY.md §7 step 3).
+
+Golden rule (SURVEY §4): the distributed result must equal a single-device
+run on the merged batch.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu import F, L
+from chainermn_tpu.core.optimizer import SGD, Adam
+from chainermn_tpu.dataset import SerialIterator, get_mnist
+from chainermn_tpu.training import StandardUpdater, Trainer, extensions
+
+
+class MLP(ct.Chain):
+    def __init__(self, n_units=32, n_out=10, seed=100):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(784, n_units, seed=seed)
+            self.l2 = L.Linear(n_units, n_out, seed=seed + 1)
+
+    def forward(self, x):
+        return self.l2(F.relu(self.l1(x)))
+
+
+class Classifier(ct.Chain):
+    def __init__(self, predictor):
+        super().__init__()
+        with self.init_scope():
+            self.predictor = predictor
+
+    def forward(self, x, t):
+        y = self.predictor(x)
+        loss = F.softmax_cross_entropy(y, t)
+        ct.report({"loss": loss, "accuracy": F.accuracy(y, t)}, self)
+        return loss
+
+
+def _batch(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.normal(0, 1, (n, 784)).astype(np.float32)
+    t = rng.randint(0, 10, n).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(t)
+
+
+def test_dp_step_equals_single_device_step():
+    """One multi-node update == one single-device update on the full batch."""
+    x, t = _batch(64)
+
+    model_dp = Classifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    comm.bcast_data(model_dp)
+    opt_dp = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model_dp)
+
+    model_ref = Classifier(MLP())  # same seeds → same init
+    opt_ref = SGD(lr=0.1).setup(model_ref)
+
+    loss_dp = opt_dp.update(model_dp, x, t)
+    loss_ref = opt_ref.update(model_ref, x, t)
+
+    np.testing.assert_allclose(float(loss_dp), float(loss_ref), rtol=1e-5)
+    for (n1, p1), (n2, p2) in zip(model_dp.namedparams(),
+                                  model_ref.namedparams()):
+        np.testing.assert_allclose(np.asarray(p1.array), np.asarray(p2.array),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_dp_step_grad_dtype_still_converges():
+    x, t = _batch(64)
+    model = Classifier(MLP())
+    comm = ct.create_communicator("pure_nccl", allreduce_grad_dtype="bfloat16")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model)
+    l0 = float(opt.update(model, x, t))
+    for _ in range(20):
+        l = float(opt.update(model, x, t))
+    assert l < l0
+
+
+def test_dp_batch_not_divisible_raises():
+    x, t = _batch(30)  # 30 % 8 != 0
+    model = Classifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model)
+    with pytest.raises(ValueError, match="divisible"):
+        opt.update(model, x, t)
+
+
+def test_double_buffering_one_step_stale():
+    """First DB update applies zero grads; second applies step-1's grads."""
+    x, t = _batch(64)
+    model_db = Classifier(MLP())
+    comm = ct.create_communicator("pure_nccl")
+    opt_db = ct.create_multi_node_optimizer(SGD(lr=0.1), comm,
+                                            double_buffering=True).setup(model_db)
+    w0 = np.asarray(model_db.predictor.l1.W.array).copy()
+    opt_db.update(model_db, x, t)
+    w1 = np.asarray(model_db.predictor.l1.W.array)
+    np.testing.assert_allclose(w1, w0)  # zero stale grads → no movement
+
+    # reference model: one plain update from the same start
+    model_ref = Classifier(MLP())
+    opt_ref = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model_ref)
+    opt_ref.update(model_ref, x, t)
+    opt_db.update(model_db, x, t)  # applies grads computed at step 1
+    np.testing.assert_allclose(np.asarray(model_db.predictor.l1.W.array),
+                               np.asarray(model_ref.predictor.l1.W.array),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_double_buffering_converges():
+    x, t = _batch(128)
+    model = Classifier(MLP())
+    comm = ct.create_communicator("pure_nccl",
+                                  allreduce_grad_dtype="bfloat16")
+    opt = ct.create_multi_node_optimizer(Adam(), comm,
+                                         double_buffering=True).setup(model)
+    losses = [float(opt.update(model, x, t)) for _ in range(30)]
+    assert losses[-1] < losses[0]
+
+
+def test_mnist_dp_end_to_end(tmp_path):
+    """Full trainer pipeline: scatter → bcast → DP optimizer → evaluator."""
+    comm = ct.create_communicator("jax_ici")
+    model = Classifier(MLP())
+    comm.bcast_data(model)
+    optimizer = ct.create_multi_node_optimizer(Adam(), comm).setup(model)
+
+    train, test = get_mnist(n_train=512, n_test=128)
+    train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
+    test = ct.scatter_dataset(test, comm, shuffle=False)
+    assert len(train) % comm.size == 0  # equal-shard invariant
+
+    train_iter = SerialIterator(train, 8 * comm.size)
+    test_iter = SerialIterator(test, 8 * comm.size, repeat=False,
+                               shuffle=False)
+    updater = StandardUpdater(train_iter, optimizer)
+    trainer = Trainer(updater, (3, "epoch"), out=str(tmp_path / "r"))
+    evaluator = ct.create_multi_node_evaluator(
+        extensions.Evaluator(test_iter, model), comm)
+    trainer.extend(evaluator)
+    trainer.extend(extensions.LogReport(trigger=(1, "epoch")))
+    trainer.run()
+
+    log = trainer.get_extension("LogReport").log
+    assert log[-1]["validation/main/accuracy"] > 0.5
+    assert log[-1]["main/loss"] < log[0]["main/loss"]
+
+
+def test_scatter_dataset_equal_shards():
+    comm = ct.create_communicator("jax_ici")
+    ds = np.arange(100)
+    shard = ct.scatter_dataset(ds, comm, shuffle=True, seed=1)
+    # padded by wrap-around to a multiple of size
+    assert len(shard) == -(-100 // comm.size) * comm.size
+    values = [int(shard[i]) for i in range(len(shard))]
+    assert set(values) == set(range(100))  # covers everything
+
+
+def test_create_empty_dataset():
+    ds = ct.create_empty_dataset(np.arange(10))
+    assert len(ds) == 10
+    assert ds[3] is None
+    assert ds[2:5] == [None, None, None]
+
+
+def test_dp_scalar_extra_arg_is_replicated():
+    """Scalar (0-d) loss args get P() specs instead of crashing shard_map."""
+    x, t = _batch(64)
+    w = jnp.asarray(2.0)
+
+    class WeightedClassifier(Classifier):
+        def forward(self, x, t, w):
+            y = self.predictor(x)
+            return w * F.softmax_cross_entropy(y, t)
+
+    model = WeightedClassifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model)
+    loss = opt.update(model, x, t, w)
+    assert np.isfinite(float(loss))
+
+
+def test_step_cache_is_bounded():
+    x, t = _batch(8)
+    model = Classifier(MLP())
+    opt = SGD(lr=0.1).setup(model)
+    for _ in range(20):
+        # fresh closure per step: worst-case pattern; cache must not grow
+        opt.update(lambda a, b: model(a, b), x, t)
+    assert len(opt._step_cache) <= opt._step_cache.maxsize
+
+
+def test_standalone_update_without_trainer_does_not_crash():
+    """No Trainer/reporter registered: in-forward report(…, self) must not
+    raise (a fallback reporter with the target registered as ``main`` backs
+    the capture); registered-observer KeyError semantics are preserved for
+    genuinely unknown observers (reference contract)."""
+    from chainermn_tpu.core import reporter as reporter_module
+    x, t = _batch(16)
+    model = Classifier(MLP())
+    opt = SGD(lr=0.1).setup(model)
+    loss = opt.update(model, x, t)
+    assert np.isfinite(float(loss))
+    rep = reporter_module.Reporter()
+    with pytest.raises(KeyError):
+        rep.report({"x": 1.0}, observer=model)
