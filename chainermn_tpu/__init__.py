@@ -26,6 +26,8 @@ from . import training
 from . import communicators
 from .communicators import (create_communicator, CommunicatorBase,
                             MeshCommunicator, DummyCommunicator)
+from . import functions
+from . import links
 from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
 from .datasets import (scatter_dataset, create_empty_dataset, scatter_index,

@@ -73,6 +73,11 @@ class MeshCommunicator(CommunicatorBase):
         self._lock = threading.Lock()
         self._jit_cache = {}
 
+    def __deepcopy__(self, memo):
+        # communicators are process-global transport handles (mesh, device
+        # list, mailboxes) — model deepcopies (create_mnbn_model) share them
+        return self
+
     @classmethod
     def from_mesh_axis(cls, mesh: Mesh, axis_name: str, **kwargs):
         """Communicator over one named axis of an existing N-D mesh."""

@@ -1,0 +1,104 @@
+"""Forward + backward checks of differentiable send/recv.
+
+Mirrors reference ``functions_tests/test_point_to_point_communication.py``
+(SURVEY.md §4): values cross the edge forward; gradients cross it
+backward (here via ppermute's automatic transpose).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu as ct
+from chainermn_tpu import functions as mnfn
+
+COMM = None
+
+
+def setup_module(module):
+    global COMM
+    COMM = ct.create_communicator("jax_ici")
+
+
+def _per_rank(shape=(3,)):
+    size = COMM.size
+    return jnp.asarray(
+        np.arange(size * int(np.prod(shape)), dtype=np.float32)
+        .reshape((size,) + shape))
+
+
+def test_point_to_point_forward():
+    x = _per_rank((2,))
+
+    def body(x):
+        return mnfn.point_to_point(x, COMM, src=2, dst=5)
+
+    out = COMM.run_spmd(body, x, out_specs=P(COMM.axis_name))
+    out = np.asarray(out).reshape(COMM.size, 2)
+    np.testing.assert_allclose(out[5], np.asarray(x[2]))
+    np.testing.assert_allclose(out[0], 0.0)
+
+
+def test_point_to_point_gradient_reverses_edge():
+    x = _per_rank((2,))
+
+    def loss(x):
+        y = mnfn.point_to_point(x, COMM, src=1, dst=4)
+        # only rank 4's received value contributes (others got zeros)
+        return jnp.sum(y * 3.0)
+
+    grad = COMM.run_spmd(lambda x: jax.grad(loss)(x), x,
+                         out_specs=P(COMM.axis_name))
+    g = np.asarray(grad).reshape(COMM.size, 2)
+    # rank 4's cotangent (3) flows back along the reversed edge 4 → 1
+    np.testing.assert_allclose(g[1], 3.0)
+    np.testing.assert_allclose(g[0], 0.0)
+
+
+def test_send_recv_pair_and_delegate():
+    x = _per_rank((2,))
+
+    def loss(x):
+        h = x * 2.0                         # stage-0 compute (owner: rank 0)
+        delegate = mnfn.send(h, COMM, 3, self_rank=0)
+        y = mnfn.recv(COMM, 0, delegate_variable=delegate, self_rank=3)
+        return jnp.sum(y * y)               # stage-1 loss on rank 3
+
+    val, grad = COMM.run_spmd(
+        lambda x: (loss(x).reshape(1), jax.grad(loss)(x)), x,
+        out_specs=(P(COMM.axis_name), P(COMM.axis_name)))
+    vals = np.asarray(val).reshape(COMM.size)
+    # rank 3 received 2*x_0; every rank's loss term: only rank 3's nonzero
+    expect = float(((2 * np.asarray(x[0])) ** 2).sum())
+    np.testing.assert_allclose(vals[3], expect, rtol=1e-6)
+    g = np.asarray(grad).reshape(COMM.size, 2)
+    # rank 3's cotangent 2y = 4 x_0 crosses back 3 → 0, then ×2 for h = 2x
+    np.testing.assert_allclose(g[0], 8.0 * np.asarray(x[0]), rtol=1e-6)
+
+
+def test_recv_without_send_raises():
+    x = _per_rank((1,))
+
+    def body(x):
+        return mnfn.recv(COMM, 0, self_rank=1)
+
+    import pytest
+    with pytest.raises(Exception, match="no matching send"):
+        COMM.run_spmd(body, x, out_specs=P(COMM.axis_name))
+
+
+def test_pseudo_connect_keeps_edge_alive():
+    x = _per_rank((2,))
+
+    def loss(x):
+        delegate = mnfn.send(x, COMM, 2, self_rank=1)
+        y = mnfn.recv(COMM, 1, self_rank=2)
+        local = jnp.sum(x)                  # some local head
+        fused = mnfn.pseudo_connect(delegate, local)
+        return fused + jnp.sum(y) * 0.0     # y unused: edge kept by delegate
+
+    grad = COMM.run_spmd(lambda x: jax.grad(loss)(x), x,
+                         out_specs=P(COMM.axis_name))
+    g = np.asarray(grad).reshape(COMM.size, 2)
+    np.testing.assert_allclose(g, 1.0)  # local head grad everywhere
