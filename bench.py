@@ -1,0 +1,78 @@
+"""Benchmark harness: ResNet-50/ImageNet training throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline derivation (BASELINE.md: reference published numbers): the
+ChainerMN scaling study (arXiv:1710.11351) trains ResNet-50/ImageNet 100
+epochs in ~4.4 h on 128 P100s → 1.28M images × 100 / (4.4·3600 s) / 128
+≈ 225 images/sec/GPU.  ``vs_baseline`` is measured throughput per chip
+against that per-device figure.
+
+The training step is the framework's real data-parallel path:
+``create_multi_node_optimizer`` over a ``jax_ici`` communicator spanning
+all available chips (one on this box), bf16 conv compute, bf16 gradient
+compression — the TPU translation of the reference's flagship
+``pure_nccl`` fp16 configuration.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.models import Classifier, ResNet50
+
+    # smoke-test knobs (defaults are the real benchmark configuration)
+    per_chip_bs = int(os.environ.get("BENCH_BS", "64"))
+    image_size = int(os.environ.get("BENCH_SIZE", "224"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+
+    n_devices = len(jax.devices())
+    global_bs = per_chip_bs * n_devices
+
+    comm = ct.create_communicator("jax_ici",
+                                  allreduce_grad_dtype="bfloat16")
+    model = Classifier(ResNet50(n_classes=1000,
+                                compute_dtype=jnp.bfloat16, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (global_bs, 3, image_size, image_size))
+                    .astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
+
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        loss = opt.update(model, x, t)
+    jax.block_until_ready(loss)
+
+    start = time.perf_counter()
+    for _ in range(n_steps):
+        loss = opt.update(model, x, t)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = n_steps * global_bs / elapsed
+    per_chip = images_per_sec / n_devices
+    baseline = 225.0  # ChainerMN-era images/sec/GPU (see module docstring)
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

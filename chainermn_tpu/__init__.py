@@ -28,7 +28,14 @@ from .communicators import (create_communicator, CommunicatorBase,
                             MeshCommunicator, DummyCommunicator)
 from . import functions
 from . import links
+from . import models
 from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
+from . import extensions
+from .extensions import create_multi_node_checkpointer
+from .iterators import (create_multi_node_iterator,
+                        create_synchronized_iterator)
+from . import global_except_hook
+global_except_hook._add_hook_if_enabled()
 from .datasets import (scatter_dataset, create_empty_dataset, scatter_index,
                        get_n_iterations_for_one_epoch)

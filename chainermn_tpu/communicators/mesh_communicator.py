@@ -432,6 +432,11 @@ class MeshCommunicator(CommunicatorBase):
             out_specs = P(axis)
         mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
+        if _is_traced(args):
+            # already inside an outer jit/grad trace — inline the
+            # shard_mapped computation (nested jit would re-enter mesh
+            # context handling and is unnecessary under a trace)
+            return mapped(*args)
         return jax.jit(mapped)(*args)
 
     # -- split ------------------------------------------------------------------------

@@ -1,0 +1,7 @@
+"""Distributed trainer extensions (reference: ``chainermn.extensions``)."""
+
+from .checkpoint import create_multi_node_checkpointer, _MultiNodeCheckpointer
+from .observation_aggregator import ObservationAggregator
+
+__all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer",
+           "ObservationAggregator"]

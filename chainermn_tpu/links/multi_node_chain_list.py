@@ -53,13 +53,18 @@ class MultiNodeChainList(Chain):
         self._components = []  # (name, rank, rank_in, rank_out)
         self._tag_counter = 0
 
-    def add_link(self, link, rank_in=None, rank_out=None, rank=None):
+    def add_link(self, link, rank_in=None, rank_out=None, rank=None,
+                 pass_inputs=False):
         """Register a component.
 
         ``rank``: owner stage (default: registration order).  ``rank_in``:
         rank(s) whose outputs feed this component (None → the original
         inputs).  ``rank_out``: rank(s) consuming this component's output
-        (None → terminal output).
+        (None → terminal output).  ``pass_inputs``: also forward the
+        original call inputs after the received values — the
+        single-controller stand-in for the reference pattern where a
+        downstream rank's own iterator feeds it side inputs (e.g. the
+        decoder's teacher-forcing batch).
         """
         index = len(self._components)
         name = f"mn_component_{index}"
@@ -67,7 +72,7 @@ class MultiNodeChainList(Chain):
             setattr(self, name, link)
         owner = index if rank is None else int(rank)
         self._components.append((name, owner, _as_list(rank_in),
-                                 _as_list(rank_out)))
+                                 _as_list(rank_out), pass_inputs))
         return link
 
     # -- execution ---------------------------------------------------------
@@ -78,17 +83,36 @@ class MultiNodeChainList(Chain):
             # already inside a shard_map over the stage axis (e.g. the
             # multi-node optimizer's compiled step) — emit edges directly
             return self._forward_spmd(*inputs)
-        # otherwise launch as a compiled SPMD program over the stage axis
-        # with replicated inputs and output (works both eagerly and when
-        # traced by an outer jit without the axis in scope)
+        # Launch as a compiled SPMD program over the stage axis.  The
+        # current parameter/persistent arrays — possibly outer-jit tracers
+        # installed by an enclosing optimizer step — must enter the
+        # shard_map as explicit replicated ARGUMENTS: closing over outer
+        # tracers poisons the Manual mesh context (notably inside
+        # lax.scan bodies).
+        from ..core.link import bind_state, extract_state, _persistent_slots
+        state = extract_state(self)
         n_in = len(inputs)
 
-        def fn(*args):
-            return self._forward_spmd(*args)
+        def fn(state, *args):
+            with bind_state(self, state) as handle:
+                out = self._forward_spmd(*args)
+                new_pstate = handle.collect()
+            return out, new_pstate
 
-        return comm.run_spmd(fn, *inputs,
-                             in_specs=tuple(P() for _ in range(n_in)),
-                             out_specs=P())
+        out, new_pstate = comm.run_spmd(
+            fn, state, *inputs,
+            in_specs=tuple(P() for _ in range(n_in + 1)),
+            out_specs=(P(), P()))
+        # re-install forward-mutated persistent values (BN stats inside
+        # pipeline stages) so an enclosing bind_state handle collects them
+        slots = {full: (sublink, name)
+                 for sublink, name, full in _persistent_slots(self)}
+        for path, value in new_pstate.items():
+            if path in slots:
+                sublink, name = slots[path]
+                object.__setattr__(sublink, name, value)
+                sublink._persistent[name] = value
+        return out
 
     def _forward_spmd(self, *inputs):
         comm = self._comm
@@ -99,7 +123,7 @@ class MultiNodeChainList(Chain):
         delegates = []
         terminal = None
         terminal_owner = None
-        for name, owner, rank_in, rank_out in self._components:
+        for name, owner, rank_in, rank_out, pass_inputs in self._components:
             link = getattr(self, name)
             if rank_in is None:
                 x_in = inputs
@@ -110,7 +134,10 @@ class MultiNodeChainList(Chain):
                                   tag=self._edge_tag(src, owner))
                     received.append(y)
                 x_in = tuple(received)
+                if pass_inputs:
+                    x_in = x_in + inputs
             y = link(*x_in)
+            self._fix_persistent_to_owner(link, owner)
             if rank_out is None:
                 if terminal is not None:
                     raise ValueError(
@@ -132,6 +159,23 @@ class MultiNodeChainList(Chain):
         for d in delegates:
             out = mnfn.pseudo_connect(d, out)
         return out
+
+    def _fix_persistent_to_owner(self, link, owner):
+        """Overwrite a component's forward-mutated persistent state (BN
+        running stats) with the owner rank's values.
+
+        SPMD ranks other than the owner execute the component on
+        zeros/garbage delivered by the transfer edges; without this
+        selection, any collector of persistent state could surface a
+        non-owner's corrupted statistics.
+        """
+        from ..core.link import _persistent_slots
+        for sublink, name, _ in _persistent_slots(link):
+            value = getattr(sublink, name)
+            if isinstance(value, jax.core.Tracer):
+                fixed = mnfn.bcast(self._comm, value, root=owner)
+                object.__setattr__(sublink, name, fixed)
+                sublink._persistent[name] = fixed
 
     def _edge_tag(self, src, dst):
         # one logical channel per (src, dst) edge; FIFO order of sends

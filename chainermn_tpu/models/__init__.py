@@ -1,0 +1,16 @@
+"""Model zoo (reference example model families, TPU-first designs)."""
+
+from .mlp import MLP, Classifier
+from .resnet import (ResNet, ResNet18, ResNet50, ResNet101,
+                     BottleneckBlock, BasicBlock)
+from .seq2seq import (Seq2seq, Encoder, Decoder, ModelParallelSeq2seq,
+                      create_model_parallel_seq2seq,
+                      make_synthetic_translation_data)
+from .dcgan import Generator, Discriminator, DCGANUpdater
+
+__all__ = ["MLP", "Classifier", "ResNet", "ResNet18", "ResNet50",
+           "ResNet101", "BottleneckBlock", "BasicBlock", "Seq2seq",
+           "Encoder", "Decoder", "ModelParallelSeq2seq",
+           "create_model_parallel_seq2seq",
+           "make_synthetic_translation_data", "Generator", "Discriminator",
+           "DCGANUpdater"]

@@ -204,22 +204,40 @@ def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1):
 
 
 def deconvolution_2d(x, W, b=None, stride=1, pad=0, outsize=None):
-    """Transposed convolution; kernel layout IOHW like the reference
-    (``L.Deconvolution2D`` stores W as (in_ch, out_ch, kh, kw))."""
+    """Transposed convolution; kernel (in_ch, out_ch, kh, kw) like the
+    reference (``L.Deconvolution2D``).
+
+    Implemented as the literal transpose of the corresponding forward
+    convolution (the reference's definition) via ``jax.vjp`` — XLA lowers
+    this to a single transposed-conv kernel, and the kernel-layout
+    conventions can't drift from the conv they transpose.
+    """
     sy, sx = _pair(stride)
     ph, pw = _pair(pad)
-    kh, kw = W.shape[2], W.shape[3]
-    # lax.conv_transpose with IOHW spec handles the kernel-flip convention
-    y = lax.conv_transpose(
-        x, W,
-        strides=(sy, sx),
-        padding=((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)),
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
-    if outsize is not None:
+    in_ch, out_ch, kh, kw = W.shape
+    n, _, h, w = x.shape
+    if outsize is None:
+        oh, ow = sy * (h - 1) + kh - 2 * ph, sx * (w - 1) + kw - 2 * pw
+    else:
         oh, ow = outsize
-        y = y[:, :, :oh, :ow]
+
+    # analytic shape check: the forward conv of (oh, ow) must give (h, w)
+    if (oh + 2 * ph - kh) // sy + 1 != h or (ow + 2 * pw - kw) // sx + 1 != w \
+            or oh + 2 * ph < kh or ow + 2 * pw < kw:
+        raise ValueError(
+            f"invalid outsize {(oh, ow)} for input {(h, w)} with "
+            f"k={(kh, kw)} s={(sy, sx)} p={(ph, pw)}")
+
+    def fwd(a):  # [N, out_ch, oh, ow] → [N, in_ch, h, w]
+        return lax.conv_general_dilated(
+            a, W, (sy, sx), ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    # fwd is linear in its input — linear_transpose traces it once and
+    # never evaluates the discarded primal
+    f_t = jax.linear_transpose(
+        fwd, jax.ShapeDtypeStruct((n, out_ch, oh, ow), x.dtype))
+    (y,) = f_t(x)
     if b is not None:
         y = y + b[None, :, None, None]
     return y
@@ -245,7 +263,8 @@ def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
         ew = max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
     else:
         eh = ew = 0
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
     return lax.reduce_window(
         x, neg, lax.max,
         window_dimensions=(1, 1, kh, kw),

@@ -1,0 +1,69 @@
+"""Data-parallel ImageNet ResNet-50 (reference:
+``examples/imagenet/train_imagenet.py``; BASELINE config #2).
+
+Synthetic ImageNet-shaped data (no network on this box); the input
+pipeline shards per host via ``scatter_dataset`` and the compiled step
+shards the batch across chips.
+"""
+
+import argparse
+
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu.core.optimizer import MomentumSGD
+from chainermn_tpu.dataset import SerialIterator, MultithreadIterator
+from chainermn_tpu.dataset.datasets import get_synthetic_imagenet
+from chainermn_tpu.models import Classifier, ResNet50
+from chainermn_tpu.training import StandardUpdater, Trainer, extensions
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batchsize", "-b", type=int, default=32,
+                        help="per-chip batch size")
+    parser.add_argument("--epoch", "-e", type=int, default=1)
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="stop after N iterations (overrides --epoch)")
+    parser.add_argument("--size", type=int, default=224)
+    parser.add_argument("--n-train", type=int, default=512)
+    parser.add_argument("--communicator", "-c", default="pure_nccl")
+    parser.add_argument("--grad-dtype", default="bfloat16")
+    parser.add_argument("--out", "-o", default="result_imagenet")
+    parser.add_argument("--platform", default=None)
+    parser.add_argument("--simulate-devices", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.simulate_devices:
+        from chainermn_tpu.utils import simulate_devices
+        simulate_devices(args.simulate_devices)
+    if args.platform:
+        from chainermn_tpu.utils import use_platform
+        use_platform(args.platform)
+
+    comm = ct.create_communicator(args.communicator,
+                                  allreduce_grad_dtype=args.grad_dtype)
+    model = Classifier(ResNet50(compute_dtype=jnp.bfloat16))
+    comm.bcast_data(model)
+    optimizer = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+    optimizer.add_hook(ct.core.WeightDecay(1e-4))
+
+    train = get_synthetic_imagenet(n=args.n_train, size=args.size)
+    train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
+    train_iter = MultithreadIterator(train, args.batchsize * comm.size)
+
+    updater = StandardUpdater(train_iter, optimizer)
+    stop = (args.iterations, "iteration") if args.iterations \
+        else (args.epoch, "epoch")
+    trainer = Trainer(updater, stop, out=args.out)
+    if comm.rank == 0:
+        trainer.extend(extensions.LogReport(trigger=(10, "iteration")))
+        trainer.extend(extensions.PrintReport(
+            ["epoch", "iteration", "main/loss", "main/accuracy",
+             "elapsed_time"]))
+    trainer.run()
+
+
+if __name__ == "__main__":
+    main()

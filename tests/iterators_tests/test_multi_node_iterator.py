@@ -1,0 +1,64 @@
+"""Multi-node & synchronized iterator behavior (single-host contracts).
+
+Mirrors reference ``iterators_tests`` (SURVEY.md §4).
+"""
+
+import numpy as np
+
+import chainermn_tpu as ct
+from chainermn_tpu.dataset import SerialIterator
+
+
+def test_multi_node_iterator_passthrough_single_host():
+    comm = ct.create_communicator("jax_ici")
+    it = ct.create_multi_node_iterator(
+        SerialIterator(np.arange(12), 4, shuffle=False), comm)
+    b1 = it.next()
+    assert len(b1) == 4
+    for _ in range(2):
+        it.next()
+    assert it.epoch == 1
+    assert it.is_new_epoch
+
+
+def test_multi_node_iterator_serialize_delegates():
+    from chainermn_tpu.serializers.npz import DictionarySerializer
+    comm = ct.create_communicator("jax_ici")
+    base = SerialIterator(np.arange(10), 5, shuffle=False)
+    it = ct.create_multi_node_iterator(base, comm)
+    it.next()
+    s = DictionarySerializer()
+    it.serialize(s)
+    assert "current_position" in s.target
+
+
+def test_synchronized_iterator_same_order():
+    comm = ct.create_communicator("jax_ici")
+    a = ct.create_synchronized_iterator(
+        SerialIterator(np.arange(32), 8, shuffle=True, seed=None), comm)
+    # single host: the returned iterator is the actual one with a
+    # broadcast-agreed seed; order exists and is a permutation
+    order = a._order
+    assert sorted(order.tolist()) == list(range(32))
+
+
+def test_global_except_hook_installable():
+    import sys
+    from chainermn_tpu import global_except_hook
+    old = sys.excepthook
+    try:
+        global_except_hook.add_hook()
+        assert sys.excepthook is not old
+    finally:
+        sys.excepthook = old
+        global_except_hook._hook_installed = False
+
+
+def test_observation_aggregator():
+    comm = ct.create_communicator("jax_ici")
+    agg = ct.extensions.ObservationAggregator(comm, "mykey", "mykey_agg")
+
+    class _T:
+        observation = {"mykey": 4.0}
+    agg(_T())
+    assert _T.observation["mykey_agg"] == 4.0

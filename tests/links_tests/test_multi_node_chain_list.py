@@ -131,3 +131,35 @@ def test_pipeline_trains_with_multi_node_optimizer():
     t = jnp.asarray(rng.normal(0, 1, (8, 2)).astype(np.float32))
     losses = [float(opt.update(model, x, t)) for _ in range(20)]
     assert losses[-1] < losses[0]
+
+
+def test_pipeline_bn_stats_come_from_owner_rank():
+    """BN running stats inside a non-rank-0 stage must reflect the owner's
+    real activations, not another rank's zero-input garbage."""
+    m = MultiNodeChainList(COMM)
+    m.add_link(_Block(4, 6, seed=20), rank_in=None, rank_out=1, rank=0)
+
+    class _BNStage(ct.Chain):
+        def __init__(self):
+            super().__init__()
+            with self.init_scope():
+                self.bn = L.BatchNormalization(6)
+                self.l = L.Linear(6, 2, seed=21)
+
+        def forward(self, x):
+            return self.l(self.bn(x))
+
+    stage1 = _BNStage()
+    m.add_link(stage1, rank_in=0, rank_out=None, rank=1)
+
+    x = jnp.asarray(np.random.RandomState(5).normal(3, 1, (16, 4))
+                    .astype(np.float32))
+    m(x)
+    # reference: single-process stack with the same seeds
+    ref_b0, ref_stage = _Block(4, 6, seed=20), _BNStage()
+    ref_stage(ref_b0(x))
+    np.testing.assert_allclose(np.asarray(stage1.bn.avg_mean),
+                               np.asarray(ref_stage.bn.avg_mean),
+                               rtol=1e-4, atol=1e-5)
+    # owner's activations have nonzero mean — garbage (zeros) would not
+    assert float(np.abs(np.asarray(stage1.bn.avg_mean)).sum()) > 1e-3
