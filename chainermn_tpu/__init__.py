@@ -29,6 +29,8 @@ from .communicators import (create_communicator, CommunicatorBase,
 from . import functions
 from . import links
 from . import models
+from . import parallel
+from . import ops
 from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
 from . import extensions

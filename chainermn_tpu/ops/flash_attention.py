@@ -1,0 +1,134 @@
+"""Fused flash attention — Pallas TPU kernel.
+
+N2/N3-class component (SURVEY.md §2.5): where the reference hand-wrote
+CUDA kernels for its hot paths, the TPU rebuild's escape hatch beyond
+XLA fusion is Pallas.  Attention is the canonical case: the fused kernel
+keeps the [Tq, Tk] score matrix out of HBM entirely — scores live in VMEM
+tiles, softmax runs online (running max/normalizer), and the MXU sees one
+[BQ, D]×[D, Tk-block] matmul stream per query tile.
+
+``attention(q, k, v)`` dispatches: Pallas kernel on TPU backends, a
+jnp reference elsewhere (CPU tests run the kernel in interpreter mode to
+pin kernel↔reference equivalence).
+
+Ring-attention composition: ``parallel.ring_attention`` rotates KV blocks
+between chips; within a chip this kernel computes each block's
+contribution — ICI transfers at the outer level, VMEM tiling at the
+inner.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["attention", "flash_attention", "xla_attention"]
+
+
+def xla_attention(q, k, v, causal=False, scale=None):
+    """jnp reference implementation (and non-TPU fallback)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                  q_offset_blocks):
+    """One (batch*head, q-block) program: stream K/V blocks through VMEM
+    with the online-softmax recurrence."""
+    bq, d = q_ref.shape
+    tk = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    n_kblocks = tk // block_k
+    q_pos = (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, block_k]
+        if causal:
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks that intersect the causal triangle contribute
+        last_needed = jnp.minimum(
+            (qi * bq + bq + block_k - 1) // block_k, n_kblocks)
+    else:
+        last_needed = n_kblocks
+    m, l, acc = jax.lax.fori_loop(0, last_needed, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Fused attention via Pallas.  q/k/v: [B, H, T, D]."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale,
+                               q_offset_blocks=0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return xla_attention(q, k, v, causal=causal, scale=scale)

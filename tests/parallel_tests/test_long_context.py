@@ -1,0 +1,131 @@
+"""Sequence parallelism: ring attention and Ulysses vs full attention.
+
+Golden rule (SURVEY.md §4): distributed result == single-device result on
+the gathered sequence, forward AND backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu as ct
+from chainermn_tpu.parallel import (ring_self_attention, ulysses_attention)
+
+COMM = None
+
+
+def setup_module(module):
+    global COMM
+    COMM = ct.create_communicator("jax_ici", axis_name="seq")
+
+
+def _full_reference(q, k, v, causal, scale=None):
+    D = q.shape[-1]
+    scale = scale or 1.0 / np.sqrt(D)
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = s.shape[-1]
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -np.inf)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _data(B=2, H=4, T=None, D=16, seed=0):
+    T = T or 8 * COMM.size
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+def _spec():
+    return P(None, None, "seq", None)
+
+
+def _run(fn, q, k, v):
+    spec = _spec()
+    return COMM.run_spmd(fn, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         in_specs=(spec, spec, spec), out_specs=spec)
+
+
+def test_ring_attention_matches_full():
+    q, k, v = _data(seed=1)
+    out = _run(lambda q, k, v: ring_self_attention(COMM, q, k, v), q, k, v)
+    ref = _full_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_causal_matches_full():
+    q, k, v = _data(seed=2)
+    out = _run(lambda q, k, v: ring_self_attention(COMM, q, k, v,
+                                                   causal=True), q, k, v)
+    ref = _full_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_match_full():
+    q, k, v = _data(B=1, H=2, D=8, seed=3)
+
+    def dist_loss(q, k, v):
+        out = ring_self_attention(COMM, q, k, v, causal=True)
+        return jnp.sum(out ** 2)
+
+    def body(q, k, v):
+        g = jax.grad(dist_loss, argnums=(0, 1, 2))(q, k, v)
+        return g
+
+    spec = _spec()
+    gq, gk, gv = COMM.run_spmd(body, jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v),
+                               in_specs=(spec, spec, spec),
+                               out_specs=(spec, spec, spec))
+
+    qj, kj, vj = map(jnp.asarray, (q, k, v))
+
+    def ref_loss(q, k, v):
+        D = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        T = s.shape[-1]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        return jnp.sum(out ** 2)
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(qj, kj, vj)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ulysses_matches_full():
+    q, k, v = _data(H=8, seed=4)  # H divisible by size
+    out = _run(lambda q, k, v: ulysses_attention(COMM, q, k, v), q, k, v)
+    ref = _full_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_causal_matches_full():
+    q, k, v = _data(H=8, seed=5)
+    out = _run(lambda q, k, v: ulysses_attention(COMM, q, k, v, causal=True),
+               q, k, v)
+    ref = _full_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_head_count_validation():
+    import pytest
+    q = jnp.zeros((1, 3, 8 * COMM.size, 4))  # 3 heads not divisible by 8
+
+    def body(q):
+        from chainermn_tpu.parallel import seq_to_head_shard
+        return seq_to_head_shard(COMM, q)
+
+    with pytest.raises(Exception):
+        COMM.run_spmd(body, q, in_specs=(_spec(),), out_specs=_spec())
