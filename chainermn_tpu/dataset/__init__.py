@@ -4,4 +4,9 @@ from .datasets import (DatasetMixin, TupleDataset, DictDataset, SubDataset,
                        get_synthetic_imagenet)
 from .iterators import (Iterator, SerialIterator, MultiprocessIterator,
                         MultithreadIterator)
-from .convert import concat_examples, to_device
+from .convert import concat_examples, to_device, identity_converter
+
+try:
+    from .native_iterator import NativeBatchIterator
+except Exception:  # pragma: no cover - no toolchain
+    NativeBatchIterator = None

@@ -12,7 +12,15 @@ import numpy as np
 
 import jax
 
-__all__ = ["concat_examples", "to_device"]
+__all__ = ["concat_examples", "to_device", "identity_converter"]
+
+
+def identity_converter(batch, device=None):
+    """Pass-through converter for iterators that already emit stacked
+    arrays (``NativeBatchIterator``)."""
+    if device is not None:
+        return to_device(batch, device)
+    return batch
 
 
 def _stack(xs, padding=None):

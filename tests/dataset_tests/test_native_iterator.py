@@ -1,0 +1,98 @@
+"""Native C++ batch-assembly engine + iterator."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.dataset.datasets import TupleDataset
+
+native = pytest.importorskip("chainermn_tpu.utils.native")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.load_library()
+    if lib is None:
+        pytest.skip("g++ unavailable")
+    return lib
+
+
+def test_native_loader_gathers_rows(lib):
+    data = np.arange(100 * 16, dtype=np.float32).reshape(100, 16)
+    loader = native.NativeLoader(data, max_batch=8)
+    idx = np.asarray([3, 97, 0, 42], dtype=np.int64)
+    loader.submit(idx)
+    batch = loader.next()
+    np.testing.assert_array_equal(batch, data[idx])
+    loader.close()
+
+
+def test_native_loader_backpressure_many_batches(lib):
+    data = np.random.RandomState(0).normal(
+        0, 1, (256, 32)).astype(np.float32)
+    loader = native.NativeLoader(data, max_batch=16, n_buffers=2)
+    rng = np.random.RandomState(1)
+    batches = []
+    submitted = []
+    for _ in range(20):
+        idx = rng.randint(0, 256, 16).astype(np.int64)
+        submitted.append(idx)
+        loader.submit(idx)
+        batches.append(loader.next())
+    for idx, b in zip(submitted, batches):
+        np.testing.assert_array_equal(b, data[idx])
+    loader.close()
+
+
+def test_native_loader_rejects_bad_indices(lib):
+    data = np.zeros((10, 4), np.float32)
+    loader = native.NativeLoader(data, max_batch=4)
+    with pytest.raises(ValueError):
+        loader.submit(np.asarray([0, 99], dtype=np.int64))
+    loader.close()
+
+
+def test_native_batch_iterator_epoch_coverage(lib):
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    y = np.arange(64, dtype=np.int32)
+    it = NativeBatchIterator(TupleDataset(x, y), 16, shuffle=True, seed=0)
+    seen = []
+    for _ in range(4):
+        bx, by = it.next()
+        assert bx.shape == (16, 1)
+        np.testing.assert_array_equal(bx[:, 0].astype(np.int32), by)
+        seen.extend(by.tolist())
+    assert sorted(seen) == list(range(64))
+    assert it.epoch == 1
+    it.finalize()
+
+
+def test_native_batch_iterator_no_repeat_stops(lib):
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    x = np.zeros((32, 4), np.float32)
+    it = NativeBatchIterator(x, 16, repeat=False, shuffle=False)
+    assert it.next().shape == (16, 4)
+    assert it.next().shape == (16, 4)
+    with pytest.raises(StopIteration):
+        it.next()
+    it.finalize()
+
+
+def test_native_iterator_trains_with_updater(lib):
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    from chainermn_tpu.dataset.convert import identity_converter
+    from chainermn_tpu.core.optimizer import Adam
+    from chainermn_tpu.models import Classifier, MLP
+    from chainermn_tpu.training import StandardUpdater, Trainer
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (128, 8)).astype(np.float32)
+    t = rng.randint(0, 3, 128).astype(np.int32)
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    opt = Adam().setup(model)
+    it = NativeBatchIterator(TupleDataset(x, t), 32, seed=1)
+    updater = StandardUpdater(it, opt, converter=identity_converter)
+    trainer = Trainer(updater, (8, "iteration"), out="/tmp/native_it_out")
+    trainer.run()
+    assert opt.t == 8
+    it.finalize()
