@@ -3,8 +3,8 @@
 from .point_to_point_communication import (point_to_point, send, recv,
                                            pseudo_connect)
 from .collective_communication import (allgather, alltoall, bcast, gather,
-                                       scatter, allreduce)
+                                       scatter, allreduce, psum_gradient)
 
 __all__ = ["point_to_point", "send", "recv", "pseudo_connect",
            "allgather", "alltoall", "bcast", "gather", "scatter",
-           "allreduce"]
+           "allreduce", "psum_gradient"]

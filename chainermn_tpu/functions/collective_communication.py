@@ -16,11 +16,48 @@ get wrong.  These are the building blocks for tensor/hybrid parallelism
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["allgather", "alltoall", "bcast", "gather", "scatter",
-           "allreduce"]
+           "allreduce", "psum_gradient"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_grad(x, axis_name):
+    return x
+
+
+def _psum_grad_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_grad_bwd(axis_name, _, g):
+    return (lax.pmean(g, axis_name),)
+
+
+_psum_grad.defvjp(_psum_grad_fwd, _psum_grad_bwd)
+
+
+def psum_gradient(communicator, x):
+    """Identity forward, gradient allreduce backward.
+
+    The "copy into tensor-parallel region" primitive: a replicated tensor
+    consumed shard-wise by different ranks (each slicing its block) has
+    per-rank cotangents covering only that rank's slice; the backward
+    allreduce reassembles the full replicated gradient.
+
+    Scaling contract: this framework's SPMD convention is that the loss is
+    computed *redundantly on every rank* (MultiNodeChainList broadcasts
+    the terminal output; DP losses are per-shard means).  Under that
+    convention collective transposes already multiply cotangents by the
+    rank count, so the reassembly here is a ``pmean`` — the result equals
+    the single-process gradient exactly.
+    """
+    return _psum_grad(x, communicator.axis_name)
 
 
 def allgather(communicator, x):

@@ -1,0 +1,134 @@
+"""Transformer language model with sequence-parallel attention.
+
+Beyond-reference model family (ChainerMN predates transformers; SURVEY.md
+§5 long-context note prescribes ring/Ulysses layers as the rebuild's
+long-context story).  TPU-first: pre-norm blocks whose FLOPs are three
+fused GEMMs (qkv, attention output, MLP), ``ops.attention`` dispatching
+to the Pallas flash kernel on TPU, and a ``sequence_parallel`` mode that
+shards the sequence over a communicator axis — attention runs as ring
+attention (ppermute KV rotation) or Ulysses (all_to_all head exchange)
+while every other op stays position-local, so the same weights serve
+single-chip and sequence-parallel execution bit-compatibly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.link import Chain, ChainList
+from ..core import reporter
+from ..nn import functions as F
+from ..nn import links as L
+from ..ops import attention as fused_attention
+
+__all__ = ["MultiHeadAttention", "TransformerBlock", "TransformerLM"]
+
+
+def _axis_bound(comm):
+    if comm is None or comm.axis_name is None:
+        return False
+    from jax._src.core import get_axis_env
+    return get_axis_env().axis_exists(comm.axis_name)
+
+
+class MultiHeadAttention(Chain):
+    def __init__(self, d_model, n_heads, seed=0, sp_comm=None,
+                 sp_mode="ring"):
+        super().__init__()
+        assert d_model % n_heads == 0
+        self.n_heads = n_heads
+        self.d_head = d_model // n_heads
+        self.sp_comm = sp_comm
+        self.sp_mode = sp_mode
+        with self.init_scope():
+            self.qkv = L.Linear(d_model, 3 * d_model, seed=seed)
+            self.proj = L.Linear(d_model, d_model, seed=seed + 1)
+
+    def forward(self, x, causal=True):
+        B, T, D = x.shape
+        qkv = self.qkv(x.reshape(B * T, D)).reshape(B, T, 3, self.n_heads,
+                                                    self.d_head)
+        q, k, v = [jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)]
+        if _axis_bound(self.sp_comm):
+            if self.sp_mode == "ring":
+                from ..parallel import ring_self_attention
+                out = ring_self_attention(self.sp_comm, q, k, v,
+                                          causal=causal)
+            else:
+                from ..parallel import ulysses_attention
+                out = ulysses_attention(self.sp_comm, q, k, v,
+                                        causal=causal)
+        else:
+            out = fused_attention(q, k, v, causal=causal)
+        out = jnp.moveaxis(out, 2, 1).reshape(B * T, D)
+        return self.proj(out).reshape(B, T, D)
+
+
+class TransformerBlock(Chain):
+    def __init__(self, d_model, n_heads, d_ff=None, seed=0, sp_comm=None,
+                 sp_mode="ring"):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        with self.init_scope():
+            self.ln1 = L.LayerNormalization(d_model)
+            self.attn = MultiHeadAttention(d_model, n_heads, seed=seed,
+                                           sp_comm=sp_comm, sp_mode=sp_mode)
+            self.ln2 = L.LayerNormalization(d_model)
+            self.fc1 = L.Linear(d_model, d_ff, seed=seed + 10)
+            self.fc2 = L.Linear(d_ff, d_model, seed=seed + 11)
+
+    def forward(self, x, causal=True):
+        B, T, D = x.shape
+        h = x + self.attn(self.ln1(x), causal=causal)
+        m = self.fc2(F.gelu(self.fc1(self.ln2(h).reshape(B * T, D))))
+        return h + m.reshape(B, T, D)
+
+
+class TransformerLM(Chain):
+    """Causal LM.  ``sequence_parallel``: pass ``sp_comm`` and call inside
+    a program sharding the T dimension over its axis (positions must be
+    offset-consistent: ``pos_offset`` = rank * T_local, supplied
+    automatically when the axis is bound)."""
+
+    def __init__(self, n_vocab, d_model=128, n_heads=4, n_layers=2,
+                 max_len=2048, seed=0, sp_comm=None, sp_mode="ring"):
+        super().__init__()
+        self.sp_comm = sp_comm
+        with self.init_scope():
+            self.embed = L.EmbedID(n_vocab, d_model, seed=seed)
+            self.pos_embed = L.EmbedID(max_len, d_model, seed=seed + 1)
+            self.blocks = ChainList(*[
+                TransformerBlock(d_model, n_heads, seed=seed + 100 * (i + 1),
+                                 sp_comm=sp_comm, sp_mode=sp_mode)
+                for i in range(n_layers)])
+            self.ln_f = L.LayerNormalization(d_model)
+            self.head = L.Linear(d_model, n_vocab, nobias=True,
+                                 seed=seed + 999)
+
+    def hidden(self, x):
+        B, T = x.shape
+        offset = 0
+        if _axis_bound(self.sp_comm):
+            offset = jax.lax.axis_index(self.sp_comm.axis_name) * T
+        pos = offset + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
+        for block in self.blocks:
+            h = block(h)
+        return self.ln_f(h)
+
+    def logits(self, x):
+        B, T = x.shape
+        h = self.hidden(x)
+        return self.head(h.reshape(B * T, -1)).reshape(B, T, -1)
+
+    def forward(self, x, t):
+        """LM loss with ignore_label=-1 padding."""
+        logits = self.logits(x)
+        loss = F.softmax_cross_entropy(
+            logits.reshape(-1, logits.shape[-1]), t.reshape(-1),
+            ignore_label=-1)
+        reporter.report({"loss": loss}, self)
+        return loss

@@ -75,3 +75,24 @@ def test_maybe_load_empty_dir_returns_none(tmp_path):
     cp = ct.create_multi_node_checkpointer(comm, name="x")
     assert cp.maybe_load(trainer) is None
     assert trainer.updater.iteration == 0
+
+
+def test_orbax_checkpointer_roundtrip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from chainermn_tpu.extensions.orbax_checkpoint import OrbaxCheckpointer
+    from chainermn_tpu import L
+    import jax.numpy as jnp
+
+    link = L.BatchNormalization(4)
+    link.gamma.array = jnp.full((4,), 3.0)
+    link.avg_mean = jnp.full((4,), 0.5)
+    cp = OrbaxCheckpointer(str(tmp_path / "orbax"), max_to_keep=2)
+    cp.save_link(1, link)
+    cp.save_link(2, link)
+    assert cp.latest_step() == 2
+
+    link2 = L.BatchNormalization(4)
+    assert cp.restore_link(link2)
+    np.testing.assert_allclose(np.asarray(link2.gamma.array), 3.0)
+    np.testing.assert_allclose(np.asarray(link2.avg_mean), 0.5)
+    cp.close()
