@@ -1,0 +1,129 @@
+"""Scaling-efficiency harness (north-star metric #2, BASELINE.md).
+
+Measures data-parallel ResNet train-step throughput at 1..N chips and the
+raw gradient-allreduce bandwidth, reporting scaling efficiency
+(throughput_n / (n × throughput_1)).  On a real pod the mesh covers
+physical chips and the collective rides ICI; on this 1-chip dev box run
+with ``--simulate-devices 8 --platform cpu`` for the methodology curve
+(framework-overhead scaling only — SURVEY §7 step 7 notes v4-32 numbers
+are for the real-pod stage).
+
+Output: one JSON line per device count + a summary line.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def measure_step_throughput(n_devices, per_chip_bs, image_size, steps,
+                            model_kind="resnet18"):
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.models import Classifier, ResNet18, ResNet50
+
+    devices = jax.devices()[:n_devices]
+    comm = ct.create_communicator("jax_ici", devices=devices,
+                                  axis_name=f"bench{n_devices}",
+                                  allreduce_grad_dtype="bfloat16")
+    model_cls = ResNet50 if model_kind == "resnet50" else ResNet18
+    model = Classifier(model_cls(n_classes=1000,
+                                 compute_dtype=jnp.bfloat16, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+
+    gbs = per_chip_bs * n_devices
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (gbs, 3, image_size, image_size))
+                    .astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 1000, gbs).astype(np.int32))
+    for _ in range(2):
+        loss = opt.update(model, x, t)
+    jax.block_until_ready(loss)
+    start = time.perf_counter()
+    for _ in range(steps):
+        loss = opt.update(model, x, t)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - start
+    return steps * gbs / dt
+
+
+def measure_allreduce_bandwidth(n_devices, n_floats, iters=20):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()[:n_devices]
+    mesh = Mesh(np.asarray(devices), ("ar",))
+    x = jnp.ones((n_devices, n_floats), jnp.float32)
+
+    fn = jax.jit(shard_map(lambda x: lax.psum(x, "ar"), mesh=mesh,
+                           in_specs=P("ar"), out_specs=P("ar"),
+                           check_vma=False))
+    jax.block_until_ready(fn(x))
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - start
+    # ring allreduce moves 2(n-1)/n × payload per chip
+    bytes_moved = 4 * n_floats * 2 * (n_devices - 1) / max(n_devices, 1)
+    return iters * bytes_moved / dt / 1e9  # GB/s per chip
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--per-chip-bs", type=int, default=8)
+    parser.add_argument("--size", type=int, default=96)
+    parser.add_argument("--steps", type=int, default=5)
+    parser.add_argument("--model", default="resnet18",
+                        choices=["resnet18", "resnet50"])
+    parser.add_argument("--allreduce-floats", type=int, default=1 << 22)
+    parser.add_argument("--platform", default=None)
+    parser.add_argument("--simulate-devices", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.simulate_devices:
+        from chainermn_tpu.utils import simulate_devices
+        simulate_devices(args.simulate_devices)
+    if args.platform:
+        from chainermn_tpu.utils import use_platform
+        use_platform(args.platform)
+
+    import jax
+    max_devices = len(jax.devices())
+    counts = [n for n in (1, 2, 4, 8, 16, 32) if n <= max_devices]
+
+    base = None
+    results = []
+    for n in counts:
+        thr = measure_step_throughput(n, args.per_chip_bs, args.size,
+                                      args.steps, args.model)
+        if base is None:
+            base = thr
+        eff = thr / (n * base)
+        bw = measure_allreduce_bandwidth(n, args.allreduce_floats) \
+            if n > 1 else 0.0
+        row = {"devices": n, "images_per_sec": round(thr, 2),
+               "scaling_efficiency": round(eff, 4),
+               "allreduce_gbps_per_chip": round(bw, 2)}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    print(json.dumps({
+        "metric": f"{args.model}_dp_scaling_efficiency_1_to_{counts[-1]}",
+        "value": results[-1]["scaling_efficiency"],
+        "unit": "fraction",
+        "vs_baseline": round(results[-1]["scaling_efficiency"] / 0.9, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,8 @@ from . import initializers as I
 __all__ = ["Linear", "Convolution2D", "Deconvolution2D",
            "DepthwiseConvolution2D", "BatchNormalization",
            "LayerNormalization", "EmbedID", "LSTM", "StatelessLSTM",
-           "GroupNormalization"]
+           "GroupNormalization", "StatelessGRU", "GRU", "NStepLSTM",
+           "NStepGRU"]
 
 _default_rng = np.random.RandomState(817)
 
@@ -338,3 +339,7 @@ class LSTM(StatelessLSTM):
     def forward(self, x):
         self.c, self.h = super().forward(self.c, self.h, x)
         return self.h
+
+
+# RNN family lives in nn/rnn.py (imported late: it consumes Linear above)
+from .rnn import StatelessGRU, GRU, NStepLSTM, NStepGRU  # noqa: E402

@@ -242,19 +242,55 @@ class Evaluator(Extension):
         else:
             it = copy.copy(iterator)
         summary = reporter_module.DictSummary()
+        from ..core.link import Link
+        compiled = isinstance(eval_func, Link)
         with using_config("train", False):
             for batch in it:
+                in_arrays = self.converter(batch, self.device)
+                args = in_arrays if isinstance(in_arrays, tuple) \
+                    else (in_arrays,)
+                if compiled and not isinstance(in_arrays, dict):
+                    summary.add(self._compiled_eval(eval_func, args))
+                    continue
                 observation = {}
                 with reporter_module.report_scope(observation):
-                    in_arrays = self.converter(batch, self.device)
-                    if isinstance(in_arrays, tuple):
-                        eval_func(*in_arrays)
-                    elif isinstance(in_arrays, dict):
+                    if isinstance(in_arrays, dict):
                         eval_func(**in_arrays)
                     else:
-                        eval_func(in_arrays)
+                        eval_func(*args)
                 summary.add(observation)
         return summary.compute_mean()
+
+    def _compiled_eval(self, target, args):
+        """One jitted validation step: forward + captured observations.
+
+        The reference runs evaluation eagerly per batch; compiling keeps
+        validation on-device at train-step speeds.  Cached per input
+        shapes; the trace-time reporter is the prefixed one installed by
+        ``__call__``, so observation keys match the eager path.
+        """
+        import jax
+        import numpy as np
+        from ..core.link import bind_state, extract_state
+        if not hasattr(self, "_eval_cache"):
+            self._eval_cache = {}
+        key = tuple((np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
+                    for a in jax.tree.leaves(args))
+        fn = self._eval_cache.get(key)
+        if fn is None:
+            def fn(params, pstate, args):
+                with bind_state(target, {"params": params,
+                                         "state": pstate}):
+                    obs = {}
+                    with reporter_module.get_current_reporter().scope(obs):
+                        with using_config("train", False):
+                            target(*args)
+                return obs
+
+            fn = jax.jit(fn)
+            self._eval_cache[key] = fn
+        state = extract_state(target)
+        return fn(state["params"], state["state"], args)
 
 
 class ExponentialShift(Extension):

@@ -140,3 +140,32 @@ def test_unpooling_2d_stride_pad():
     assert y2.shape == (1, 1, 5, 5)
     # center cell covered by all 9 windows
     assert float(y2[0, 0, 2, 2]) == 9.0
+
+
+def test_gru_and_nstep_rnns():
+    from chainermn_tpu.nn.rnn import GRU, NStepGRU, NStepLSTM
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (3, 5, 4)).astype(np.float32))
+
+    hy, cy, ys = NStepLSTM(2, 4, 6, seed=0)(None, None, x)
+    assert hy.shape == (2, 3, 6) and cy.shape == (2, 3, 6)
+    assert ys.shape == (3, 5, 6)
+
+    hy2, ys2 = NStepGRU(2, 4, 6, seed=1)(None, x)
+    assert hy2.shape == (2, 3, 6) and ys2.shape == (3, 5, 6)
+
+    # mask freezes state: fully-masked suffix leaves hy at the prefix value
+    mask = jnp.asarray(np.array([[True] * 2 + [False] * 3] * 3))
+    lstm = NStepLSTM(1, 4, 6, seed=2)
+    hy_m, _, _ = lstm(None, None, x, mask=mask)
+    hy_p, _, _ = lstm(None, None, x[:, :2])
+    np.testing.assert_allclose(np.asarray(hy_m), np.asarray(hy_p),
+                               rtol=1e-5)
+
+    gru = GRU(4, 6, seed=3)
+    h1 = gru(x[:, 0])
+    h2 = gru(x[:, 1])
+    assert h2.shape == (3, 6)
+    gru.reset_state()
+    np.testing.assert_allclose(np.asarray(gru(x[:, 0])), np.asarray(h1),
+                               rtol=1e-6)
