@@ -379,6 +379,12 @@ def bind_state(link: Link, state: dict):
             saved_persistent.append((sublink, name, full, getattr(sublink, name)))
             object.__setattr__(sublink, name, pstate[full])
             sublink._persistent[name] = pstate[full]
+    # volatile per-call state (stateful LSTM/GRU hidden values): restored
+    # on exit so traced calls can't leak tracers into link attributes
+    saved_volatile = []
+    for sublink in link.links():
+        for name in getattr(sublink, "_volatile_attrs", ()):
+            saved_volatile.append((sublink, name, getattr(sublink, name)))
 
     class _Handle:
         updated_state: dict = {}
@@ -400,6 +406,8 @@ def bind_state(link: Link, state: dict):
         for sublink, name, full, orig in saved_persistent:
             object.__setattr__(sublink, name, orig)
             sublink._persistent[name] = orig
+        for sublink, name, orig in saved_volatile:
+            object.__setattr__(sublink, name, orig)
 
 
 def apply_state(link: Link, state: dict, *args, **kwargs):

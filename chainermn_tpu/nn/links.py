@@ -321,7 +321,11 @@ class LSTM(StatelessLSTM):
 
     Statefulness is eager-mode convenience; inside jitted programs prefer
     ``StatelessLSTM`` + ``lax.scan`` (see ``models/seq2seq.py``).
+    ``_volatile_attrs`` lets ``bind_state`` restore (c, h) after traced
+    calls so tracers never leak into the link.
     """
+
+    _volatile_attrs = ("c", "h")
 
     def __init__(self, in_size, out_size, seed=None):
         super().__init__(in_size, out_size, seed=seed)

@@ -54,6 +54,8 @@ class StatelessGRU(Chain):
 class GRU(StatelessGRU):
     """Stateful GRU (reference: ``L.GRU``)."""
 
+    _volatile_attrs = ("h",)
+
     def __init__(self, in_size, out_size, seed=None):
         super().__init__(in_size, out_size, seed=seed)
         self.h = None
@@ -106,6 +108,9 @@ class NStepLSTM(_NStepRNNBase):
         h_seq = xs
         hy, cy = [], []
         for layer, cell in enumerate(self):
+            if layer > 0 and self.dropout:
+                # reference semantics: inter-layer dropout during training
+                h_seq = F.dropout(h_seq, self.dropout)
             def step(carry, inp):
                 c, h = carry
                 x_t, m_t = inp
@@ -139,6 +144,8 @@ class NStepGRU(_NStepRNNBase):
         h_seq = xs
         hy = []
         for layer, cell in enumerate(self):
+            if layer > 0 and self.dropout:
+                h_seq = F.dropout(h_seq, self.dropout)
             def step(h, inp):
                 x_t, m_t = inp
                 h_new = cell(h, x_t)

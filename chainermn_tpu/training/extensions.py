@@ -242,16 +242,26 @@ class Evaluator(Extension):
         else:
             it = copy.copy(iterator)
         summary = reporter_module.DictSummary()
-        from ..core.link import Link
-        compiled = isinstance(eval_func, Link)
+        from ..core.link import Link, extract_state
+        compiled = isinstance(eval_func, Link) and \
+            not getattr(self, "_eval_compile_failed", False)
+        eval_state = extract_state(eval_func) if compiled else None
         with using_config("train", False):
             for batch in it:
                 in_arrays = self.converter(batch, self.device)
                 args = in_arrays if isinstance(in_arrays, tuple) \
                     else (in_arrays,)
                 if compiled and not isinstance(in_arrays, dict):
-                    summary.add(self._compiled_eval(eval_func, args))
-                    continue
+                    try:
+                        summary.add(self._compiled_eval(eval_func,
+                                                        eval_state, args))
+                        continue
+                    except Exception:
+                        # forwards that aren't jit-traceable (value-
+                        # dependent control flow, host-side metrics):
+                        # fall back to the reference's eager loop
+                        self._eval_compile_failed = True
+                        compiled = False
                 observation = {}
                 with reporter_module.report_scope(observation):
                     if isinstance(in_arrays, dict):
@@ -261,7 +271,7 @@ class Evaluator(Extension):
                 summary.add(observation)
         return summary.compute_mean()
 
-    def _compiled_eval(self, target, args):
+    def _compiled_eval(self, target, state, args):
         """One jitted validation step: forward + captured observations.
 
         The reference runs evaluation eagerly per batch; compiling keeps
@@ -271,7 +281,7 @@ class Evaluator(Extension):
         """
         import jax
         import numpy as np
-        from ..core.link import bind_state, extract_state
+        from ..core.link import bind_state
         if not hasattr(self, "_eval_cache"):
             self._eval_cache = {}
         key = tuple((np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
@@ -289,7 +299,6 @@ class Evaluator(Extension):
 
             fn = jax.jit(fn)
             self._eval_cache[key] = fn
-        state = extract_state(target)
         return fn(state["params"], state["state"], args)
 
 

@@ -5,6 +5,8 @@ import os
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 import chainermn_tpu as ct
 from chainermn_tpu import F, L
 from chainermn_tpu.core.optimizer import Adam, SGD
@@ -135,3 +137,54 @@ def test_bn_link_serialize_includes_persistent(tmp_path):
     load_npz(path, bn2)
     np.testing.assert_allclose(np.asarray(bn2.avg_mean),
                                np.asarray(bn1.avg_mean))
+
+
+def test_evaluator_falls_back_for_untraceable_forward(tmp_path, mnist_small):
+    """Forwards with value-dependent Python control flow still evaluate
+    (eager fallback instead of a trace crash)."""
+    train, test = mnist_small
+
+    class HostyClassifier(Classifier):
+        def forward(self, x, t):
+            y = self.predictor(x)
+            loss = F.softmax_cross_entropy(y, t)
+            # host-side branch: not jit-traceable
+            if float(np.asarray(loss)) > -1.0:
+                ct.report({"loss": loss}, self)
+            return loss
+
+    model = HostyClassifier(MLP())
+    model(np.ones((1, 784), np.float32), np.zeros((1,), np.int32))
+    from chainermn_tpu.training.extensions import Evaluator
+    from chainermn_tpu.dataset import SerialIterator
+    ev = Evaluator(SerialIterator(test, 64, repeat=False, shuffle=False),
+                   model)
+    result = ev()
+    assert any(k.endswith("main/loss") for k in result)
+    assert ev._eval_compile_failed
+
+
+def test_stateful_lstm_no_tracer_leak_through_compiled_paths():
+    """bind_state restores volatile LSTM state after traced calls."""
+    import jax
+
+    class LstmNet(ct.Chain):
+        def __init__(self):
+            super().__init__()
+            with self.init_scope():
+                self.lstm = L.LSTM(4, 6, seed=0)
+                self.out = L.Linear(6, 2, seed=1)
+
+        def forward(self, x, t):
+            self.lstm.reset_state()
+            h = self.lstm(x)
+            return F.softmax_cross_entropy(self.out(h), t)
+
+    net = LstmNet()
+    opt = SGD(lr=0.1).setup(net)
+    x = np.random.RandomState(0).normal(0, 1, (3, 4)).astype(np.float32)
+    t = np.zeros(3, np.int32)
+    opt.update(net, jnp.asarray(x), jnp.asarray(t))
+    # volatile state restored — no tracer leaked into the link
+    assert not isinstance(net.lstm.h, jax.core.Tracer)
+    opt.update(net, jnp.asarray(x), jnp.asarray(t))  # second step fine
