@@ -37,33 +37,45 @@ def main():
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
 
     n_devices = len(jax.devices())
-    global_bs = per_chip_bs * n_devices
 
-    comm = ct.create_communicator("jax_ici",
-                                  allreduce_grad_dtype="bfloat16")
-    model = Classifier(ResNet50(n_classes=1000,
-                                compute_dtype=jnp.bfloat16, seed=0))
-    comm.bcast_data(model)
-    opt = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+    def run(per_chip_bs):
+        global_bs = per_chip_bs * n_devices
+        comm = ct.create_communicator("jax_ici",
+                                      allreduce_grad_dtype="bfloat16")
+        model = Classifier(ResNet50(n_classes=1000,
+                                    compute_dtype=jnp.bfloat16, seed=0))
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
 
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.normal(0, 1, (global_bs, 3, image_size, image_size))
-                    .astype(np.float32))
-    t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.normal(
+            0, 1, (global_bs, 3, image_size, image_size)).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
 
-    # warmup: compile + 2 steady steps
-    for _ in range(3):
-        loss = opt.update(model, x, t)
-    jax.block_until_ready(loss)
+        for _ in range(3):  # warmup: compile + 2 steady steps
+            loss = opt.update(model, x, t)
+        jax.block_until_ready(loss)
 
-    start = time.perf_counter()
-    for _ in range(n_steps):
-        loss = opt.update(model, x, t)
-    jax.block_until_ready(loss)
-    elapsed = time.perf_counter() - start
+        start = time.perf_counter()
+        for _ in range(n_steps):
+            loss = opt.update(model, x, t)
+        jax.block_until_ready(loss)
+        elapsed = time.perf_counter() - start
+        return n_steps * global_bs / elapsed
 
-    images_per_sec = n_steps * global_bs / elapsed
+    images_per_sec = None
+    last_err = None
+    for bs in (per_chip_bs, per_chip_bs // 2, per_chip_bs // 4):
+        if bs < 1:
+            break
+        try:
+            images_per_sec = run(bs)
+            break
+        except Exception as e:  # e.g. HBM OOM at the largest batch
+            last_err = e
+    if images_per_sec is None:
+        raise last_err
     per_chip = images_per_sec / n_devices
     baseline = 225.0  # ChainerMN-era images/sec/GPU (see module docstring)
     print(json.dumps({

@@ -267,7 +267,11 @@ class Optimizer:
                 tx, grads, opt_state, params, hyper["lr"])
             return new_params, new_pstate, new_opt_state, loss, grads, obs
 
-        return jax.jit(step)
+        # donate opt_state (optimizer-internal, replaced by the returned
+        # value) so XLA updates it in place; params/persistent state stay
+        # un-donated — Link arrays are user-visible and may be aliased
+        # (copyparams shares array objects)
+        return jax.jit(step, donate_argnums=(2,))
 
     def _cache_key(self, lossfun, args, kwargs):
         shapes = tuple(

@@ -191,7 +191,9 @@ class _MultiNodeOptimizer:
             in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        return jax.jit(mapped)
+        # donate opt_state only (see core/optimizer.py note: Link arrays
+        # may be user-aliased)
+        return jax.jit(mapped, donate_argnums=(2,))
 
     # -- misc reference API -----------------------------------------------------
     def new_epoch(self):
