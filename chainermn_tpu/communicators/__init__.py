@@ -26,14 +26,15 @@ from __future__ import annotations
 import jax
 
 from .communicator_base import CommunicatorBase
+from .debug_communicator import DebugCommunicator
 from .dummy_communicator import DummyCommunicator
 from .mesh_communicator import MeshCommunicator
 
 __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
-           "DummyCommunicator"]
+           "DummyCommunicator", "DebugCommunicator"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
-          "non_cuda_aware", "pure_nccl", "jax_ici", "dummy")
+          "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug")
 
 
 def create_communicator(communicator_name="jax_ici", devices=None,
@@ -51,6 +52,10 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             f"unknown communicator {name!r}; choose from {_NAMES}")
     if name == "dummy":
         return DummyCommunicator()
+    if name == "debug":
+        return DebugCommunicator(devices=devices, axis_name=axis_name,
+                                 allreduce_grad_dtype=allreduce_grad_dtype,
+                                 batch_collectives=bool(batch_collectives))
     if name == "single_node" and jax.process_count() != 1:
         raise ValueError("single_node communicator requires one host "
                          f"(process_count={jax.process_count()})")

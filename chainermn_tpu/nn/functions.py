@@ -29,6 +29,8 @@ __all__ = [
     "global_average_pooling_2d", "resize_images",
     "batch_normalization", "fixed_batch_normalization", "layer_normalization",
     "concat", "stack", "hstack", "vstack", "split_axis", "separate",
+    "average", "select_item", "absolute", "maximum", "minimum", "swish",
+    "normalize", "local_response_normalization", "squared_error",
     "reshape", "flatten", "transpose", "expand_dims", "squeeze", "tile",
     "broadcast_to", "sum", "mean", "max", "min", "argmax", "sqrt", "exp",
     "log", "clip", "matmul", "batch_matmul", "where", "pad",
@@ -480,3 +482,62 @@ def where(cond, x, y):
 
 def pad(x, pad_width, mode="constant", **kwargs):
     return jnp.pad(x, pad_width, mode=mode, **kwargs)
+
+
+# -- additional reference-surface functions ---------------------------------
+
+def average(x, axis=None, weights=None, keepdims=False):
+    """Weighted mean (reference: ``F.average``)."""
+    if weights is None:
+        return jnp.mean(x, axis=axis, keepdims=keepdims)
+    return jnp.average(x, axis=axis, weights=weights)
+
+
+def select_item(x, t):
+    """x[i, t[i]] for each row (reference: ``F.select_item``)."""
+    return jnp.take_along_axis(x, t[:, None], axis=1).squeeze(1)
+
+
+def absolute(x):
+    return jnp.abs(x)
+
+
+def maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+def minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def normalize(x, eps=1e-5, axis=1):
+    """L2 normalization along ``axis`` (reference: ``F.normalize``)."""
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True)) + eps
+    return x / norm
+
+
+def local_response_normalization(x, n=5, k=2.0, alpha=1e-4, beta=0.75):
+    """Cross-channel LRN on NCHW (reference: ``F.local_response_
+    normalization``; AlexNet-era)."""
+    sq = x * x
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    # note: this module shadows builtin sum with the reference F.sum alias
+    window = padded[:, 0:x.shape[1]]
+    for i in range(1, n):
+        window = window + padded[:, i:i + x.shape[1]]
+    return x / (k + alpha * window) ** beta
+
+
+def squared_error(x, t):
+    return (x - t) ** 2
+
+
+def log_softmax_cross_entropy_components(x, t, ignore_label=-1):
+    """(per-example nll, valid mask) — building block for custom losses."""
+    nll = softmax_cross_entropy(x, t, ignore_label=ignore_label, reduce="no")
+    return nll, t != ignore_label

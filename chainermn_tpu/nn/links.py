@@ -28,7 +28,7 @@ __all__ = ["Linear", "Convolution2D", "Deconvolution2D",
            "DepthwiseConvolution2D", "BatchNormalization",
            "LayerNormalization", "EmbedID", "LSTM", "StatelessLSTM",
            "GroupNormalization", "StatelessGRU", "GRU", "NStepLSTM",
-           "NStepGRU"]
+           "NStepGRU", "Highway", "Maxout", "Scale", "Classifier"]
 
 _default_rng = np.random.RandomState(817)
 
@@ -347,3 +347,69 @@ class LSTM(StatelessLSTM):
 
 # RNN family lives in nn/rnn.py (imported late: it consumes Linear above)
 from .rnn import StatelessGRU, GRU, NStepLSTM, NStepGRU  # noqa: E402
+
+
+class Highway(Link):
+    """Highway layer (reference: ``L.Highway``)."""
+
+    def __init__(self, in_out_size, nobias=False, activate=None, seed=None):
+        super().__init__()
+        self.activate = activate or F.relu
+        s = (lambda k: None if seed is None else seed + k)
+        with self.init_scope():
+            self.plain = Linear(in_out_size, in_out_size, nobias=nobias,
+                                seed=s(0))
+            self.transform = Linear(in_out_size, in_out_size,
+                                    nobias=nobias,
+                                    initial_bias=I.Constant(-1.0), seed=s(1))
+
+    def forward(self, x):
+        h = self.activate(self.plain(x))
+        t = F.sigmoid(self.transform(x))
+        return h * t + x * (1 - t)
+
+
+class Maxout(Link):
+    """Fully-connected maxout (reference: ``L.Maxout``)."""
+
+    def __init__(self, in_size, out_size, pool_size, seed=None):
+        super().__init__()
+        self.out_size = out_size
+        self.pool_size = pool_size
+        with self.init_scope():
+            self.linear = Linear(in_size, out_size * pool_size, seed=seed)
+
+    def forward(self, x):
+        h = self.linear(x)
+        return jnp.max(h.reshape(-1, self.out_size, self.pool_size), axis=2)
+
+
+class Scale(Link):
+    """Elementwise scale + optional shift (reference: ``L.Scale``)."""
+
+    def __init__(self, axis=1, W_shape=None, bias_term=False):
+        super().__init__()
+        self.axis = axis
+        with self.init_scope():
+            self.W = Parameter(jnp.ones(W_shape))
+            if bias_term:
+                self.bias = Parameter(jnp.zeros(W_shape))
+        self.bias_term = bias_term
+
+    def forward(self, x):
+        shape = [1] * x.ndim
+        for i, s in enumerate(self.W.array.shape):
+            shape[self.axis + i] = s
+        y = x * self.W.array.reshape(shape)
+        if self.bias_term:
+            y = y + self.bias.array.reshape(shape)
+        return y
+
+
+def __getattr__(name):
+    # L.Classifier lives with the models (avoids a circular import);
+    # exposed here for chainer-parity `L.Classifier(...)` call sites
+    if name == "Classifier":
+        from ..models.mlp import Classifier
+        return Classifier
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

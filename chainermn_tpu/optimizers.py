@@ -110,6 +110,10 @@ class _MultiNodeOptimizer:
         if any(p.array is None for p in actual.target.params()):
             with bind_state(actual.target, extract_state(actual.target)):
                 lossfun(*jax.tree.map(lambda a: a, args), **kwargs)
+        if hasattr(self.communicator, "verify_step_signature"):
+            # debug communicator: agree on shapes/dtypes across hosts
+            # before launching (fail fast instead of collective deadlock)
+            self.communicator.verify_step_signature((args, kwargs))
         state = extract_state(actual.target)
         params, pstate = state["params"], state["state"]
         opt_state = actual._ensure_opt_state(params)

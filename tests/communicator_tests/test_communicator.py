@@ -235,3 +235,37 @@ def test_dummy_communicator_noops():
     model = L.Linear(2, 2, seed=0)
     d.bcast_data(model)
     d.multi_node_mean_grad(model)
+
+
+def test_debug_communicator_signature_checking():
+    from chainermn_tpu.communicators.debug_communicator import (
+        DebugCommunicator, SignatureMismatchError)
+    comm = create_communicator("debug")
+    assert isinstance(comm, DebugCommunicator)
+    x = jnp.ones((comm.size, 3))
+    out = comm.run_spmd(lambda x: x * 2, x)
+    assert comm.signature_checks == 1
+    comm.run_spmd(lambda x: x * 3, x)  # same signature → cached
+    assert comm.signature_checks == 1
+    comm.run_spmd(lambda x: x, jnp.ones((comm.size, 5)))  # new shape
+    assert comm.signature_checks == 2
+
+    # simulate a host disagreeing
+    orig = comm.allgather_obj
+    comm.allgather_obj = lambda obj: [obj, (1, "deadbeef", "(9, 9):bad")]
+    with pytest.raises(SignatureMismatchError, match="disagree"):
+        comm.verify_step_signature(jnp.ones((2, 2)))
+    comm.allgather_obj = orig
+
+
+def test_debug_communicator_under_optimizer():
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import SGD
+    from chainermn_tpu.models import Classifier, MLP
+    comm = create_communicator("debug")
+    model = Classifier(MLP(n_units=8, n_out=4, seed=0))
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model)
+    x = jnp.ones((comm.size * 2, 6))
+    t = jnp.zeros((comm.size * 2,), jnp.int32)
+    opt.update(model, x, t)
+    assert comm.signature_checks >= 1

@@ -169,3 +169,28 @@ def test_gru_and_nstep_rnns():
     gru.reset_state()
     np.testing.assert_allclose(np.asarray(gru(x[:, 0])), np.asarray(h1),
                                rtol=1e-6)
+
+
+def test_additional_links_and_functions():
+    from chainermn_tpu.nn.links import Highway, Maxout, Scale
+    x = jnp.asarray(np.random.RandomState(0).normal(0, 1, (4, 6))
+                    .astype(np.float32))
+    assert Highway(6, seed=0)(x).shape == (4, 6)
+    assert Maxout(6, 3, 2, seed=1)(x).shape == (4, 3)
+    sc = Scale(axis=1, W_shape=(6,), bias_term=True)
+    np.testing.assert_allclose(np.asarray(sc(x)), np.asarray(x), rtol=1e-6)
+
+    # L.Classifier alias resolves to the models implementation
+    clf = L.Classifier(L.Linear(6, 3, seed=2))
+    loss = clf(x, jnp.zeros(4, jnp.int32))
+    assert np.isfinite(float(loss))
+
+    y = F.select_item(x, jnp.asarray([0, 1, 2, 3]))
+    np.testing.assert_allclose(np.asarray(y),
+                               [x[i, i] for i in range(4)], rtol=1e-6)
+    assert F.swish(x).shape == x.shape
+    n = F.normalize(x)
+    np.testing.assert_allclose(np.asarray(jnp.sum(n * n, axis=1)), 1.0,
+                               rtol=1e-3)
+    img = jnp.ones((2, 8, 4, 4))
+    assert F.local_response_normalization(img).shape == img.shape
