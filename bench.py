@@ -25,6 +25,12 @@ import numpy as np
 
 def main():
     import jax
+    try:  # persistent compile cache: repeat runs skip the ~30s XLA compile
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/chainermn_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     import chainermn_tpu as ct
@@ -33,6 +39,7 @@ def main():
 
     # smoke-test knobs (defaults are the real benchmark configuration)
     per_chip_bs = int(os.environ.get("BENCH_BS", "64"))
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     image_size = int(os.environ.get("BENCH_SIZE", "224"))
     n_steps = int(os.environ.get("BENCH_STEPS", "10"))
 
@@ -42,7 +49,7 @@ def main():
         global_bs = per_chip_bs * n_devices
         comm = ct.create_communicator("jax_ici",
                                       allreduce_grad_dtype="bfloat16")
-        model = Classifier(ResNet50(n_classes=1000,
+        model = Classifier(ResNet50(n_classes=1000, remat=remat,
                                     compute_dtype=jnp.bfloat16, seed=0))
         comm.bcast_data(model)
         opt = ct.create_multi_node_optimizer(

@@ -110,9 +110,10 @@ class _Stage(ChainList):
 
 class ResNet(Chain):
     def __init__(self, block_counts, n_classes=1000, compute_dtype=None,
-                 seed=42):
+                 seed=42, remat=False):
         super().__init__()
         self.compute_dtype = compute_dtype
+        self.remat = remat
         with self.init_scope():
             self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
             self.res2 = _Stage(block_counts[0], 64, 64, 256, 1, seed + 100)
@@ -121,27 +122,59 @@ class ResNet(Chain):
             self.res5 = _Stage(block_counts[3], 1024, 512, 2048, 2, seed + 400)
             self.fc = L.Linear(2048, n_classes, seed=seed + 500)
 
+    def _apply_stage(self, stage, h):
+        if not self.remat:
+            return stage(h)
+        # rematerialize per stage: backward recomputes activations instead
+        # of keeping them resident — trades MXU FLOPs for HBM (SURVEY §7
+        # hardware note), buying larger per-chip batches.  BN running
+        # stats must flow through the checkpoint boundary as explicit
+        # inputs/outputs (attribute mutation would leak tracers out of the
+        # remat region).
+        import jax
+        from ..core.link import _persistent_slots
+        slots = list(_persistent_slots(stage))
+
+        def run(h, values):
+            for (sl, n, _), v in zip(slots, values):
+                object.__setattr__(sl, n, v)
+                sl._persistent[n] = v
+            out = stage(h)
+            new = tuple(getattr(sl, n) for sl, n, _ in slots)
+            return out, new
+
+        values = tuple(getattr(sl, n) for sl, n, _ in slots)
+        out, new = jax.checkpoint(run)(h, values)
+        for (sl, n, _), v in zip(slots, new):
+            object.__setattr__(sl, n, v)
+            sl._persistent[n] = v
+        return out
+
     def forward(self, x):
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
         h = self.conv1(x)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
-        h = self.res2(h)
-        h = self.res3(h)
-        h = self.res4(h)
-        h = self.res5(h)
+        h = self._apply_stage(self.res2, h)
+        h = self._apply_stage(self.res3, h)
+        h = self._apply_stage(self.res4, h)
+        h = self._apply_stage(self.res5, h)
         h = F.global_average_pooling_2d(h)
         return self.fc(h.astype(jnp.float32))
 
 
 class ResNet50(ResNet):
-    def __init__(self, n_classes=1000, compute_dtype=None, seed=42):
-        super().__init__([3, 4, 6, 3], n_classes, compute_dtype, seed)
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
+                 remat=False):
+        super().__init__([3, 4, 6, 3], n_classes, compute_dtype, seed,
+                         remat=remat)
 
 
 class ResNet101(ResNet):
-    def __init__(self, n_classes=1000, compute_dtype=None, seed=42):
-        super().__init__([3, 4, 23, 3], n_classes, compute_dtype, seed)
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
+                 remat=False):
+        super().__init__([3, 4, 23, 3], n_classes, compute_dtype, seed,
+                         remat=remat)
 
 
 class ResNet18(Chain):

@@ -127,3 +127,22 @@ def test_dcgan_data_parallel():
     updater = DCGANUpdater(it, opt_gen, opt_dis)
     updater.update()
     assert np.isfinite(np.asarray(gen.l0.W.array)).all()
+
+
+def test_resnet_remat_matches_no_remat():
+    """jax.checkpoint stages: identical loss/grads, lower activation
+    memory; BN stats thread through the remat boundary."""
+    from chainermn_tpu.core.optimizer import SGD
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.normal(0, 1, (4, 3, 64, 64)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 10, 4).astype(np.int32))
+    losses = {}
+    stats = {}
+    for remat in (False, True):
+        m = Classifier(ResNet50(n_classes=10, remat=remat, seed=0))
+        opt = SGD(lr=0.01).setup(m)
+        losses[remat] = [float(opt.update(m, x, t)) for _ in range(2)]
+        stats[remat] = np.asarray(m.predictor.res2[0].a.bn.avg_mean)
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+    np.testing.assert_allclose(stats[True], stats[False], rtol=1e-5)
+    assert np.abs(stats[True]).sum() > 0  # BN stats actually updated
