@@ -127,8 +127,35 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     return out.reshape(B, H, Tq, D)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_diff(q, k, v, causal, scale):
+    return flash_attention(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_diff_fwd(q, k, v, causal, scale):
+    # Pallas kernels are not differentiable by construction; forward uses
+    # the fused kernel, backward differentiates the XLA reference from the
+    # saved inputs (numerically identical).  This rematerializes the score
+    # matrix during backward — a dedicated flash backward kernel is the
+    # known follow-up; forward-only paths (inference, frozen towers) get
+    # full flash memory behavior today.
+    return flash_attention(q, k, v, causal=causal, scale=scale), (q, k, v)
+
+
+def _flash_diff_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(q, k, v, causal=causal, scale=scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def attention(q, k, v, causal=False, scale=None):
-    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere."""
+    """Dispatch: Pallas kernel on TPU (differentiable via custom VJP),
+    XLA reference elsewhere."""
     if jax.default_backend() in ("tpu", "axon"):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return _flash_diff(q, k, v, causal, scale)
     return xla_attention(q, k, v, causal=causal, scale=scale)
