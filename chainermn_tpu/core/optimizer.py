@@ -137,11 +137,14 @@ def make_loss_and_grad(target, lossfun):
         rep.add_observers("main", target.namedlinks(skipself=True))
         return rep
 
-    def loss_and_grad(params, pstate, args, kwargs):
+    def loss_and_grad(params, pstate, rng_key, args, kwargs):
+        from . import rng as rng_module
+
         def loss_on(p):
             with bind_state(target, {"params": p, "state": pstate}) as handle:
                 obs = {}
-                with resolve_reporter().scope(obs):
+                with resolve_reporter().scope(obs), \
+                        rng_module.key_scope(rng_key):
                     loss = lossfun(*args, **kwargs)
                 new_pstate = handle.collect()
             if isinstance(loss, tuple):
@@ -250,6 +253,18 @@ class Optimizer:
         return {name: jnp.asarray(getattr(self, name), jnp.float32)
                 for name in self._dynamic_hyper}
 
+    def _next_rng_key(self):
+        """Fresh per-step key (traced arg): stochastic layers get a new
+        mask every step without recompilation.  Seeded from ``self.seed``
+        when set (reproducibility)."""
+        if not hasattr(self, "_rng_key") or self._rng_key is None:
+            seed = getattr(self, "seed", None)
+            if seed is None:
+                seed = np.random.randint(0, 2**31 - 1)
+            self._rng_key = jax.random.PRNGKey(seed)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
     def _ensure_opt_state(self, params):
         if self._opt_state is None:
             self._opt_state = self._transform().init(params)
@@ -260,9 +275,9 @@ class Optimizer:
         tx = self._transform()
         loss_and_grad = make_loss_and_grad(self.target, lossfun)
 
-        def step(params, pstate, opt_state, hyper, args, kwargs):
+        def step(params, pstate, opt_state, hyper, rng_key, args, kwargs):
             loss, new_pstate, obs, grads = loss_and_grad(
-                params, pstate, args, kwargs)
+                params, pstate, rng_key, args, kwargs)
             new_params, new_opt_state = apply_transform_update(
                 tx, grads, opt_state, params, hyper["lr"])
             return new_params, new_pstate, new_opt_state, loss, grads, obs
@@ -299,7 +314,8 @@ class Optimizer:
             step = self._make_step(lossfun)
             self._step_cache[key] = step
         new_params, new_pstate, new_opt_state, loss, grads, obs = step(
-            params, pstate, opt_state, self._hyper_values(), args, kwargs)
+            params, pstate, opt_state, self._hyper_values(),
+            self._next_rng_key(), args, kwargs)
         self._write_back(new_params, new_pstate, grads)
         self._opt_state = new_opt_state
         self.t += 1

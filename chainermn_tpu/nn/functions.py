@@ -149,6 +149,12 @@ def dropout(x, ratio=0.5, key=None, train: bool | None = None):
     if not train or ratio == 0.0:
         return x
     if key is None:
+        # per-step key pushed by the compiled train step (core.rng);
+        # outside any step scope, fall back to a host-drawn key (eager
+        # use — matches the reference's hidden global RNG)
+        from ..core import rng as rng_module
+        key = rng_module.next_key()
+    if key is None:
         key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
     keep = 1.0 - ratio
     mask = jax.random.bernoulli(key, keep, x.shape)

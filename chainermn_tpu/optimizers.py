@@ -129,12 +129,12 @@ class _MultiNodeOptimizer:
                 super().__setattr__("_stale_grads", zeros)
             new_params, new_pstate, new_opt_state, loss, grads, obs = step(
                 params, pstate, opt_state, actual._hyper_values(),
-                (self._stale_grads,), args, kwargs)
+                actual._next_rng_key(), (self._stale_grads,), args, kwargs)
             super().__setattr__("_stale_grads", grads)
         else:
             new_params, new_pstate, new_opt_state, loss, grads, obs = step(
                 params, pstate, opt_state, actual._hyper_values(),
-                (), args, kwargs)
+                actual._next_rng_key(), (), args, kwargs)
         actual._write_back(new_params, new_pstate, grads)
         actual._opt_state = new_opt_state
         actual.t += 1
@@ -171,9 +171,13 @@ class _MultiNodeOptimizer:
         double_buffering = self._double_buffering
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
-        def rank_step(params, pstate, opt_state, hyper, stale, args, kwargs):
+        def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
+                      args, kwargs):
+            # decorrelate stochastic masks across ranks (each rank holds a
+            # different batch shard)
+            rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
             loss, new_pstate, obs, grads = loss_and_grad(
-                params, pstate, args, kwargs)
+                params, pstate, rng_local, args, kwargs)
             # the reference's allreduce_grad: mean over ranks, optional
             # dtype compression, optional flat bucket — all in-program
             grads = grad_transform(grads)
@@ -192,7 +196,8 @@ class _MultiNodeOptimizer:
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
-            in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
+            in_specs=(P(), P(), P(), P(), P(), P(), args_specs,
+                      kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
         # donate opt_state only (see core/optimizer.py note: Link arrays

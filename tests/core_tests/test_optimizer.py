@@ -124,3 +124,36 @@ def test_optimizer_serialize_roundtrip(tmp_path):
     opt2.update(m2)
     np.testing.assert_allclose(np.asarray(m2.w.array), np.asarray(m.w.array),
                                rtol=1e-6)
+
+
+def test_dropout_fresh_mask_every_compiled_step():
+    """Per-step traced rng: dropout masks differ across steps with lr=0
+    (params frozen → loss variation can only come from the mask)."""
+    import chainermn_tpu as ct
+    from chainermn_tpu import F, L
+
+    class DropNet(ct.Chain):
+        def __init__(self):
+            super().__init__()
+            with self.init_scope():
+                self.l = L.Linear(16, 4, seed=0)
+
+        def forward(self, x, t):
+            h = F.dropout(x, 0.5)
+            return F.softmax_cross_entropy(self.l(h), t)
+
+    net = DropNet()
+    opt = SGD(lr=0.0).setup(net)
+    opt.seed = 123
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+    x = jnp.asarray(rng.normal(0, 1, (32, 16)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 4, 32).astype(np.int32))
+    losses = [float(opt.update(net, x, t)) for _ in range(4)]
+    assert len(set(losses)) > 1, "dropout mask frozen across steps"
+    # reproducible with the same seed
+    net2 = DropNet()
+    opt2 = SGD(lr=0.0).setup(net2)
+    opt2.seed = 123
+    losses2 = [float(opt2.update(net2, x, t)) for _ in range(4)]
+    np.testing.assert_allclose(losses, losses2, rtol=1e-6)
