@@ -44,49 +44,74 @@ def _scan_lstm(cell, xs, c0=None, h0=None, reverse=False):
 
 
 class Encoder(Chain):
-    def __init__(self, n_vocab, n_units, seed=0):
+    """n-layer LSTM encoder (reference example: 3-layer NStepLSTM).
+
+    PAD positions freeze the recurrent state (length masking), so the
+    final state reflects each sequence's true last token.
+    """
+
+    def __init__(self, n_vocab, n_units, n_layers=1, seed=0):
         super().__init__()
         with self.init_scope():
             self.embed = L.EmbedID(n_vocab, n_units, ignore_label=PAD,
                                    seed=seed)
-            self.lstm = L.StatelessLSTM(n_units, n_units, seed=seed + 1)
+            self.lstm = L.NStepLSTM(n_layers, n_units, n_units,
+                                    seed=seed + 1)
 
     def forward(self, xs):
-        """xs: int [B, T] (PAD-padded) → final (c, h) stacked [2, B, H]."""
+        """xs: int [B, T] (PAD-padded) → state stacked [2, L, B, H]."""
         emb = self.embed(xs)
-        c, h, _ = _scan_lstm(self.lstm, emb)
-        return jnp.stack([c, h])
+        hy, cy, _ = self.lstm(None, None, emb, mask=(xs != PAD))
+        return jnp.stack([cy, hy])
 
 
 class Decoder(Chain):
-    def __init__(self, n_vocab, n_units, seed=10):
+    def __init__(self, n_vocab, n_units, n_layers=1, seed=10):
         super().__init__()
         self.n_units = n_units
         with self.init_scope():
             self.embed = L.EmbedID(n_vocab, n_units, ignore_label=PAD,
                                    seed=seed)
-            self.lstm = L.StatelessLSTM(n_units, n_units, seed=seed + 1)
+            self.lstm = L.NStepLSTM(n_layers, n_units, n_units,
+                                    seed=seed + 1)
             self.out = L.Linear(n_units, n_vocab, seed=seed + 2)
 
     def forward(self, state, ys_in, ys_out):
-        """Teacher-forced loss.  state: [2, B, H] from the encoder."""
-        c0, h0 = state[0], state[1]
+        """Teacher-forced loss.  state: [2, L, B, H] from the encoder."""
+        cx, hx = state[0], state[1]
         emb = self.embed(ys_in)
-        _, _, hs = _scan_lstm(self.lstm, emb, c0, h0)
+        _, _, hs = self.lstm(hx, cx, emb)
         logits = self.out(hs.reshape(-1, self.n_units))
         loss = F.softmax_cross_entropy(logits, ys_out.reshape(-1),
                                        ignore_label=PAD)
         return loss
 
+    def step_tokens(self, c, h, tok):
+        """One greedy-decoding step through all layers: (c, h [L,B,H],
+        tok [B]) → (c, h, next_tok)."""
+        inp = self.embed(tok)
+        new_c, new_h = [], []
+        for layer, cell in enumerate(self.lstm):
+            c_l, h_l = cell(c[layer], h[layer], inp)
+            new_c.append(c_l)
+            new_h.append(h_l)
+            inp = h_l
+        logits = self.out(inp)
+        tok = jnp.argmax(logits, axis=1).astype(jnp.int32)
+        return jnp.stack(new_c), jnp.stack(new_h), tok
+
 
 class Seq2seq(Chain):
     """Single-process encoder-decoder (reference example model shape)."""
 
-    def __init__(self, n_source_vocab, n_target_vocab, n_units, seed=0):
+    def __init__(self, n_source_vocab, n_target_vocab, n_units,
+                 n_layers=1, seed=0):
         super().__init__()
         with self.init_scope():
-            self.encoder = Encoder(n_source_vocab, n_units, seed=seed)
-            self.decoder = Decoder(n_target_vocab, n_units, seed=seed + 100)
+            self.encoder = Encoder(n_source_vocab, n_units,
+                                   n_layers=n_layers, seed=seed)
+            self.decoder = Decoder(n_target_vocab, n_units,
+                                   n_layers=n_layers, seed=seed + 100)
 
     def forward(self, xs, ys_in, ys_out):
         from ..core import reporter
@@ -104,10 +129,7 @@ class Seq2seq(Chain):
 
         def step(carry, _):
             c, h, tok = carry
-            emb = self.decoder.embed(tok)
-            c, h = self.decoder.lstm(c, h, emb)
-            logits = self.decoder.out(h)
-            tok = jnp.argmax(logits, axis=1).astype(jnp.int32)
+            c, h, tok = self.decoder.step_tokens(c, h, tok)
             return (c, h, tok), tok
 
         _, toks = lax.scan(step, (c, h, tok0), None, length=max_length)
@@ -146,10 +168,11 @@ class ModelParallelSeq2seq(MultiNodeChainList):
     """
 
     def __init__(self, comm, n_source_vocab, n_target_vocab, n_units,
-                 rank_encoder=0, rank_decoder=1, seed=0):
+                 rank_encoder=0, rank_decoder=1, n_layers=1, seed=0):
         super().__init__(comm)
-        enc = Encoder(n_source_vocab, n_units, seed=seed)
-        dec = Decoder(n_target_vocab, n_units, seed=seed + 100)
+        enc = Encoder(n_source_vocab, n_units, n_layers=n_layers, seed=seed)
+        dec = Decoder(n_target_vocab, n_units, n_layers=n_layers,
+                      seed=seed + 100)
         self._enc_component = _EncoderComponent(enc)
         self._dec_component = _DecoderWrapper(dec)
         self.add_link(self._enc_component, rank_in=None,

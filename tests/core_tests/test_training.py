@@ -188,3 +188,17 @@ def test_stateful_lstm_no_tracer_leak_through_compiled_paths():
     # volatile state restored — no tracer leaked into the link
     assert not isinstance(net.lstm.h, jax.core.Tracer)
     opt.update(net, jnp.asarray(x), jnp.asarray(t))  # second step fine
+
+
+def test_profile_extension_captures_trace(tmp_path, mnist_small):
+    train, _ = mnist_small
+    from chainermn_tpu.utils.profiling import Profile
+    model = Classifier(MLP())
+    optimizer = SGD(lr=0.05).setup(model)
+    it = SerialIterator(train, 128, seed=5)
+    updater = StandardUpdater(it, optimizer)
+    trainer = Trainer(updater, (6, "iteration"), out=str(tmp_path / "p"))
+    trainer.extend(Profile(start=2, n_steps=2,
+                           log_dir=str(tmp_path / "trace")))
+    trainer.run()
+    assert os.path.isdir(str(tmp_path / "trace"))
