@@ -22,6 +22,7 @@ def main():
     parser.add_argument("--batchsize", "-b", type=int, default=16)
     parser.add_argument("--epoch", "-e", type=int, default=5)
     parser.add_argument("--unit", "-u", type=int, default=64)
+    parser.add_argument("--layers", "-l", type=int, default=2)
     parser.add_argument("--communicator", "-c", default="pure_nccl")
     parser.add_argument("--model-parallel", action="store_true")
     parser.add_argument("--no-double-buffering", action="store_true")
@@ -42,13 +43,14 @@ def main():
 
     if args.model_parallel:
         comm = ct.create_communicator(args.communicator, axis_name="stage")
-        model = ModelParallelSeq2seq(comm, 40, 40, args.unit)
+        model = ModelParallelSeq2seq(comm, 40, 40, args.unit,
+                                     n_layers=args.layers)
         optimizer = Adam().setup(model)  # stages share the mesh axis
         batch = args.batchsize
         train = dataset
     else:
         comm = ct.create_communicator(args.communicator)
-        model = Seq2seq(40, 40, args.unit)
+        model = Seq2seq(40, 40, args.unit, n_layers=args.layers)
         comm.bcast_data(model)
         optimizer = ct.create_multi_node_optimizer(
             Adam(), comm,
