@@ -40,28 +40,50 @@ def test_flash_irregular_shapes_fall_back():
                                rtol=2e-4, atol=2e-5)
 
 
-def test_flash_custom_vjp_gradients_match_xla():
+def test_flash_backward_kernels_match_xla_grads():
+    """Pallas flash backward (dq/dk/dv kernels) vs XLA autodiff, causal
+    and non-causal, all three gradients."""
     import jax
     from chainermn_tpu.ops.flash_attention import _flash_diff
-    q, k, v = _data(T=64, seed=3)
+    for causal in (False, True):
+        q, k, v = _data(T=128, D=32, seed=3 + causal)
 
-    # interpret-mode flash forward inside the custom-vjp wrapper
-    # (the ops package re-exports the function under the module's name,
-    # so resolve the module via importlib)
-    import importlib
-    fa = importlib.import_module("chainermn_tpu.ops.flash_attention")
-    orig = fa.flash_attention
-    fa.flash_attention = lambda *a, **kw: orig(*a, interpret=True, **kw)
-    try:
-        def loss_flash(q):
-            return jnp.sum(_flash_diff(q, k, v, True, None) ** 2)
+        def loss_flash(q, k, v):
+            return jnp.sum(_flash_diff(q, k, v, causal, None, True) ** 2)
 
-        def loss_ref(q):
-            return jnp.sum(xla_attention(q, k, v, causal=True) ** 2)
+        def loss_ref(q, k, v):
+            return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
 
-        g_flash = jax.grad(loss_flash)(q)
-        g_ref = jax.grad(loss_ref)(q)
-        np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_ref),
-                                   rtol=2e-4, atol=2e-5)
-    finally:
-        fa.flash_attention = orig
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+                err_msg=f"d{name} causal={causal}")
+
+
+def test_flash_fwd_lse_matches_softmax_normalizer():
+    from chainermn_tpu.ops.flash_attention import flash_attention_fwd
+    q, k, v = _data(T=64, D=16, seed=5)
+    out, lse = flash_attention_fwd(q, k, v, causal=False, interpret=True)
+    ref = xla_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q), np.asarray(k)) \
+        / np.sqrt(q.shape[-1])
+    lse_ref = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) \
+        + s.max(-1)
+    np.testing.assert_allclose(np.asarray(lse), lse_ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_flash_vjp_irregular_shape_fallback():
+    import jax
+    from chainermn_tpu.ops.flash_attention import _flash_diff
+    q, k, v = _data(T=100, seed=6)  # not block-divisible → XLA both ways
+    g = jax.grad(lambda q: jnp.sum(_flash_diff(q, k, v, True, None,
+                                               True) ** 2))(q)
+    gr = jax.grad(lambda q: jnp.sum(
+        xla_attention(q, k, v, causal=True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=2e-4, atol=2e-5)
