@@ -275,7 +275,11 @@ class Evaluator(Extension):
         """One jitted validation step: forward + captured observations.
 
         The reference runs evaluation eagerly per batch; compiling keeps
-        validation on-device at train-step speeds.  Cached per input
+        validation on-device at train-step speeds.  When a multi-node
+        communicator is attached (``create_multi_node_evaluator``), the
+        step is shard_mapped over its axis with the batch split across
+        ranks and per-rank observations pmean'd — evaluation throughput
+        scales with the mesh like training does.  Cached per input
         shapes; the trace-time reporter is the prefixed one installed by
         ``__call__``, so observation keys match the eager path.
         """
@@ -288,16 +292,35 @@ class Evaluator(Extension):
                     for a in jax.tree.leaves(args))
         fn = self._eval_cache.get(key)
         if fn is None:
-            def fn(params, pstate, args):
+            comm = getattr(self, "_mn_communicator", None)
+            axis = getattr(comm, "axis_name", None)
+            shardable = axis is not None and all(
+                hasattr(a, "shape") and a.ndim > 0
+                and a.shape[0] % comm.size == 0
+                for a in jax.tree.leaves(args))
+
+            def body(params, pstate, args):
                 with bind_state(target, {"params": params,
                                          "state": pstate}):
                     obs = {}
                     with reporter_module.get_current_reporter().scope(obs):
                         with using_config("train", False):
                             target(*args)
+                if shardable:
+                    from jax import lax
+                    obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
                 return obs
 
-            fn = jax.jit(fn)
+            if shardable:
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                args_specs = jax.tree.map(lambda _: P(axis), args)
+                fn = jax.jit(shard_map(
+                    body, mesh=comm.mesh,
+                    in_specs=(P(), P(), args_specs), out_specs=P(),
+                    check_vma=False))
+            else:
+                fn = jax.jit(body)
             self._eval_cache[key] = fn
         return fn(state["params"], state["state"], args)
 

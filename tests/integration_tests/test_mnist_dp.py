@@ -208,3 +208,21 @@ def test_standalone_update_without_trainer_does_not_crash():
     rep = reporter_module.Reporter()
     with pytest.raises(KeyError):
         rep.report({"x": 1.0}, observer=model)
+
+
+def test_multi_node_evaluator_sharded_eval_matches_plain():
+    """The sharded compiled eval path produces the same metrics as the
+    single-device evaluator."""
+    from chainermn_tpu.training.extensions import Evaluator
+    comm = ct.create_communicator("jax_ici")
+    model = Classifier(MLP())
+    test, _ = get_mnist(n_train=128, n_test=8)
+    it1 = SerialIterator(test, 8 * comm.size, repeat=False, shuffle=False)
+    it2 = SerialIterator(test, 8 * comm.size, repeat=False, shuffle=False)
+    plain = Evaluator(it1, model)
+    sharded = ct.create_multi_node_evaluator(Evaluator(it2, model), comm)
+    r_plain = plain()
+    r_sharded = sharded()
+    for k, v in r_plain.items():
+        np.testing.assert_allclose(r_sharded[k], float(np.asarray(v)),
+                                   rtol=1e-4)
