@@ -28,7 +28,7 @@ from .triggers import get_trigger
 
 __all__ = ["LogReport", "PrintReport", "ProgressBar", "snapshot",
            "snapshot_object", "Evaluator", "ExponentialShift", "LinearShift",
-           "observe_lr", "FailOnNonNumber"]
+           "observe_lr", "FailOnNonNumber", "ParameterStatistics"]
 
 
 class LogReport(Extension):
@@ -411,3 +411,65 @@ class FailOnNonNumber(Extension):
                 if p.array is not None and not bool(np.all(np.isfinite(np.asarray(p.array)))):
                     raise RuntimeError(
                         "Kill the process since parameters contain NaN/Inf")
+
+
+class ParameterStatistics(Extension):
+    """Report per-link parameter/gradient statistics (reference:
+    ``chainer.training.extensions.ParameterStatistics``).
+
+    One compiled reduction over the whole param tree per trigger (not a
+    Python loop per parameter): statistics are computed in a single jitted
+    call and reported under ``<prefix>/<path>/<data|grad>/<stat>``.
+    """
+
+    trigger = (1, "epoch")
+    priority = PRIORITY_WRITER
+    default_statistics = {
+        "mean": lambda x: x.mean(),
+        "std": lambda x: x.std(),
+        "min": lambda x: x.min(),
+        "max": lambda x: x.max(),
+    }
+
+    def __init__(self, links, statistics=None, report_params=True,
+                 report_grads=True, prefix=None):
+        from ..core.link import Link
+        if isinstance(links, Link):
+            links = [links]
+        self._links = links
+        self._statistics = statistics or dict(self.default_statistics)
+        self._report_params = report_params
+        self._report_grads = report_grads
+        self._prefix = prefix
+        self._compiled = None
+
+    def __call__(self, trainer=None):
+        import jax
+        params = {}
+        grads = {}
+        for i, link in enumerate(self._links):
+            base = self._prefix + "/" if self._prefix else ""
+            name = getattr(link, "name", None) or str(i)
+            for path, p in link.namedparams():
+                if p.array is not None and self._report_params:
+                    params[f"{base}{name}{path}"] = p.array
+                if p.grad is not None and self._report_grads:
+                    grads[f"{base}{name}{path}"] = p.grad
+        if self._compiled is None:
+            stats = self._statistics
+
+            @jax.jit
+            def compute(params, grads):
+                out = {}
+                for key, arr in params.items():
+                    for sname, fn in stats.items():
+                        out[f"{key}/data/{sname}"] = fn(arr)
+                for key, arr in grads.items():
+                    for sname, fn in stats.items():
+                        out[f"{key}/grad/{sname}"] = fn(arr)
+                return out
+
+            self._compiled = compute
+        observation = self._compiled(params, grads)
+        reporter_module.report(observation)
+        return observation

@@ -202,3 +202,36 @@ def test_profile_extension_captures_trace(tmp_path, mnist_small):
                            log_dir=str(tmp_path / "trace")))
     trainer.run()
     assert os.path.isdir(str(tmp_path / "trace"))
+
+
+def test_parameter_statistics_extension():
+    from chainermn_tpu.training.extensions import ParameterStatistics
+    model = MLP()
+    model(np.ones((1, 784), np.float32))
+    for p in model.params():
+        p.grad = jnp.ones_like(p.array) * 2.0
+    ext = ParameterStatistics(model, prefix=None)
+    obs = ext(None)
+    keys = list(obs)
+    assert any(k.endswith("/l1/W/data/mean") for k in keys)
+    grad_means = [float(np.asarray(v)) for k, v in obs.items()
+                  if k.endswith("/grad/mean")]
+    np.testing.assert_allclose(grad_means, 2.0, rtol=1e-6)
+
+
+def test_groupnorm_and_bn_finetune():
+    from chainermn_tpu import L
+    gn = L.GroupNormalization(2, 8)
+    x = jnp.asarray(np.random.RandomState(0).normal(2, 3, (4, 8))
+                    .astype(np.float32))
+    y = gn(x)
+    assert y.shape == x.shape
+    # per-group normalization: near-zero mean per group
+    groups = np.asarray(y).reshape(4, 2, 4)
+    np.testing.assert_allclose(groups.mean(axis=2), 0.0, atol=1e-4)
+
+    bn = L.BatchNormalization(8)
+    bn(x, finetune=True)
+    assert bn.N == 1
+    bn(x, finetune=True)
+    assert bn.N == 2
