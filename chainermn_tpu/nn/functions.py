@@ -547,3 +547,192 @@ def log_softmax_cross_entropy_components(x, t, ignore_label=-1):
     """(per-example nll, valid mask) — building block for custom losses."""
     nll = softmax_cross_entropy(x, t, ignore_label=ignore_label, reduce="no")
     return nll, t != ignore_label
+
+
+# -- elementwise math aliases (reference F.* long tail) ---------------------
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def arcsin(x):
+    return jnp.arcsin(x)
+
+
+def arccos(x):
+    return jnp.arccos(x)
+
+
+def arctan(x):
+    return jnp.arctan(x)
+
+
+def arctan2(x1, x2):
+    return jnp.arctan2(x1, x2)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+def prod(x, axis=None, keepdims=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdims)
+
+
+def logsumexp(x, axis=None):
+    return jax.scipy.special.logsumexp(x, axis=axis)
+
+
+def fmod(x, divisor):
+    return jnp.fmod(x, divisor)
+
+
+def fix(x):
+    return jnp.fix(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def hard_sigmoid(x):
+    return jnp.clip(x * 0.2 + 0.5, 0.0, 1.0)
+
+
+def softmin(x, axis=1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+def crelu(x, axis=1):
+    return jnp.concatenate([jnp.maximum(x, 0), jnp.maximum(-x, 0)],
+                           axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+def flipud(x):
+    return jnp.flipud(x)
+
+
+def rollaxis(x, axis, start=0):
+    return jnp.rollaxis(x, axis, start)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def repeat(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset, axis1, axis2)
+
+
+def cast(x, typ):
+    return x.astype(typ)
+
+
+def identity(*xs):
+    return xs[0] if len(xs) == 1 else xs
+
+
+def scale(x, y, axis=1):
+    shape = [1] * x.ndim
+    for i, s in enumerate(jnp.shape(y)):
+        shape[axis + i] = s
+    return x * jnp.reshape(y, shape)
+
+
+def bias(x, y, axis=1):
+    shape = [1] * x.ndim
+    for i, s in enumerate(jnp.shape(y)):
+        shape[axis + i] = s
+    return x + jnp.reshape(y, shape)
+
+
+def matmul_nn(a, b):
+    return a @ b
+
+
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def einsum(subscripts, *operands):
+    return jnp.einsum(subscripts, *operands)

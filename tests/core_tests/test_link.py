@@ -194,3 +194,21 @@ def test_additional_links_and_functions():
                                rtol=1e-3)
     img = jnp.ones((2, 8, 4, 4))
     assert F.local_response_normalization(img).shape == img.shape
+
+
+def test_function_long_tail_aliases():
+    x = jnp.asarray(np.random.RandomState(0).normal(0, 1, (4, 6))
+                    .astype(np.float32))
+    assert F.erf(x).shape == x.shape
+    assert F.relu6(x).max() <= 6
+    assert F.crelu(x).shape == (4, 12)
+    np.testing.assert_allclose(np.asarray(F.square(x)),
+                               np.asarray(x) ** 2, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(F.logsumexp(x, axis=1)),
+        np.log(np.exp(np.asarray(x)).sum(axis=1)), rtol=1e-5)
+    y = F.scale(jnp.ones((2, 3, 4)), jnp.asarray([1.0, 2.0, 3.0]), axis=1)
+    np.testing.assert_allclose(np.asarray(y[:, 1]), 2.0)
+    b = F.bias(jnp.zeros((2, 3)), jnp.asarray([1.0, 2.0, 3.0]), axis=1)
+    np.testing.assert_allclose(np.asarray(b[0]), [1, 2, 3])
+    assert F.einsum("ij,jk->ik", x, x.T).shape == (4, 4)
