@@ -56,7 +56,7 @@ def merge_microbatches(x):
     return x.reshape((-1,) + x.shape[2:])
 
 
-def gpipe_apply(comm, stage_fn, stage_params, x_microbatches):
+def gpipe_apply(comm, stage_fn, stage_params, x_microbatches, remat=False):
     """Run microbatches through the pipeline; call inside ``shard_map``
     over ``comm``'s axis (or via ``comm.run_spmd``).
 
@@ -69,8 +69,13 @@ def gpipe_apply(comm, stage_fn, stage_params, x_microbatches):
     (valid on every rank — they are rotated back around the ring).
 
     Schedule: M + S - 1 ticks; at tick t, stage s processes microbatch
-    t - s (when 0 ≤ t - s < M).
+    t - s (when 0 ≤ t - s < M).  ``remat=True`` rematerializes each
+    stage invocation in the backward pass — per-tick activations are
+    recomputed instead of saved, cutting pipeline activation memory from
+    O(M+S) to O(1) stage outputs at ~33% extra stage FLOPs.
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     axis = comm.axis_name
     S = comm.size
     stage = lax.axis_index(axis)

@@ -98,3 +98,28 @@ def test_split_merge_roundtrip():
     assert m.shape == (3, 4, 2)
     np.testing.assert_array_equal(np.asarray(merge_microbatches(m)),
                                   np.asarray(x))
+
+
+def test_gpipe_remat_matches_no_remat():
+    W, b = _params(5)
+    x = np.random.RandomState(6).normal(0, 1, (8, 8)).astype(np.float32)
+    xm = split_microbatches(jnp.asarray(x), 2)
+
+    grads = {}
+    for remat in (False, True):
+        def body(Wl, bl, xm):
+            def loss(args):
+                Wl, bl = args
+                out = gpipe_apply(COMM, _stage_fn, (Wl[0], bl[0]), xm,
+                                  remat=remat)
+                return jnp.sum(out ** 2)
+            return jax.grad(loss)((Wl, bl))
+
+        grads[remat] = COMM.run_spmd(
+            body, jnp.asarray(W), jnp.asarray(b), xm,
+            in_specs=(P("pipe"), P("pipe"), P()),
+            out_specs=(P("pipe"), P("pipe")))
+    for a, b2 in zip(jax.tree.leaves(grads[False]),
+                     jax.tree.leaves(grads[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                   rtol=1e-5, atol=1e-6)
