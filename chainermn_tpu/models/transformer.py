@@ -94,9 +94,11 @@ class TransformerLM(Chain):
     automatically when the axis is bound)."""
 
     def __init__(self, n_vocab, d_model=128, n_heads=4, n_layers=2,
-                 max_len=2048, seed=0, sp_comm=None, sp_mode="ring"):
+                 max_len=2048, seed=0, sp_comm=None, sp_mode="ring",
+                 remat=False):
         super().__init__()
         self.sp_comm = sp_comm
+        self.remat = remat
         with self.init_scope():
             self.embed = L.EmbedID(n_vocab, d_model, seed=seed)
             self.pos_embed = L.EmbedID(max_len, d_model, seed=seed + 1)
@@ -116,7 +118,14 @@ class TransformerLM(Chain):
         pos = offset + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
         for block in self.blocks:
-            h = block(h)
+            if self.remat:
+                # per-block rematerialization: backward recomputes the
+                # block, trading FLOPs for activation memory — the lever
+                # for long contexts (blocks hold no persistent state, so
+                # closing over bound params is safe)
+                h = jax.checkpoint(lambda hh, blk=block: blk(hh))(h)
+            else:
+                h = block(h)
         return self.ln_f(h)
 
     def logits(self, x):

@@ -158,3 +158,15 @@ def test_moe_transformer_trains():
     for _ in range(10):
         l = float(opt.update(model, x, t))
     assert l < l0
+
+
+def test_transformer_remat_matches():
+    from chainermn_tpu.core.optimizer import SGD
+    x, t = _lm_data(B=2, T=16, seed=10)
+    losses = {}
+    for remat in (False, True):
+        m = TransformerLM(50, d_model=32, n_heads=2, n_layers=2, seed=13,
+                          remat=remat)
+        opt = SGD(lr=0.1).setup(m)
+        losses[remat] = [float(opt.update(m, x, t)) for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
