@@ -73,7 +73,8 @@ class _MultiNodeOptimizer:
         super().__setattr__("communicator", communicator)
         super().__setattr__("actual_optimizer", actual_optimizer)
         super().__setattr__("zero_fill", zero_fill)
-        super().__setattr__("_mn_step_cache", {})
+        from .core.optimizer import _LRUCache
+        super().__setattr__("_mn_step_cache", _LRUCache())
         super().__setattr__("_stale_grads", None)  # double-buffer slot
 
     _double_buffering = False

@@ -287,7 +287,8 @@ class Evaluator(Extension):
         import numpy as np
         from ..core.link import bind_state
         if not hasattr(self, "_eval_cache"):
-            self._eval_cache = {}
+            from ..core.optimizer import _LRUCache
+            self._eval_cache = _LRUCache()
         key = tuple((np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
                     for a in jax.tree.leaves(args))
         fn = self._eval_cache.get(key)
