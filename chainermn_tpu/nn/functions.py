@@ -7,8 +7,8 @@ fusible by XLA, layout NCHW to match the reference's convention (XLA
 re-layouts internally for the MXU; the API contract is what matters here).
 Stochastic functions (``dropout``) take an explicit ``key`` — the idiomatic
 JAX replacement for the reference's hidden global RNG; if omitted, a
-trace-time constant key is drawn (deterministic across steps — fine for
-smoke tests, pass real keys for training).
+fresh per-step subkey comes from the compiled train step's key scope
+(``core.rng``), falling back to a host-drawn key in eager use.
 """
 
 from __future__ import annotations
