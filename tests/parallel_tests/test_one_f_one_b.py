@@ -1,0 +1,95 @@
+"""1F1B schedule: gradients and loss equal the sequential stack."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu as ct
+from chainermn_tpu.parallel import one_f_one_b, split_microbatches
+
+COMM = None
+
+
+def setup_module(module):
+    global COMM
+    COMM = ct.create_communicator("jax_ici", axis_name="fb")
+
+
+def _stage_fn(params, h):
+    W, b = params
+    return jnp.tanh(h @ W + b)
+
+
+def _loss_fn(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _params(seed):
+    rng = np.random.RandomState(seed)
+    S = COMM.size
+    W = rng.normal(0, 0.5, (S, 8, 8)).astype(np.float32)
+    b = rng.normal(0, 0.1, (S, 8)).astype(np.float32)
+    return jnp.asarray(W), jnp.asarray(b)
+
+
+def test_1f1b_matches_sequential_gradients():
+    W, b = _params(0)
+    rng = np.random.RandomState(1)
+    M = 6
+    x = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    xm = split_microbatches(x, M)
+    ym = split_microbatches(y, M)
+
+    def body(Wl, bl, xm, ym):
+        loss, (gW, gb) = one_f_one_b(COMM, _stage_fn, _loss_fn,
+                                     (Wl[0], bl[0]), xm, ym)
+        return loss.reshape(1), gW[None], gb[None]
+
+    loss, gW, gb = jax.jit(jax.shard_map(
+        body, mesh=COMM.mesh,
+        in_specs=(P("fb"), P("fb"), P(), P()),
+        out_specs=(P("fb"), P("fb"), P("fb")),
+        check_vma=False))(W, b, xm, ym)
+
+    # sequential reference: mean over microbatches of per-microbatch loss
+    def ref_loss(params):
+        W, b = params
+        total = 0.0
+        for i in range(M):
+            h = xm[i]
+            for s in range(COMM.size):
+                h = _stage_fn((W[s], b[s]), h)
+            total = total + _loss_fn(h, ym[i])
+        return total / M
+
+    l_ref, (gW_ref, gb_ref) = jax.value_and_grad(ref_loss)((W, b))
+    np.testing.assert_allclose(float(np.asarray(loss)[0]), float(l_ref),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(gW_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_1f1b_single_microbatch():
+    W, b = _params(2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(0, 1, (1, 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (1, 4, 8)).astype(np.float32))
+
+    def body(Wl, bl, xm, ym):
+        loss, _ = one_f_one_b(COMM, _stage_fn, _loss_fn,
+                              (Wl[0], bl[0]), xm, ym)
+        return loss.reshape(1)
+
+    loss = jax.jit(jax.shard_map(
+        body, mesh=COMM.mesh,
+        in_specs=(P("fb"), P("fb"), P(), P()),
+        out_specs=P("fb"), check_vma=False))(W, b, x, y)
+    h = x[0]
+    for s in range(COMM.size):
+        h = _stage_fn((W[s], b[s]), h)
+    np.testing.assert_allclose(float(np.asarray(loss)[0]),
+                               float(_loss_fn(h, y[0])), rtol=1e-5)
