@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["one_f_one_b"]
+__all__ = ["one_f_one_b", "make_pipeline_train_step"]
 
 
 def one_f_one_b(comm, stage_fn, loss_fn, stage_params, x_microbatches,
@@ -113,3 +113,39 @@ def one_f_one_b(comm, stage_fn, loss_fn, stage_params, x_microbatches,
     loss = lax.psum(jnp.where(stage == S - 1, loss_sum, 0.0), axis) / M
     grads = jax.tree.map(lambda g: g / M, grads)
     return loss, grads
+
+
+def make_pipeline_train_step(comm, stage_fn, loss_fn, tx, n_microbatches):
+    """Build a jitted 1F1B training step integrated with an optax
+    transform: ``step(stage_params, opt_state, x, y) -> (params,
+    opt_state, loss)``.
+
+    ``stage_params`` is the stacked [S, ...] tree sharded ``P(axis)`` on
+    the leading dim; batches are replicated and split into microbatches
+    internally.  The whole schedule + update compiles to one program —
+    the pipeline counterpart of ``create_multi_node_optimizer``'s DP step.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from .pipeline import split_microbatches
+    axis = comm.axis_name
+
+    def rank_step(params_stacked, opt_state, x, y):
+        params = jax.tree.map(lambda p: p[0], params_stacked)
+        xm = split_microbatches(x, n_microbatches)
+        ym = split_microbatches(y, n_microbatches)
+        loss, grads = one_f_one_b(comm, stage_fn, loss_fn, params, xm, ym)
+        updates, new_opt_state = tx.update(
+            jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params),
+            opt_state, params)
+        new_params = jax.tree.map(lambda p, u: p + u, params, updates)
+        return (jax.tree.map(lambda p: p[None], new_params),
+                new_opt_state, loss)
+
+    p_stage = P(axis)
+    mapped = shard_map(
+        rank_step, mesh=comm.mesh,
+        in_specs=(p_stage, P(), P(), P()),
+        out_specs=(p_stage, P(), P()),
+        check_vma=False)
+    return jax.jit(mapped)

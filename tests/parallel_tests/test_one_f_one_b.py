@@ -93,3 +93,23 @@ def test_1f1b_single_microbatch():
         h = _stage_fn((W[s], b[s]), h)
     np.testing.assert_allclose(float(np.asarray(loss)[0]),
                                float(_loss_fn(h, y[0])), rtol=1e-5)
+
+
+def test_pipeline_train_step_converges():
+    import optax
+    from chainermn_tpu.parallel import make_pipeline_train_step
+    W, b = _params(5)
+    params = (W, b)
+    tx = optax.sgd(0.2)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.normal(0, 1, (16, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 0.3, (16, 8)).astype(np.float32))
+    step = make_pipeline_train_step(COMM, _stage_fn, _loss_fn, tx,
+                                    n_microbatches=4)
+    per_stage = jax.tree.map(lambda p: p[0], params)
+    opt_state = tx.init(per_stage)
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(np.asarray(loss)))
+    assert losses[-1] < losses[0] * 0.7
