@@ -13,6 +13,8 @@ fresh per-step subkey comes from the compiled train step's key scope
 
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 
 import jax
@@ -267,8 +269,9 @@ def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
     if cover_all:
         # reference semantics: pad enough that every element is covered
         h, w = x.shape[2], x.shape[3]
-        eh = max(0, (-(h + 2 * ph - kh) % sy)) if sy > 1 else 0
-        ew = max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
+        # NB: this module shadows builtin max with the F.max alias
+        eh = builtins.max(0, (-(h + 2 * ph - kh) % sy)) if sy > 1 else 0
+        ew = builtins.max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
     else:
         eh = ew = 0
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \

@@ -14,7 +14,8 @@ import chainermn_tpu as ct
 from chainermn_tpu.core.optimizer import MomentumSGD
 from chainermn_tpu.dataset import SerialIterator, MultithreadIterator
 from chainermn_tpu.dataset.datasets import get_synthetic_imagenet
-from chainermn_tpu.models import Classifier, ResNet50
+from chainermn_tpu.models import (AlexNet, Classifier, GoogLeNet, NIN,
+                                  ResNet50, VGG16)
 from chainermn_tpu.training import StandardUpdater, Trainer, extensions
 
 
@@ -22,6 +23,9 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--batchsize", "-b", type=int, default=32,
                         help="per-chip batch size")
+    parser.add_argument("--arch", "-a", default="resnet50",
+                        choices=["resnet50", "alex", "nin", "vgg16",
+                                 "googlenet"])
     parser.add_argument("--epoch", "-e", type=int, default=1)
     parser.add_argument("--iterations", type=int, default=0,
                         help="stop after N iterations (overrides --epoch)")
@@ -43,7 +47,10 @@ def main():
 
     comm = ct.create_communicator(args.communicator,
                                   allreduce_grad_dtype=args.grad_dtype)
-    model = Classifier(ResNet50(compute_dtype=jnp.bfloat16))
+    archs = {"resnet50": lambda: ResNet50(compute_dtype=jnp.bfloat16),
+             "alex": AlexNet, "nin": NIN, "vgg16": VGG16,
+             "googlenet": GoogLeNet}
+    model = Classifier(archs[args.arch]())
     comm.bcast_data(model)
     optimizer = ct.create_multi_node_optimizer(
         MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)

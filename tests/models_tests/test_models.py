@@ -146,3 +146,27 @@ def test_resnet_remat_matches_no_remat():
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
     np.testing.assert_allclose(stats[True], stats[False], rtol=1e-5)
     assert np.abs(stats[True]).sum() > 0  # BN stats actually updated
+
+
+def test_classic_convnets_forward_and_train():
+    from chainermn_tpu.models import AlexNet, NIN, VGG16, GoogLeNet
+    rng = np.random.RandomState(0)
+    # small spatial input keeps CPU time sane; archs handle any size ≥ their
+    # stride pyramid via lazy/GAP heads (VGG/Alex use lazy fc6)
+    for cls, size in ((NIN, 67), (GoogLeNet, 64)):
+        m = cls(n_classes=7, seed=0)
+        x = jnp.asarray(rng.normal(0, 1, (2, 3, size, size))
+                        .astype(np.float32))
+        y = m(x)
+        assert y.shape == (2, 7), cls.__name__
+        assert np.isfinite(np.asarray(y)).all()
+    # AlexNet/VGG16 train one step on tiny inputs
+    from chainermn_tpu.core.optimizer import SGD
+    for cls, size in ((AlexNet, 67), (VGG16, 64)):
+        m = Classifier(cls(n_classes=5, seed=0))
+        opt = SGD(lr=0.01).setup(m)
+        x = jnp.asarray(rng.normal(0, 1, (2, 3, size, size))
+                        .astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 5, 2).astype(np.int32))
+        loss = opt.update(m, x, t)
+        assert np.isfinite(float(loss)), cls.__name__
