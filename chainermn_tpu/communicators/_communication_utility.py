@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
-
 import jax
 
 __all__ = ["init_ranks", "initialize_distributed", "device_topology"]

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import contextlib
 import threading
-import typing
 from collections import OrderedDict
 
 import jax

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from .link import (Link, apply_state, bind_state, extract_state,
+from .link import (Link, bind_state, extract_state,
                    load_param_tree, _persistent_slots)
 from .config import config
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import numpy as np
 
 from .datasets import TupleDataset
-from .iterators import Iterator, SerialIterator
+from .iterators import Iterator
 
 __all__ = ["NativeBatchIterator"]
 

@@ -24,7 +24,7 @@ import tempfile
 import time
 
 from ..serializers.npz import load_npz, save_npz
-from ..training.trainer import Extension, PRIORITY_READER
+from ..training.trainer import Extension
 
 __all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer"]
 

@@ -13,8 +13,6 @@ from __future__ import annotations
 
 import os
 
-import jax
-
 from ..core.link import extract_state, load_param_tree, _persistent_slots
 
 __all__ = ["OrbaxCheckpointer"]

@@ -29,7 +29,6 @@ one traced program, so ``send``/``recv`` take the static pair (``dst`` and
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

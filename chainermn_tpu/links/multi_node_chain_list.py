@@ -28,10 +28,7 @@ TPU-performance path with GPipe-style microbatching is
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
-import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core.link import Chain

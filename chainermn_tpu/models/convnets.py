@@ -8,10 +8,6 @@ AlexNet, NIN, VGG16, and GoogLeNet (inception-v1) — as TPU-first NCHW
 
 from __future__ import annotations
 
-import numpy as np
-
-import jax.numpy as jnp
-
 from ..core.link import Chain
 from ..nn import functions as F
 from ..nn import links as L

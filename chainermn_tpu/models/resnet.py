@@ -16,8 +16,6 @@ Freshly designed for TPU rather than transcribed:
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax.numpy as jnp
 
 from ..core.link import Chain, ChainList

@@ -13,8 +13,6 @@ single-chip and sequence-parallel execution bit-compatibly.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 

@@ -14,8 +14,6 @@ needs; ``chainermn_tpu.models.seq2seq`` shows the padding convention.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax.numpy as jnp
 from jax import lax
 

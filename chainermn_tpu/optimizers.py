@@ -32,16 +32,12 @@ longer serializes on the current step's collective).
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .core import reporter as reporter_module
-from .core.config import config
 from .core.link import bind_state, extract_state
 
 __all__ = ["create_multi_node_optimizer", "_MultiNodeOptimizer",

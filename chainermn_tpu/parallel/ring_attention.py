@@ -22,9 +22,6 @@ blocks attend fully.
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -22,8 +22,8 @@ import numpy as np
 from ..core import reporter as reporter_module
 from ..core.config import using_config
 from ..dataset.convert import concat_examples
-from ..serializers.npz import save_npz, load_npz
-from .trainer import Extension, PRIORITY_READER, PRIORITY_WRITER, PRIORITY_EDITOR
+from ..serializers.npz import save_npz
+from .trainer import Extension, PRIORITY_WRITER
 from .triggers import get_trigger
 
 __all__ = ["LogReport", "PrintReport", "ProgressBar", "snapshot",
