@@ -1,0 +1,45 @@
+"""pack/unpack utilities (N2 parity surface)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.communicators._memory_utility import (
+    pack_params, tree_pack, tree_unpack, unpack_params)
+from chainermn_tpu.core.link import Parameter
+
+
+def test_tree_pack_roundtrip():
+    tree = {"a": jnp.arange(6.0).reshape(2, 3),
+            "b": jnp.ones((4,), jnp.float32)}
+    flat, spec = tree_pack(tree)
+    assert flat.shape == (10,)
+    back = tree_unpack(flat, spec)
+    np.testing.assert_array_equal(np.asarray(back["a"]),
+                                  np.asarray(tree["a"]))
+    np.testing.assert_array_equal(np.asarray(back["b"]),
+                                  np.asarray(tree["b"]))
+
+
+def test_tree_pack_dtype_cast():
+    tree = [jnp.ones((3,), jnp.float32)]
+    flat, spec = tree_pack(tree, dtype=jnp.bfloat16)
+    assert flat.dtype == jnp.bfloat16
+    back = tree_unpack(flat, spec)
+    assert back[0].dtype == jnp.float32  # restored per-leaf dtype
+
+
+def test_pack_unpack_params_grads():
+    ps = [Parameter(jnp.zeros((2, 2))), Parameter(jnp.zeros((3,)))]
+    ps[0].grad = jnp.full((2, 2), 2.0)
+    ps[1].grad = jnp.full((3,), 3.0)
+    flat, spec = pack_params(ps, "grad")
+    assert flat.shape == (7,)
+    unpack_params(ps, flat * 2, spec, "grad")
+    np.testing.assert_allclose(np.asarray(ps[0].grad), 4.0)
+    np.testing.assert_allclose(np.asarray(ps[1].grad), 6.0)
+
+
+def test_orthogonal_initializer():
+    from chainermn_tpu.nn.initializers import Orthogonal
+    W = Orthogonal()((6, 6), np.float32, np.random.RandomState(0))
+    np.testing.assert_allclose(W @ W.T, np.eye(6), atol=1e-5)
