@@ -139,3 +139,100 @@ class TransformerLM(Chain):
             ignore_label=-1)
         reporter.report({"loss": loss}, self)
         return loss
+
+
+# -- incremental decoding (KV cache) ----------------------------------------
+
+def _attend_cached(q, k_cache, v_cache, pos, scale):
+    """q: [B,H,1,D]; caches [B,H,Tmax,D]; attend over positions ≤ pos."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    Tmax = k_cache.shape[2]
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, Tmax), 3)
+    s = jnp.where(kpos <= pos, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v_cache.astype(jnp.float32))
+
+
+class _GenerationMixin:
+    """Greedy / temperature sampling with per-layer KV caches."""
+
+    def init_cache(self, batch, max_len):
+        H = self.blocks[0].attn.n_heads
+        D = self.blocks[0].attn.d_head
+        n = len(self.blocks)
+        shape = (n, 2, batch, H, max_len, D)
+        return jnp.zeros(shape, jnp.float32)
+
+    def _step_logits(self, tok, pos, cache):
+        """One-token forward through all blocks using/updating the cache."""
+        B = tok.shape[0]
+        h = self.embed(tok)[:, None] + self.pos_embed(
+            jnp.full((B, 1), pos))
+        new_cache = cache
+        for i, block in enumerate(self.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x.reshape(B, -1)).reshape(
+                B, 1, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = [jnp.moveaxis(qkv[:, :, j], 1, 2) for j in range(3)]
+            k_cache = jax.lax.dynamic_update_slice(
+                new_cache[i, 0], k.astype(jnp.float32), (0, 0, pos, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                new_cache[i, 1], v.astype(jnp.float32), (0, 0, pos, 0))
+            new_cache = new_cache.at[i, 0].set(k_cache).at[i, 1].set(v_cache)
+            scale = 1.0 / (block.attn.d_head ** 0.5)
+            att = _attend_cached(q, k_cache, v_cache, pos, scale)
+            att = jnp.moveaxis(att, 2, 1).reshape(B, 1, -1)
+            h = h + block.attn.proj(att.reshape(B, -1))[:, None]
+            m = block.fc2(F.gelu(block.fc1(block.ln2(h).reshape(B, -1))))
+            h = h + m[:, None]
+        h = self.ln_f(h)
+        logits = self.head(h.reshape(B, -1))
+        return logits, new_cache
+
+    def generate(self, prompt, max_new_tokens, temperature=0.0, key=None):
+        """Autoregressive continuation as one compiled scan.
+
+        ``prompt``: int [B, T0].  ``temperature=0`` → greedy; otherwise
+        requires ``key``.  Returns [B, max_new_tokens].
+        """
+        B, T0 = prompt.shape
+        max_len = T0 + max_new_tokens
+        cache = self.init_cache(B, max_len)
+
+        # prefill: feed the prompt token by token (simple + exact; a
+        # batched prefill is the obvious follow-up optimization)
+        def prefill(carry, t):
+            cache, _ = carry
+            tok = jax.lax.dynamic_index_in_dim(prompt, t, 1, False)
+            logits, cache = self._step_logits(tok, t, cache)
+            return (cache, logits), None
+
+        (cache, logits), _ = jax.lax.scan(
+            prefill, (cache, jnp.zeros((B, self.head.out_size))),
+            jnp.arange(T0))
+
+        def pick(logits, k):
+            if temperature == 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                k, logits / temperature, axis=-1).astype(jnp.int32)
+
+        key = key if key is not None else jax.random.PRNGKey(0)
+
+        def step(carry, i):
+            cache, logits, key = carry
+            key, sub = jax.random.split(key)
+            tok = pick(logits, sub)
+            new_logits, cache = self._step_logits(tok, T0 + i, cache)
+            return (cache, new_logits, key), tok
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, logits, key), jnp.arange(max_new_tokens))
+        return jnp.swapaxes(toks, 0, 1)
+
+
+# graft generation onto the LM (kept separate for readability)
+TransformerLM.init_cache = _GenerationMixin.init_cache
+TransformerLM._step_logits = _GenerationMixin._step_logits
+TransformerLM.generate = _GenerationMixin.generate

@@ -170,3 +170,28 @@ def test_transformer_remat_matches():
         opt = SGD(lr=0.1).setup(m)
         losses[remat] = [float(opt.update(m, x, t)) for _ in range(3)]
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
+def test_generate_kv_cache_matches_full_forward():
+    """Greedy generation with KV caches emits exactly the argmax of the
+    full-forward logits at each position."""
+    m = TransformerLM(31, d_model=32, n_heads=2, n_layers=2, max_len=64,
+                      seed=0)
+    prompt = jnp.asarray(np.random.RandomState(0)
+                         .randint(0, 31, (2, 5)).astype(np.int32))
+    out = m.generate(prompt, 6)
+    assert out.shape == (2, 6)
+    full = jnp.concatenate([prompt, out], axis=1)
+    logits = m.logits(full)
+    for i in range(6):
+        expect = np.argmax(np.asarray(logits[:, 5 + i - 1]), -1)
+        np.testing.assert_array_equal(np.asarray(out[:, i]), expect)
+
+
+def test_generate_sampling_reproducible():
+    m = TransformerLM(31, d_model=16, n_heads=2, n_layers=1, max_len=32,
+                      seed=1)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    a = m.generate(prompt, 5, temperature=1.0, key=jax.random.PRNGKey(7))
+    b = m.generate(prompt, 5, temperature=1.0, key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
