@@ -33,6 +33,8 @@ def main():
     parser.add_argument("--n-train", type=int, default=512)
     parser.add_argument("--communicator", "-c", default="pure_nccl")
     parser.add_argument("--grad-dtype", default="bfloat16")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize ResNet stages (larger batches)")
     parser.add_argument("--out", "-o", default="result_imagenet")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
@@ -47,7 +49,8 @@ def main():
 
     comm = ct.create_communicator(args.communicator,
                                   allreduce_grad_dtype=args.grad_dtype)
-    archs = {"resnet50": lambda: ResNet50(compute_dtype=jnp.bfloat16),
+    archs = {"resnet50": lambda: ResNet50(compute_dtype=jnp.bfloat16,
+                                          remat=args.remat),
              "alex": AlexNet, "nin": NIN, "vgg16": VGG16,
              "googlenet": GoogLeNet}
     model = Classifier(archs[args.arch]())
