@@ -373,6 +373,19 @@ class Optimizer:
             self.target.serialize(serializer["target"])
         self.t = int(serializer("t", self.t))
         self.epoch = int(serializer("epoch", self.epoch))
+        # per-step rng key: resumed stochastic layers (dropout) continue
+        # the exact key sequence of the uninterrupted run
+        if serializer.is_writer:
+            if getattr(self, "_rng_key", None) is not None:
+                serializer("rng_key", np.asarray(self._rng_key))
+        else:
+            try:
+                data = serializer("rng_key", None)
+            except KeyError:  # snapshots from before keys were saved
+                data = None
+            if data is not None and np.asarray(data).size:
+                self._rng_key = jnp.asarray(np.asarray(data,
+                                                       dtype=np.uint32))
         if serializer.is_writer:
             if self._opt_state is not None:
                 flat, treedef = jax.tree.flatten(self._opt_state)

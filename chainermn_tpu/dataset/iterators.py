@@ -111,6 +111,17 @@ class SerialIterator(Iterator):
             self._order = np.asarray(order)
         self._previous_epoch_detail = float(serializer(
             "previous_epoch_detail", self._previous_epoch_detail))
+        # RNG state too (beyond the reference): post-resume reshuffles then
+        # match the uninterrupted run exactly — checkpoint fidelity is
+        # bit-exact, not just epoch-aligned
+        name, keys, pos, has_gauss, cached = self._rng.get_state()
+        keys = serializer("rng_keys", np.asarray(keys))
+        pos = serializer("rng_pos", pos)
+        has_gauss = serializer("rng_has_gauss", has_gauss)
+        cached = serializer("rng_cached_gaussian", cached)
+        if not serializer.is_writer and keys is not None:
+            self._rng.set_state((name, np.asarray(keys, np.uint32),
+                                 int(pos), int(has_gauss), float(cached)))
 
 
 class MultithreadIterator(Iterator):
