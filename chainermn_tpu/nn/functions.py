@@ -84,12 +84,13 @@ def log_softmax(x, axis=1):
 # -- losses ----------------------------------------------------------------
 
 def softmax_cross_entropy(x, t, ignore_label=-1, reduce="mean",
-                          normalize=True):
+                          normalize=True, class_weight=None):
     """Softmax + NLL with ignore-label masking.
 
     Matches the reference semantics (``F.softmax_cross_entropy``): ``t`` holds
     int class ids; entries equal to ``ignore_label`` contribute zero loss and
-    are excluded from the normalizer.
+    are excluded from the normalizer; ``class_weight`` ([n_classes]) scales
+    each example's loss by its target class's weight.
     """
     logp = jax.nn.log_softmax(x, axis=1)
     t_safe = jnp.where(t == ignore_label, 0, t)
@@ -97,6 +98,8 @@ def softmax_cross_entropy(x, t, ignore_label=-1, reduce="mean",
     nll = -jnp.take_along_axis(
         logp, t_safe[:, None] if logp.ndim == 2 else jnp.expand_dims(t_safe, 1), axis=1
     ).squeeze(1)
+    if class_weight is not None:
+        nll = nll * jnp.asarray(class_weight)[t_safe]
     mask = (t != ignore_label)
     nll = jnp.where(mask, nll, 0.0)
     if reduce == "no":

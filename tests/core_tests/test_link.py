@@ -212,3 +212,15 @@ def test_function_long_tail_aliases():
     b = F.bias(jnp.zeros((2, 3)), jnp.asarray([1.0, 2.0, 3.0]), axis=1)
     np.testing.assert_allclose(np.asarray(b[0]), [1, 2, 3])
     assert F.einsum("ij,jk->ik", x, x.T).shape == (4, 4)
+
+
+def test_softmax_cross_entropy_class_weight():
+    x = jnp.asarray(np.random.RandomState(0).normal(0, 1, (4, 3))
+                    .astype(np.float32))
+    t = jnp.asarray([0, 1, 2, 1], dtype=jnp.int32)
+    w = jnp.asarray([1.0, 2.0, 0.5])
+    plain = F.softmax_cross_entropy(x, t, reduce="no")
+    weighted = F.softmax_cross_entropy(x, t, reduce="no", class_weight=w)
+    np.testing.assert_allclose(np.asarray(weighted),
+                               np.asarray(plain) * np.asarray(w)[[0, 1, 2, 1]],
+                               rtol=1e-6)

@@ -163,3 +163,16 @@ def test_pipeline_bn_stats_come_from_owner_rank():
                                rtol=1e-4, atol=1e-5)
     # owner's activations have nonzero mean — garbage (zeros) would not
     assert float(np.abs(np.asarray(stage1.bn.avg_mean)).sum()) > 1e-3
+
+
+def test_chain_list_topology_errors():
+    import pytest
+    m = MultiNodeChainList(COMM)
+    m.add_link(_Block(4, 4, seed=1), rank_in=None, rank_out=1, rank=0)
+    with pytest.raises(ValueError, match="no terminal"):
+        m(jnp.ones((2, 4)))
+    m2 = MultiNodeChainList(COMM)
+    m2.add_link(_Block(4, 4, seed=1), rank_in=None, rank_out=None, rank=0)
+    m2.add_link(_Block(4, 4, seed=2), rank_in=None, rank_out=None, rank=1)
+    with pytest.raises(ValueError, match="multiple terminal"):
+        m2(jnp.ones((2, 4)))
