@@ -173,14 +173,17 @@ class _MultiNodeOptimizer:
             # decorrelate stochastic masks across ranks (each rank holds a
             # different batch shard)
             rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
-            loss, new_pstate, obs, grads = loss_and_grad(
-                params, pstate, rng_local, args, kwargs)
+            with jax.named_scope("mn_forward_backward"):
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_local, args, kwargs)
             # the reference's allreduce_grad: mean over ranks, optional
             # dtype compression, optional flat bucket — all in-program
-            grads = grad_transform(grads)
+            with jax.named_scope("mn_allreduce_grad"):
+                grads = grad_transform(grads)
             apply_grads = stale[0] if double_buffering else grads
-            new_params, new_opt_state = apply_transform_update(
-                tx, apply_grads, opt_state, params, hyper["lr"])
+            with jax.named_scope("mn_optimizer_update"):
+                new_params, new_opt_state = apply_transform_update(
+                    tx, apply_grads, opt_state, params, hyper["lr"])
             # per-rank scalars → global means for reporting / BN state
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
