@@ -78,6 +78,20 @@ class LogReport(Extension):
     def serialize(self, serializer):
         if hasattr(self._trigger, "serialize"):
             self._trigger.serialize(serializer["_trigger"])
+        # persist accumulated log entries so resumed runs append to the
+        # same history (reference LogReport behavior)
+        if serializer.is_writer:
+            payload = np.frombuffer(
+                json.dumps(self._log).encode(), dtype=np.uint8)
+            serializer("log_json", payload)
+        else:
+            try:
+                data = serializer("log_json", None)
+            except KeyError:
+                data = None
+            if data is not None and np.asarray(data).size:
+                self._log = json.loads(np.asarray(
+                    data, dtype=np.uint8).tobytes().decode())
 
 
 class PrintReport(Extension):

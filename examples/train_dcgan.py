@@ -53,6 +53,9 @@ def main():
     trainer = Trainer(updater, (args.epoch, "epoch"), out=args.out)
     checkpointer = ct.create_multi_node_checkpointer(comm, name="dcgan")
     trainer.extend(checkpointer, trigger=(1, "epoch"))
+    resumed = checkpointer.maybe_load(trainer, path=args.out)
+    if resumed and comm.rank == 0:
+        print(f"resumed from iteration {resumed}")
     if comm.rank == 0:
         trainer.extend(extensions.LogReport(trigger=(10, "iteration")))
         trainer.extend(extensions.PrintReport(

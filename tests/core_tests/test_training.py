@@ -235,3 +235,24 @@ def test_groupnorm_and_bn_finetune():
     assert bn.N == 1
     bn(x, finetune=True)
     assert bn.N == 2
+
+
+def test_logreport_log_survives_snapshot(tmp_path, mnist_small):
+    train, _ = mnist_small
+
+    def build():
+        model = Classifier(MLP())
+        optimizer = SGD(lr=0.05).setup(model)
+        it = SerialIterator(train, 128, seed=9)
+        updater = StandardUpdater(it, optimizer)
+        trainer = Trainer(updater, (2, "epoch"), out=str(tmp_path / "lr"))
+        trainer.extend(extensions.LogReport(trigger=(1, "epoch")))
+        return trainer
+
+    t1 = build()
+    t1.extend(extensions.snapshot(filename="s"), trigger=(2, "epoch"))
+    t1.run()
+    assert len(t1.get_extension("LogReport").log) == 2
+    t2 = build()
+    load_npz(os.path.join(str(tmp_path / "lr"), "s"), t2)
+    assert len(t2.get_extension("LogReport").log) == 2
