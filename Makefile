@@ -1,0 +1,30 @@
+# Common workflows.  The CPU-simulated mesh flags are applied by each
+# entry point itself (tests/conftest.py pins cpu; examples take
+# --platform/--simulate-devices; bench/dryrun self-configure).
+
+PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
+
+.PHONY: test bench bench-smoke scaling dryrun examples clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:            ## real-hardware benchmark (one JSON line)
+	$(PY) bench.py
+
+bench-smoke:      ## CPU smoke of the bench mechanics
+	BENCH_BS=2 BENCH_SIZE=64 BENCH_STEPS=2 $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()"
+
+scaling:
+	$(PY) bench_scaling.py --platform cpu --simulate-devices 8 --per-chip-bs 4 --size 64 --steps 3
+
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+examples:         ## quick battery on the simulated mesh
+	$(PY) examples/train_mnist_dp.py -e 1 -o /tmp/mk_dp --platform cpu --simulate-devices 8
+	$(PY) examples/train_mnist_model_parallel.py -e 1 -u 24 -o /tmp/mk_mp --platform cpu --simulate-devices 8
+	$(PY) examples/train_seq2seq.py -e 1 -u 16 -o /tmp/mk_s2s --platform cpu --simulate-devices 8
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; rm -f chainermn_tpu/utils/native/_dataloader.so
