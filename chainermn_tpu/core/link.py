@@ -324,7 +324,11 @@ def extract_state(link: Link) -> dict:
     for path, value in link.namedpersistent():
         if value is None or isinstance(value, (str, bytes)):
             continue
-        state[path] = jnp.asarray(value)
+        # hot path: persistent leaves are usually already jax Arrays;
+        # python scalars (BN finetune counters) pass through as weak-typed
+        # jit leaves without a per-step device transfer
+        state[path] = value if isinstance(value, (jax.Array, int, float)) \
+            else jnp.asarray(value)
     return {"params": params, "state": state}
 
 
