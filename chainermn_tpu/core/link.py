@@ -317,18 +317,25 @@ def extract_state(link: Link) -> dict:
     """Flatten a link into ``{'params': {path: array}, 'state': {path: array}}``.
 
     The result is a plain nested dict — a JAX pytree — suitable for jit
-    arguments, optax states, checkpointing, and collectives.
+    arguments, optax states, checkpointing, and collectives.  Persistent
+    python scalars (BN finetune counters) are converted to weak-typed
+    arrays ONCE and written back into the link, so every compiled step
+    sees the same leaf types (a python-scalar jit argument and its
+    written-back Array would otherwise occupy two jit cache entries —
+    one full extra XLA compilation per step function).
     """
     params = {path: p.array for path, p in link.namedparams() if p.array is not None}
     state = {}
-    for path, value in link.namedpersistent():
+    for sublink, name, full in _persistent_slots(link):
+        value = getattr(sublink, name)
         if value is None or isinstance(value, (str, bytes)):
             continue
-        # hot path: persistent leaves are usually already jax Arrays;
-        # python scalars (BN finetune counters) pass through as weak-typed
-        # jit leaves without a per-step device transfer
-        state[path] = value if isinstance(value, (jax.Array, int, float)) \
-            else jnp.asarray(value)
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+            # write-through: stabilize the leaf type for later extracts
+            object.__setattr__(sublink, name, value)
+            sublink._persistent[name] = value
+        state[full] = value
     return {"params": params, "state": state}
 
 

@@ -157,3 +157,31 @@ def test_dropout_fresh_mask_every_compiled_step():
     opt2.seed = 123
     losses2 = [float(opt2.update(net2, x, t)) for _ in range(4)]
     np.testing.assert_allclose(losses, losses2, rtol=1e-6)
+
+
+def test_bn_counter_does_not_double_compile():
+    """Persistent python scalars must not create a second jit cache entry
+    (python-int leaf on step 1 vs written-back Array on step 2)."""
+    import chainermn_tpu as ct
+    from chainermn_tpu import F, L
+
+    class Net(ct.Chain):
+        def __init__(self):
+            super().__init__()
+            with self.init_scope():
+                self.bn = L.BatchNormalization(4)
+                self.l = L.Linear(4, 2, seed=0)
+
+        def forward(self, x, t):
+            return F.softmax_cross_entropy(self.l(self.bn(x)), t)
+
+    import jax.numpy as jnp
+    net = Net()
+    opt = SGD(lr=0.1).setup(net)
+    x = jnp.ones((8, 4))
+    t = jnp.zeros((8,), jnp.int32)
+    for _ in range(3):
+        opt.update(net, x, t)
+    (step,) = list(opt._step_cache.values())
+    assert step._cache_size() == 1, \
+        f"step compiled {step._cache_size()} times"
