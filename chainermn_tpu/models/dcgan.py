@@ -185,7 +185,8 @@ class DCGANUpdater(StandardUpdater):
             return out
 
         if comm is None:
-            return jax.jit(step)
+            # donate optimizer states (replaced by returned values)
+            return jax.jit(step, donate_argnums=(2, 3))
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
         mapped = shard_map(
@@ -194,7 +195,7 @@ class DCGANUpdater(StandardUpdater):
                       P(comm.axis_name), P(comm.axis_name)),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        return jax.jit(mapped)
+        return jax.jit(mapped, donate_argnums=(2, 3))
 
     def update_core(self):
         from ..core.link import extract_state
