@@ -21,6 +21,11 @@ import numpy as np
 def measure_step_throughput(n_devices, per_chip_bs, image_size, steps,
                             model_kind="resnet18"):
     import jax
+    try:  # persistent compile cache (shared with bench.py)
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/chainermn_tpu_jax_cache")
+    except Exception:
+        pass
     import jax.numpy as jnp
 
     import chainermn_tpu as ct
