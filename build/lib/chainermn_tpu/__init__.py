@@ -1,0 +1,43 @@
+"""chainermn_tpu — TPU-native distributed deep-learning framework.
+
+Rebuilds the capabilities of Chainer + ChainerMN (see SURVEY.md) on
+JAX/XLA: define-by-run-feel parameter containers compiled into single
+jitted SPMD train steps, with ChainerMN's full distributed surface —
+communicators, differentiable collectives, model-parallel chain lists,
+multi-node BN/optimizer/evaluator/iterators, dataset scattering, and
+consensus-resume checkpointing — lowered to ICI/DCN mesh collectives.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (Parameter, Link, Chain, ChainList, Sequential,
+                   Optimizer, SGD, MomentumSGD, Adam, AdamW,
+                   Reporter, report, report_scope,
+                   global_config, config, using_config)
+from . import nn
+from .nn import functions as F
+from .nn import links as L
+from .nn import initializers
+from . import dataset
+from .dataset import (TupleDataset, SubDataset, SerialIterator,
+                      concat_examples)
+from . import serializers
+from . import training
+from . import communicators
+from .communicators import (create_communicator, CommunicatorBase,
+                            MeshCommunicator, DummyCommunicator)
+from . import functions
+from . import links
+from . import models
+from . import parallel
+from . import ops
+from .optimizers import create_multi_node_optimizer
+from .evaluators import create_multi_node_evaluator
+from . import extensions
+from .extensions import create_multi_node_checkpointer
+from .iterators import (create_multi_node_iterator,
+                        create_synchronized_iterator)
+from . import global_except_hook
+global_except_hook._add_hook_if_enabled()
+from .datasets import (scatter_dataset, create_empty_dataset, scatter_index,
+                       get_n_iterations_for_one_epoch)

@@ -1,0 +1,73 @@
+"""Communicator factory.
+
+Reference: ``chainermn/communicators/__init__.py · create_communicator``
+(SURVEY.md §2.1) — maps a name string to a communicator.  All reference
+names are accepted; on TPU they are flavors of one mesh-backed
+implementation (SURVEY §2.7: the taxonomy collapses to mesh-axis +
+dtype + bucketing choices):
+
+===================  ========================================================
+name                 TPU realization
+===================  ========================================================
+``naive``            per-parameter mean collectives (correctness baseline)
+``flat``             single flat-bucket collective (``batch_collectives``)
+``pure_nccl``        fused bucket + optional compressed-dtype gradient psum
+``hierarchical``     alias of ``pure_nccl`` (XLA handles torus hierarchy)
+``two_dimensional``  alias of ``pure_nccl``
+``single_node``      asserts one host, otherwise ``pure_nccl``
+``non_cuda_aware``   alias of ``naive`` (host staging has no TPU analog)
+``jax_ici``          canonical native name (= ``pure_nccl`` defaults)
+``dummy``            no-op loopback
+===================  ========================================================
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .communicator_base import CommunicatorBase
+from .debug_communicator import DebugCommunicator
+from .dummy_communicator import DummyCommunicator
+from .mesh_communicator import MeshCommunicator
+
+__all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
+           "DummyCommunicator", "DebugCommunicator"]
+
+_NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
+          "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug")
+
+
+def create_communicator(communicator_name="jax_ici", devices=None,
+                        axis_name="mn_world", allreduce_grad_dtype=None,
+                        batch_collectives=None, **kwargs):
+    """Create a communicator by reference name.
+
+    ``allreduce_grad_dtype``: gradient-compression dtype for the collective
+    (reference fp16 path; bf16 recommended on TPU).  ``devices``: subset of
+    ``jax.devices()`` (default all).
+    """
+    name = communicator_name
+    if name not in _NAMES:
+        raise ValueError(
+            f"unknown communicator {name!r}; choose from {_NAMES}")
+    if name == "dummy":
+        return DummyCommunicator()
+    if name == "debug":
+        return DebugCommunicator(devices=devices, axis_name=axis_name,
+                                 allreduce_grad_dtype=allreduce_grad_dtype,
+                                 batch_collectives=bool(batch_collectives))
+    if name == "single_node" and jax.process_count() != 1:
+        raise ValueError("single_node communicator requires one host "
+                         f"(process_count={jax.process_count()})")
+    if allreduce_grad_dtype is not None and name not in (
+            "pure_nccl", "jax_ici", "hierarchical", "two_dimensional"):
+        raise ValueError(
+            f"allreduce_grad_dtype is supported by the fused-bucket "
+            f"communicators, not {name!r} (reference: pure_nccl-only)")
+    if batch_collectives is None:
+        batch_collectives = name in ("flat", "pure_nccl", "jax_ici",
+                                     "hierarchical", "two_dimensional",
+                                     "single_node")
+    return MeshCommunicator(devices=devices, axis_name=axis_name,
+                            allreduce_grad_dtype=allreduce_grad_dtype,
+                            batch_collectives=batch_collectives, name=name)

@@ -1,0 +1,65 @@
+"""Rank/topology utilities.
+
+Reference: ``chainermn/communicators/_communication_utility.py ·
+init_ranks/init_comms`` (SURVEY.md §2.1) — there, topology is derived by
+allgathering hostnames over MPI and NCCL ids are broadcast.  On TPU the
+runtime already knows the topology: ``jax.devices()`` carries process
+ownership and ICI coordinates, and ``jax.distributed.initialize`` is the
+bootstrap (N4 in SURVEY §2.5).  These helpers expose the same vocabulary.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_ranks", "initialize_distributed", "device_topology"]
+
+
+def init_ranks(devices=None):
+    """Per-device ``(global_rank, intra_rank, intra_size, inter_rank,
+    inter_size)`` — the reference's quintuple, with host standing in for
+    node (one controlling process per TPU host)."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n_hosts = jax.process_count()
+    ranks = []
+    per_host = {}
+    for gr, d in enumerate(devices):
+        host = getattr(d, "process_index", 0)
+        intra = per_host.setdefault(host, 0)
+        per_host[host] += 1
+        ranks.append((gr, intra, None, host, n_hosts))
+    intra_sizes = dict(per_host)
+    return [(gr, ir, intra_sizes[h], h, n)
+            for (gr, ir, _, h, n) in ranks]
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host bootstrap (reference: ``mpiexec`` + ``init_ranks``).
+
+    Wraps ``jax.distributed.initialize``: the coordinator's gRPC/DCN store
+    takes MPI's role for process launch agreement.  No-op when already
+    initialized or running single-process.
+    """
+    if num_processes in (None, 1) and coordinator_address is None \
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+        return True
+    except RuntimeError:
+        return False  # already initialized
+
+
+def device_topology(devices=None):
+    """Best-effort ICI coordinates per device (for mesh layout choices)."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    coords = []
+    for d in devices:
+        coords.append(getattr(d, "coords", None))
+    return coords

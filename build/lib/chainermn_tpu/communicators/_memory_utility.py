@@ -1,0 +1,57 @@
+"""Parameter packing utilities.
+
+Reference: ``chainermn/communicators/_memory_utility.py · DeviceMemory,
+pack_params, unpack_params`` (SURVEY.md §2.1, N2 in §2.5) — there, CUDA
+arenas and batched-copy kernels gather scattered grads into one buffer.
+On TPU, packing is a ``concatenate`` *inside* the compiled step (XLA fuses
+the copies); no arena management exists because XLA owns HBM.  These
+helpers provide the same pack/unpack contract for the ``flat``-flavor
+communicator and for flat-buffer checkpointing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack"]
+
+
+def tree_pack(tree, dtype=None):
+    """Flatten a pytree of arrays into (flat_vector, spec)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    shapes = [l.shape for l in leaves]
+    dtypes = [l.dtype for l in leaves]
+    flat = jnp.concatenate(
+        [l.reshape(-1).astype(dtype or l.dtype) for l in leaves]) \
+        if leaves else jnp.zeros((0,), dtype or jnp.float32)
+    return flat, (treedef, shapes, dtypes)
+
+
+def tree_unpack(flat, spec):
+    treedef, shapes, dtypes = spec
+    leaves = []
+    offset = 0
+    for shape, dt in zip(shapes, dtypes):
+        n = int(np.prod(shape))
+        leaves.append(flat[offset:offset + n].reshape(shape).astype(dt))
+        offset += n
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def pack_params(params, attr="grad", dtype=None):
+    """Pack ``param.<attr>`` of a parameter list into one flat vector.
+
+    Reference-shaped API (``pack_params(params, 'grad', buffer)``); returns
+    (flat, spec) instead of filling a caller-owned arena.
+    """
+    arrays = [getattr(p, attr) for p in params]
+    return tree_pack(arrays, dtype=dtype)
+
+
+def unpack_params(params, flat, spec, attr="grad"):
+    arrays = tree_unpack(flat, spec)
+    for p, a in zip(params, arrays):
+        setattr(p, attr, a)

@@ -1,0 +1,141 @@
+"""Communicator abstract base.
+
+Reference: ``chainermn/communicators/communicator_base.py ·
+CommunicatorBase`` (SURVEY.md §2.1) — the full method vocabulary:
+properties ``rank/size/intra_rank/inter_rank/inter_size``, ndarray
+collectives ``send/recv/bcast/gather/allgather/alltoall/allreduce/scatter``,
+pickled-object variants ``*_obj``, model ops ``bcast_data`` /
+``allreduce_grad`` (alias ``multi_node_mean_grad``), and ``split``.
+
+Semantics shift for the single-controller SPMD world (documented here once,
+inherited everywhere):
+
+* The reference is MPMD: N processes, each owning one GPU, each executing
+  its own copy of the script; ``rank`` addresses a process.  JAX is
+  single-controller SPMD: one Python process per *host* drives all devices,
+  and per-device code exists only inside compiled programs.  Therefore a
+  "rank" here is a **device index along the communicator's mesh axis**, and
+  the communicator has two operating modes:
+
+  - **Eager (host) mode** — collectives act on *stacked* arrays whose
+    leading axis is ``size`` (element ``i`` = rank ``i``'s value).  This is
+    the single-controller view of "every rank holds a value" and is what
+    the reference's per-process test patterns map onto.
+  - **In-step (traced) mode** — inside a program launched via
+    :meth:`run_spmd` (a ``shard_map`` over the communicator's axis), the
+    same methods emit ``lax`` collectives (``psum``/``all_gather``/
+    ``ppermute``/``all_to_all``) that compile onto ICI/DCN.  This is the
+    hot path; SURVEY §3.2's pack/cast/allreduce machinery becomes part of
+    one XLA program.
+
+* ``rank``/``intra_rank`` address the *controlling process* (host): used
+  for the reference's ``if comm.rank == 0:`` logging/IO patterns, which in
+  JAX run once per host rather than once per device.  ``size`` is the
+  device count along the communicator axis (the data-parallel degree).
+"""
+
+from __future__ import annotations
+
+__all__ = ["CommunicatorBase"]
+
+
+class CommunicatorBase:
+    # -- topology ----------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        """Host/process rank for control-flow (logging, IO)."""
+        raise NotImplementedError
+
+    @property
+    def size(self) -> int:
+        """Number of ranks (devices along the communicator axis)."""
+        raise NotImplementedError
+
+    @property
+    def intra_rank(self) -> int:
+        """Rank within the local host (reference: GPU index within node)."""
+        raise NotImplementedError
+
+    @property
+    def intra_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def inter_rank(self) -> int:
+        """Host index (reference: node index)."""
+        raise NotImplementedError
+
+    @property
+    def inter_size(self) -> int:
+        """Number of hosts (reference: number of nodes)."""
+        raise NotImplementedError
+
+    # -- ndarray collectives -------------------------------------------------
+    def send(self, data, dest, tag=0):
+        raise NotImplementedError
+
+    def recv(self, source, tag=0):
+        raise NotImplementedError
+
+    def bcast(self, data, root=0):
+        raise NotImplementedError
+
+    def gather(self, data, root=0):
+        raise NotImplementedError
+
+    def allgather(self, x):
+        raise NotImplementedError
+
+    def alltoall(self, xs):
+        raise NotImplementedError
+
+    def scatter(self, xs, root=0):
+        raise NotImplementedError
+
+    def allreduce(self, data, op="sum"):
+        raise NotImplementedError
+
+    # -- object (pickle) channel ----------------------------------------------
+    def send_obj(self, obj, dest, tag=0):
+        raise NotImplementedError
+
+    def recv_obj(self, source, tag=0):
+        raise NotImplementedError
+
+    def bcast_obj(self, obj, root=0):
+        raise NotImplementedError
+
+    def gather_obj(self, obj, root=0):
+        raise NotImplementedError
+
+    def allgather_obj(self, obj):
+        raise NotImplementedError
+
+    def allreduce_obj(self, obj):
+        raise NotImplementedError
+
+    # -- model ops -------------------------------------------------------------
+    def bcast_data(self, model):
+        """Replicate model parameters from root across ranks.
+
+        Reference: ``CommunicatorBase.bcast_data`` — called once before
+        training so all ranks start from identical weights.
+        """
+        raise NotImplementedError
+
+    def multi_node_mean_grad(self, model, zero_fill=False):
+        """Average ``param.grad`` across ranks in place."""
+        raise NotImplementedError
+
+    # historical alias (reference kept both names through the rename)
+    def allreduce_grad(self, model, zero_fill=False):
+        return self.multi_node_mean_grad(model, zero_fill)
+
+    # -- topology manipulation ---------------------------------------------------
+    def split(self, color, key):
+        """Partition ranks into disjoint sub-communicators (MPI_Comm_Split)."""
+        raise NotImplementedError
+
+    # -- lifecycle ------------------------------------------------------------
+    def finalize(self):
+        pass

@@ -1,0 +1,93 @@
+"""No-op communicator.
+
+Reference: ``chainermn/communicators/dummy_communicator.py ·
+DummyCommunicator`` (SURVEY.md §2.1) — used to measure the
+non-communication fraction of a run and in API-shape tests.  All
+collectives are size-1 identities; ``grad_transform`` is the identity, so
+a training loop built for a real communicator runs unchanged with zero
+communication cost.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .communicator_base import CommunicatorBase
+
+__all__ = ["DummyCommunicator"]
+
+
+class DummyCommunicator(CommunicatorBase):
+    def __init__(self):
+        self.name = "dummy"
+        self.axis_name = None
+        self._mailbox = []
+        self._obj_mailbox = []
+
+    rank = property(lambda self: 0)
+    size = property(lambda self: 1)
+    intra_rank = property(lambda self: 0)
+    intra_size = property(lambda self: 1)
+    inter_rank = property(lambda self: 0)
+    inter_size = property(lambda self: 1)
+
+    def send(self, data, dest, tag=0):
+        self._mailbox.append(jnp.asarray(data))
+
+    def recv(self, source, tag=0):
+        return self._mailbox.pop(0)
+
+    def bcast(self, data, root=0):
+        return jnp.asarray(data)
+
+    def gather(self, data, root=0):
+        return (jnp.asarray(data),)
+
+    def allgather(self, x):
+        return (jnp.asarray(x),)
+
+    def alltoall(self, xs):
+        return xs
+
+    def scatter(self, xs, root=0):
+        return jnp.asarray(xs)
+
+    def allreduce(self, data, op="sum"):
+        return jnp.asarray(data)
+
+    def multi_node_mean(self, data):
+        return jnp.asarray(data)
+
+    def send_obj(self, obj, dest, tag=0):
+        self._obj_mailbox.append(obj)
+
+    def recv_obj(self, source, tag=0):
+        return self._obj_mailbox.pop(0)
+
+    def bcast_obj(self, obj, root=0):
+        return obj
+
+    def gather_obj(self, obj, root=0):
+        return [obj]
+
+    def allgather_obj(self, obj):
+        return [obj]
+
+    def allreduce_obj(self, obj):
+        return obj
+
+    def bcast_data(self, model):
+        return model
+
+    def multi_node_mean_grad(self, model, zero_fill=False):
+        pass
+
+    def grad_transform(self):
+        return lambda grads: grads
+
+    def run_spmd(self, fn, *args, **kwargs):
+        return jax.jit(fn)(*args)
+
+    def split(self, color, key):
+        return self

@@ -1,0 +1,486 @@
+"""Mesh-backed communicator — the ``jax_ici`` backend.
+
+Reference: the whole of ``chainermn/communicators/`` (SURVEY.md §2.1).
+The reference's eight communicator classes solve GPU-cluster problems
+(CUDA-aware MPI, host staging, node hierarchy, NCCL rings).  On TPU the
+transport is one thing — XLA collectives over ICI/DCN — so the taxonomy
+collapses into *mesh-axis choice + gradient dtype choice* (SURVEY §2.7),
+and the named variants (``naive``/``flat``/``hierarchical``/
+``two_dimensional``/``single_node``/``non_cuda_aware``/``pure_nccl``)
+are aliases of this class with their distinguishing knobs preserved:
+
+* ``pure_nccl(allreduce_grad_dtype=float16)`` → ``grad_dtype=bfloat16``
+  compressed gradient ``psum`` (N3 in SURVEY §2.5; bf16 is the TPU-native
+  half type — fp16 is honored if explicitly requested).
+* ``flat``'s single fused buffer → ``batch_collectives=True``: gradients
+  are flattened into one contiguous bucket before the collective (N2;
+  XLA usually fuses this anyway — measured, not assumed; see bench/).
+* ``hierarchical``/``two_dimensional``'s reduce-scatter structure → XLA
+  already decomposes large ``psum``s bandwidth-optimally over the torus.
+
+Two operating modes (see ``communicator_base`` docstring): eager host-mode
+collectives on stacked arrays, and in-step ``lax`` collectives inside
+``shard_map`` programs launched by :meth:`run_spmd`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .communicator_base import CommunicatorBase
+
+__all__ = ["MeshCommunicator"]
+
+
+def _is_traced(*xs):
+    return any(isinstance(leaf, jax.core.Tracer)
+               for x in xs for leaf in jax.tree.leaves(x))
+
+
+class MeshCommunicator(CommunicatorBase):
+    """Communicator over a 1-D device mesh axis.
+
+    ``devices``: list of ``jax.Device`` (default: all).  ``axis_name``: the
+    mesh axis this communicator's collectives run over.  For hybrid
+    DP×MP (reference: ``CommunicatorBase.split`` + two communicators),
+    construct one communicator per axis of a shared N-D mesh via
+    :meth:`from_mesh_axis`.
+    """
+
+    def __init__(self, devices=None, axis_name="mn_world",
+                 allreduce_grad_dtype=None, batch_collectives=False,
+                 name="jax_ici", _mesh=None):
+        self.name = name
+        self.axis_name = axis_name
+        if _mesh is not None:
+            self.mesh = _mesh
+            self._devices = list(np.asarray(_mesh.devices).reshape(-1))
+        else:
+            self._devices = list(devices) if devices is not None else list(jax.devices())
+            self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
+        self.allreduce_grad_dtype = (None if allreduce_grad_dtype is None
+                                     else jnp.dtype(allreduce_grad_dtype))
+        self.batch_collectives = batch_collectives
+        self._mailbox = {}
+        self._obj_mailbox = {}
+        self._lock = threading.Lock()
+        self._jit_cache = {}
+
+    def __deepcopy__(self, memo):
+        # communicators are process-global transport handles (mesh, device
+        # list, mailboxes) — model deepcopies (create_mnbn_model) share them
+        return self
+
+    @classmethod
+    def from_mesh_axis(cls, mesh: Mesh, axis_name: str, **kwargs):
+        """Communicator over one named axis of an existing N-D mesh."""
+        sub = np.moveaxis(mesh.devices,
+                          mesh.axis_names.index(axis_name), 0)
+        comm = cls(devices=list(sub.reshape(sub.shape[0], -1)[:, 0]),
+                   axis_name=axis_name, **kwargs)
+        comm.mesh = mesh  # collectives run inside programs over the full mesh
+        return comm
+
+    # -- topology ------------------------------------------------------------
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def size(self):
+        return len(self._devices)
+
+    @property
+    def intra_rank(self):
+        return 0  # one controller per host drives all local devices
+
+    @property
+    def intra_size(self):
+        return jax.local_device_count()
+
+    @property
+    def inter_rank(self):
+        return jax.process_index()
+
+    @property
+    def inter_size(self):
+        return jax.process_count()
+
+    # -- mode dispatch ---------------------------------------------------------
+    def _axis_index(self):
+        return lax.axis_index(self.axis_name)
+
+    # -- ndarray collectives ----------------------------------------------------
+    def allreduce(self, data, op="sum"):
+        """Traced: ``lax`` reduction over the axis.  Eager: reduce the
+        stacked leading axis and return the (identical-on-all-ranks) value."""
+        if _is_traced(data):
+            if op == "sum":
+                return lax.psum(data, self.axis_name)
+            if op == "mean":
+                return lax.pmean(data, self.axis_name)
+            if op == "max":
+                return lax.pmax(data, self.axis_name)
+            if op == "min":
+                return lax.pmin(data, self.axis_name)
+            raise ValueError(f"unsupported op {op!r}")
+        data = jnp.asarray(data)
+        self._check_stacked(data, "allreduce")
+        red = {"sum": jnp.sum, "mean": jnp.mean,
+               "max": jnp.max, "min": jnp.min}[op]
+        return red(data, axis=0)
+
+    def multi_node_mean(self, data):
+        """Reference ``CommunicatorBase.multi_node_mean``: allreduce ÷ size."""
+        return self.allreduce(data, op="mean")
+
+    def allgather(self, x):
+        """Traced: ``lax.all_gather`` → leading ``size`` axis.  Eager: the
+        stacked input *is* the gathered result; returned as a tuple for
+        reference-shape parity."""
+        if _is_traced(x):
+            return lax.all_gather(x, self.axis_name)
+        x = jnp.asarray(x)
+        self._check_stacked(x, "allgather")
+        return tuple(x[i] for i in range(self.size))
+
+    def alltoall(self, xs):
+        """Traced: ``lax.all_to_all`` on the leading (destination) axis.
+        Eager: input [src, dst, ...] → output [dst, src, ...]."""
+        if _is_traced(xs):
+            if isinstance(xs, (tuple, list)):
+                xs = jnp.stack(list(xs))
+            return lax.all_to_all(xs, self.axis_name,
+                                  split_axis=0, concat_axis=0, tiled=False)
+        if isinstance(xs, (tuple, list)):
+            xs = jnp.stack([jnp.stack(list(row)) for row in xs]) \
+                if isinstance(xs[0], (tuple, list)) else jnp.stack(list(xs))
+        self._check_stacked(xs, "alltoall")
+        if xs.ndim < 2 or xs.shape[1] != self.size:
+            raise ValueError(
+                "eager alltoall expects [src, dst, ...] stacked input")
+        return jnp.swapaxes(xs, 0, 1)
+
+    def bcast(self, data, root=0):
+        """Traced: every rank gets rank ``root``'s value.  Eager: stacked
+        input → the root slice."""
+        if _is_traced(data):
+            masked = jnp.where(self._axis_index() == root, data,
+                               jnp.zeros_like(data))
+            return lax.psum(masked, self.axis_name)
+        data = jnp.asarray(data)
+        self._check_stacked(data, "bcast")
+        return data[root]
+
+    def gather(self, data, root=0):
+        """Traced: ``all_gather`` (SPMD has no root asymmetry inside a
+        compiled program).  Eager: tuple of per-rank slices."""
+        if _is_traced(data):
+            return lax.all_gather(data, self.axis_name)
+        data = jnp.asarray(data)
+        self._check_stacked(data, "gather")
+        return tuple(data[i] for i in range(self.size))
+
+    def scatter(self, xs, root=0):
+        """Traced: rank ``root``'s stacked [size, ...] value, own slice out.
+        Eager: identity on the stacked representation."""
+        if isinstance(xs, (tuple, list)):
+            xs = jnp.stack(list(xs))
+        if _is_traced(xs):
+            from_root = self.bcast(xs, root)
+            return jnp.take(from_root, self._axis_index(), axis=0)
+        self._check_stacked(xs, "scatter")
+        return xs
+
+    # -- point-to-point -----------------------------------------------------------
+    def send(self, data, dest, tag=0):
+        """Eager mailbox send (host mode).  Traced point-to-point lives in
+        ``chainermn_tpu.functions`` (ppermute with static src/dst)."""
+        if _is_traced(data):
+            raise RuntimeError(
+                "inside compiled steps use chainermn_tpu.functions.send "
+                "(ppermute); Communicator.send is the host-mode channel")
+        with self._lock:
+            self._mailbox.setdefault((dest, tag), []).append(jnp.asarray(data))
+
+    def recv(self, source, tag=0):
+        del source  # single controller: one mailbox, FIFO per tag
+        with self._lock:
+            for key in list(self._mailbox):
+                if key[1] == tag and self._mailbox[key]:
+                    return self._mailbox[key].pop(0)
+        raise RuntimeError("recv with empty mailbox (host mode)")
+
+    # -- object channel ---------------------------------------------------------
+    # Single host: loopback (the controller holds the one copy).  Multi-host:
+    # DCN via multihost_utils (reference: pickled MPI transport, SURVEY §2.7).
+    def send_obj(self, obj, dest, tag=0):
+        with self._lock:
+            self._obj_mailbox.setdefault((dest, tag), []).append(obj)
+
+    def recv_obj(self, source, tag=0):
+        with self._lock:
+            for key in list(self._obj_mailbox):
+                if key[1] == tag and self._obj_mailbox[key]:
+                    return self._obj_mailbox[key].pop(0)
+        raise RuntimeError("recv_obj with empty mailbox (host mode)")
+
+    def bcast_obj(self, obj, root=0):
+        if self.inter_size > 1:
+            gathered = self._process_allgather_pickled(obj)
+            return gathered[root if root < len(gathered) else 0]
+        return obj
+
+    def gather_obj(self, obj, root=0):
+        return self.allgather_obj(obj)
+
+    def allgather_obj(self, obj):
+        """One entry per *rank* (device), independent of host layout.
+
+        Each controlling process contributes one object on behalf of each
+        device it drives (single-controller SPMD: all local ranks hold the
+        same host-side object), so reductions over the result scale with
+        ``size`` identically on 1×8 and 2×4 host layouts.
+        """
+        if self.inter_size > 1:
+            per_process = self._process_allgather_pickled(obj)
+            out = []
+            local_counts = self._local_device_counts()
+            for host_obj, count in zip(per_process, local_counts):
+                out.extend([host_obj] * count)
+            return out
+        return [obj] * self.size
+
+    def _local_device_counts(self):
+        counts = [0] * jax.process_count()
+        for d in self._devices:
+            counts[getattr(d, "process_index", 0)] += 1
+        return counts
+
+    def _process_allgather_pickled(self, obj):
+        """Allgather arbitrary Python objects across processes.
+
+        ``multihost_utils.process_allgather`` stacks array pytrees — wrong
+        shape for opaque objects — so objects go as length-padded pickled
+        byte arrays (the reference's chunked-pickle MPI channel, SURVEY
+        §2.7, re-homed onto the DCN allgather).
+        """
+        import pickle
+        from jax.experimental import multihost_utils
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = np.asarray([payload.size], dtype=np.int64)
+        all_lengths = np.asarray(
+            multihost_utils.process_allgather(length)).reshape(-1)
+        max_len = int(all_lengths.max())
+        padded = np.zeros(max_len, dtype=np.uint8)
+        padded[: payload.size] = payload
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        gathered = gathered.reshape(len(all_lengths), max_len)
+        return [pickle.loads(gathered[i, : int(all_lengths[i])].tobytes())
+                for i in range(len(all_lengths))]
+
+    def allreduce_obj(self, obj):
+        gathered = self.allgather_obj(obj)
+        out = gathered[0]
+        for other in gathered[1:]:
+            out = jax.tree.map(lambda a, b: a + b, out, other)
+        return out
+
+    # -- model ops ------------------------------------------------------------------
+    def bcast_data(self, model):
+        """Make parameters explicitly replicated over the communicator mesh.
+
+        In single-controller JAX, replication is a *sharding property*, not
+        a message: this places every param/persistent array with a
+        replicated ``NamedSharding`` so later sharded programs consume them
+        without re-layout.  Multi-host agreement is handled by the runtime
+        (same bytes on every host by construction of the program).
+        """
+        sharding = NamedSharding(self.mesh, P())
+        for param in model.params():
+            if param.array is not None:
+                param.array = jax.device_put(param.array, sharding)
+        from ..core.link import _persistent_slots
+        for sublink, name, _ in _persistent_slots(model):
+            value = getattr(sublink, name)
+            if value is not None and not np.isscalar(value) \
+                    and not isinstance(value, (int, float)):
+                placed = jax.device_put(jnp.asarray(value), sharding)
+                object.__setattr__(sublink, name, placed)
+                sublink._persistent[name] = placed
+        return model
+
+    def multi_node_mean_grad(self, model, zero_fill=False):
+        """Average per-rank gradients stored on the model (eager path).
+
+        Grad layout contract (single-controller translation of "each rank
+        holds its own grads"): a stacked gradient with leading axis ``size``
+        (``grad.shape == (size,) + param.shape``) is averaged over that
+        axis; an unstacked gradient is already global and is left as-is
+        (÷1).  The *compiled* path — the one benchmarks use — is the
+        ``grad_transform`` this communicator hands to the multi-node
+        optimizer, where the same mean runs as an in-step ``pmean``.
+        """
+        named = [(path, p) for path, p in model.namedparams()
+                 if p.array is not None]
+        grads = {}
+        for path, p in named:
+            if p.grad is None:
+                if zero_fill:
+                    grads[path] = jnp.zeros((self.size,) + p.array.shape,
+                                            p.array.dtype)
+                else:
+                    continue
+            else:
+                grads[path] = p.grad
+        if not grads:
+            return
+        reduced = self._mean_grads_eager(grads, {path: p.array.shape
+                                                 for path, p in named})
+        for path, p in named:
+            if path in reduced:
+                p.grad = reduced[path]
+
+    def _mean_grads_eager(self, grads, shapes):
+        key = tuple(sorted((path, g.shape, str(g.dtype))
+                           for path, g in grads.items()))
+        fn = self._jit_cache.get(("mean_eager", key))
+        if fn is None:
+            size = self.size
+            dtype = self.allreduce_grad_dtype
+            stacked = {path: (g.ndim == len(shapes[path]) + 1
+                              and g.shape[0] == size
+                              and tuple(g.shape[1:]) == tuple(shapes[path]))
+                       for path, g in grads.items()}
+
+            @jax.jit
+            def fn(grads):
+                out = {}
+                for path, g in grads.items():
+                    orig = g.dtype
+                    if dtype is not None:
+                        g = g.astype(dtype)
+                    if stacked[path]:
+                        g = jnp.mean(g, axis=0)
+                    out[path] = g.astype(orig)
+                return out
+
+            self._jit_cache[("mean_eager", key)] = fn
+        return fn(grads)
+
+    # -- in-step gradient transform (the hot path) ---------------------------------
+    def grad_transform(self):
+        """Return ``grads -> grads`` for use inside a compiled train step.
+
+        Implements the reference's ``allreduce_grad`` data path (SURVEY
+        §3.2): optional cast to the compressed dtype (N3), one fused
+        mean-``psum`` over the communicator axis, cast back.  With
+        ``batch_collectives`` (the ``flat`` flavor, N2) gradients are
+        first flattened into a single contiguous bucket so the collective
+        is one large transfer.
+        """
+        axis = self.axis_name
+        dtype = self.allreduce_grad_dtype
+        flat_bucket = self.batch_collectives
+
+        def transform(grads):
+            leaves, treedef = jax.tree.flatten(grads)
+            if not leaves:
+                return grads
+            orig_dtypes = [g.dtype for g in leaves]
+            if dtype is not None:
+                leaves = [g.astype(dtype) for g in leaves]
+            if flat_bucket:
+                shapes = [g.shape for g in leaves]
+                sizes = [int(np.prod(s)) for s in shapes]
+                bucket = jnp.concatenate([g.reshape(-1) for g in leaves])
+                bucket = lax.pmean(bucket, axis)
+                outs = []
+                offset = 0
+                for shape, n in zip(shapes, sizes):
+                    outs.append(bucket[offset:offset + n].reshape(shape))
+                    offset += n
+                leaves = outs
+            else:
+                leaves = [lax.pmean(g, axis) for g in leaves]
+            leaves = [g.astype(d) for g, d in zip(leaves, orig_dtypes)]
+            return jax.tree.unflatten(treedef, leaves)
+
+        return transform
+
+    # -- SPMD launcher ----------------------------------------------------------------
+    def run_spmd(self, fn, *args, in_specs=None, out_specs=None,
+                 static_out=False):
+        """Run ``fn`` as a ``shard_map``ped program over this communicator's
+        axis: rank-local code with this communicator's methods emitting real
+        collectives.  Default specs: every arg/result is stacked on its
+        leading axis (one slice per rank); pass ``P()`` in ``in_specs``/
+        ``out_specs`` for replicated values.
+        """
+        from jax import shard_map
+        axis = self.axis_name
+        if in_specs is None:
+            in_specs = tuple(P(axis) for _ in args)
+        if out_specs is None:
+            out_specs = P(axis)
+        mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        if _is_traced(args):
+            # already inside an outer jit/grad trace — inline the
+            # shard_mapped computation (nested jit would re-enter mesh
+            # context handling and is unnecessary under a trace)
+            return mapped(*args)
+        return jax.jit(mapped)(*args)
+
+    # -- split ------------------------------------------------------------------------
+    def split(self, color, key):
+        """Partition devices into sub-communicators (reference:
+        ``MPI_Comm_Split`` semantics over device ranks).
+
+        ``color``/``key`` follow the per-rank convention: sequences of
+        length ``size`` (device rank i gets color[i]); scalars apply the
+        same value to every rank (the common "all same group" case).
+        Returns the sub-communicator containing *this controller's* view —
+        since one controller drives all devices, the full set of
+        sub-communicators is available as ``.split_all(color, key)``.
+        """
+        return self.split_all(color, key)[0]
+
+    def split_all(self, color, key):
+        size = self.size
+        colors = [color] * size if np.isscalar(color) else list(color)
+        keys = [key] * size if np.isscalar(key) else list(key)
+        if len(colors) != size or len(keys) != size:
+            raise ValueError("color/key must be scalars or length-size")
+        groups = {}
+        for i, (c, k) in enumerate(zip(colors, keys)):
+            groups.setdefault(c, []).append((k, i))
+        comms = []
+        for c in sorted(groups):
+            members = [i for _, i in sorted(groups[c])]
+            comms.append(MeshCommunicator(
+                devices=[self._devices[i] for i in members],
+                axis_name=f"{self.axis_name}_s{c}",
+                allreduce_grad_dtype=self.allreduce_grad_dtype,
+                batch_collectives=self.batch_collectives,
+                name=self.name))
+        return comms
+
+    # -- diagnostics --------------------------------------------------------------------
+    def __repr__(self):
+        return (f"<{type(self).__name__} name={self.name!r} size={self.size} "
+                f"axis={self.axis_name!r} grad_dtype={self.allreduce_grad_dtype}>")
+
+    def _check_stacked(self, x, what):
+        if x.ndim == 0 or x.shape[0] != self.size:
+            raise ValueError(
+                f"eager {what} expects a stacked array with leading axis "
+                f"size={self.size} (one slice per rank); got shape {x.shape}. "
+                f"Inside compiled steps (run_spmd) pass the rank-local value.")

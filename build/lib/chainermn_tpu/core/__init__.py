@@ -1,0 +1,10 @@
+from .link import (Parameter, Link, Chain, ChainList, Sequential,
+                   extract_state, bind_state, apply_state, param_tree,
+                   grad_tree, set_grads, load_param_tree)
+from .optimizer import (Optimizer, GradientMethod, SGD, MomentumSGD, Adam,
+                        AdamW, RMSprop, AdaGrad, AdaDelta, NesterovAG,
+                        WeightDecay, GradientClipping, GradientHardClipping,
+                        Lasso, GradientScaling)
+from .reporter import (Reporter, report, report_scope, get_current_reporter,
+                       Summary, DictSummary)
+from .config import global_config, config, using_config

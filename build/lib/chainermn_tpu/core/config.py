@@ -1,0 +1,50 @@
+"""Thread-local global configuration (consumed-Chainer surface).
+
+Reference: ``chainer/configuration.py · global_config/config/using_config``
+(SURVEY.md §5 config note: train/test mode, dtype flags).  Only the flags this
+framework consults are declared, but arbitrary attributes are allowed for
+user code parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["global_config", "config", "using_config"]
+
+
+class _GlobalConfig:
+    def __init__(self):
+        self.train = True
+        self.enable_backprop = True
+        self.dtype = "float32"
+        self.debug = False
+
+
+global_config = _GlobalConfig()
+
+
+class _LocalConfig(threading.local):
+    def __getattr__(self, name):  # fall through to global defaults
+        return getattr(global_config, name)
+
+
+config = _LocalConfig()
+
+
+@contextlib.contextmanager
+def using_config(name, value, cfg=config):
+    if name in cfg.__dict__:
+        old = cfg.__dict__[name]
+        setattr(cfg, name, value)
+        try:
+            yield
+        finally:
+            setattr(cfg, name, old)
+    else:
+        setattr(cfg, name, value)
+        try:
+            yield
+        finally:
+            delattr(cfg, name)

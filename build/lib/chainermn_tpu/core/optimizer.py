@@ -1,0 +1,520 @@
+"""Optimizers (consumed-Chainer surface: ``chainer.Optimizer`` + optimizers).
+
+Reference anchors: ``chainer/optimizer.py · Optimizer/GradientMethod``,
+``chainer/optimizers/ · SGD, MomentumSGD, Adam, ...``,
+``chainer/optimizer_hooks/ · WeightDecay, GradientClipping`` (SURVEY.md §2.8).
+
+Architecture (TPU-first): the reference runs a Python loop of per-parameter
+CUDA update kernels; here the *whole* step — forward, backward, gradient
+transform (where the multi-node subclass inserts its mesh ``psum``), optax
+update — is one jit-compiled program per (loss function, input shapes).
+Hooks map to optax gradient transformations chained ahead of the base rule,
+preserving the reference's apply-hooks-then-update ordering.  The learning
+rate is a *traced argument* so schedule extensions (ExponentialShift etc.)
+can mutate ``optimizer.lr`` between steps without recompiling.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .link import (Link, bind_state, extract_state,
+                   load_param_tree, _persistent_slots)
+from .config import config
+
+__all__ = ["Optimizer", "GradientMethod", "SGD", "MomentumSGD", "Adam",
+           "AdamW", "RMSprop", "AdaGrad", "AdaDelta", "NesterovAG",
+           "WeightDecay", "GradientClipping", "GradientHardClipping",
+           "Lasso", "GradientScaling"]
+
+
+# ---------------------------------------------------------------------------
+# Hooks → optax gradient transformations
+# ---------------------------------------------------------------------------
+
+class _Hook:
+    name = "Hook"
+    timing = "pre"
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+
+class WeightDecay(_Hook):
+    """L2 decay added to gradients (reference: ``optimizer_hooks.WeightDecay``)."""
+
+    name = "WeightDecay"
+
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_optax(self):
+        return optax.add_decayed_weights(self.rate)
+
+
+class Lasso(_Hook):
+    name = "Lasso"
+
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_optax(self):
+        rate = self.rate
+
+        def update_fn(updates, state, params=None):
+            upd = jax.tree.map(lambda g, p: g + rate * jnp.sign(p), updates, params)
+            return upd, state
+
+        return optax.GradientTransformation(lambda p: optax.EmptyState(), update_fn)
+
+
+class GradientClipping(_Hook):
+    """Clip by global L2 norm (reference: ``optimizer_hooks.GradientClipping``)."""
+
+    name = "GradientClipping"
+
+    def __init__(self, threshold):
+        self.threshold = threshold
+
+    def to_optax(self):
+        return optax.clip_by_global_norm(self.threshold)
+
+
+class GradientHardClipping(_Hook):
+    name = "GradientHardClipping"
+
+    def __init__(self, lower_bound, upper_bound):
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+
+    def to_optax(self):
+        lo, hi = self.lower_bound, self.upper_bound
+
+        def update_fn(updates, state, params=None):
+            return jax.tree.map(lambda g: jnp.clip(g, lo, hi), updates), state
+
+        return optax.GradientTransformation(lambda p: optax.EmptyState(), update_fn)
+
+
+class GradientScaling(_Hook):
+    name = "GradientScaling"
+
+    def __init__(self, rate):
+        self.rate = rate
+
+    def to_optax(self):
+        return optax.scale(self.rate)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer base
+# ---------------------------------------------------------------------------
+
+def make_loss_and_grad(target, lossfun):
+    """Build the traced loss/grad body shared by the single-device and
+    multi-node compiled steps.
+
+    Returns ``f(params, pstate, args, kwargs) -> (loss, new_pstate, obs,
+    grads)``.  In-forward ``report`` calls are captured into ``obs`` (keys
+    prefixed via the reporter active at trace time; standalone use gets a
+    fresh reporter with the target registered as ``main`` so keys match
+    trainer runs).
+    """
+    from . import reporter as reporter_module
+
+    def resolve_reporter():
+        stack = reporter_module._reporter_stack()
+        if stack:
+            return stack[-1]
+        rep = reporter_module.Reporter()
+        rep.add_observer("main", target)
+        rep.add_observers("main", target.namedlinks(skipself=True))
+        return rep
+
+    def loss_and_grad(params, pstate, rng_key, args, kwargs):
+        from . import rng as rng_module
+
+        def loss_on(p):
+            with bind_state(target, {"params": p, "state": pstate}) as handle:
+                obs = {}
+                with resolve_reporter().scope(obs), \
+                        rng_module.key_scope(rng_key):
+                    loss = lossfun(*args, **kwargs)
+                new_pstate = handle.collect()
+            if isinstance(loss, tuple):
+                loss = loss[0]
+            return loss, (new_pstate, obs)
+
+        (loss, (new_pstate, obs)), grads = jax.value_and_grad(
+            loss_on, has_aux=True)(params)
+        return loss, new_pstate, obs, grads
+
+    return loss_and_grad
+
+
+def apply_transform_update(tx, grads, opt_state, params, lr):
+    """Shared tail of every compiled step: hook-chained transform, then the
+    -lr scaling (lr is a traced argument — schedule changes don't recompile)."""
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+    updates = jax.tree.map(lambda u: -lr * u, updates)
+    return optax.apply_updates(params, updates), new_opt_state
+
+
+class _LRUCache(OrderedDict):
+    """Bounded compiled-step cache.
+
+    Keys include ``id(lossfun)``: per-iteration closure lambdas would
+    otherwise grow the cache without bound while pinning their captured
+    batches.  (Pass data via ``update(lossfun, *args)`` — a fresh closure
+    per step forces a retrace by construction.)
+    """
+
+    def __init__(self, maxsize=16):
+        super().__init__()
+        self.maxsize = maxsize
+
+    def get(self, key, default=None):
+        if key in self:
+            self.move_to_end(key)
+            return self[key]
+        return default
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)
+
+
+class Optimizer:
+    """Base optimizer with the reference's lifecycle vocabulary.
+
+    ``setup(link)`` binds a target; ``update(lossfun, *args)`` runs one full
+    compiled train step; ``update()`` (no args) consumes gradients already
+    stored on ``Parameter.grad`` (the path the eager communicator's
+    ``allreduce_grad`` feeds, reference `optimizer.py · GradientMethod.update`).
+    """
+
+    # names of hyperparameters passed as traced args (mutable between steps)
+    _dynamic_hyper = ("lr",)
+
+    def __init__(self):
+        self.target: Link | None = None
+        self.t = 0
+        self.epoch = 0
+        self._hooks = OrderedDict()
+        self._opt_state = None
+        self._tx = None
+        self._step_cache = _LRUCache()
+
+    # -- lifecycle ---------------------------------------------------------
+    def setup(self, link: Link):
+        self.target = link
+        self._opt_state = None
+        self._step_cache = _LRUCache()
+        return self
+
+    def add_hook(self, hook, name=None, timing="pre"):
+        if self.target is None:
+            raise RuntimeError("call setup() before add_hook()")
+        self._hooks[name or hook.name] = hook
+        self._tx = None
+        self._opt_state = None
+        self._step_cache = _LRUCache()
+
+    def remove_hook(self, name):
+        del self._hooks[name]
+        self._tx = None
+        self._opt_state = None
+        self._step_cache = _LRUCache()
+
+    def new_epoch(self):
+        self.epoch += 1
+
+    # -- optax assembly ----------------------------------------------------
+    def _base_transform(self) -> optax.GradientTransformation:
+        """Subclass: the update rule *excluding* the -lr scaling."""
+        raise NotImplementedError
+
+    def _transform(self):
+        if self._tx is None:
+            parts = [h.to_optax() for h in self._hooks.values()]
+            parts.append(self._base_transform())
+            self._tx = optax.chain(*parts)
+        return self._tx
+
+    def _hyper_values(self):
+        return {name: jnp.asarray(getattr(self, name), jnp.float32)
+                for name in self._dynamic_hyper}
+
+    def _next_rng_key(self):
+        """Fresh per-step key (traced arg): stochastic layers get a new
+        mask every step without recompilation.  Seeded from ``self.seed``
+        when set (reproducibility)."""
+        if not hasattr(self, "_rng_key") or self._rng_key is None:
+            seed = getattr(self, "seed", None)
+            if seed is None:
+                seed = np.random.randint(0, 2**31 - 1)
+            self._rng_key = jax.random.PRNGKey(seed)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def _ensure_opt_state(self, params):
+        if self._opt_state is None:
+            self._opt_state = self._transform().init(params)
+        return self._opt_state
+
+    # -- compiled full step ------------------------------------------------
+    def _make_step(self, lossfun):
+        tx = self._transform()
+        loss_and_grad = make_loss_and_grad(self.target, lossfun)
+
+        def step(params, pstate, opt_state, hyper, rng_key, args, kwargs):
+            loss, new_pstate, obs, grads = loss_and_grad(
+                params, pstate, rng_key, args, kwargs)
+            new_params, new_opt_state = apply_transform_update(
+                tx, grads, opt_state, params, hyper["lr"])
+            return new_params, new_pstate, new_opt_state, loss, grads, obs
+
+        # donate opt_state (optimizer-internal, replaced by the returned
+        # value) so XLA updates it in place; params/persistent state stay
+        # un-donated — Link arrays are user-visible and may be aliased
+        # (copyparams shares array objects)
+        return jax.jit(step, donate_argnums=(2,))
+
+    def _cache_key(self, lossfun, args, kwargs):
+        shapes = tuple(
+            (np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
+            for a in jax.tree.leaves((args, kwargs)))
+        return (id(lossfun), shapes, bool(config.train))
+
+    def update(self, lossfun=None, *args, **kwargs):
+        if self.target is None:
+            raise RuntimeError("Optimizer.setup(link) was not called")
+        if lossfun is None:
+            return self._update_from_grads()
+        if any(p.array is None for p in self.target.params()):
+            # materialize lazily-initialized params with one eager forward
+            # (bind_state restores persistent state, so BN stats are untouched)
+            from .link import bind_state
+            with bind_state(self.target, extract_state(self.target)):
+                lossfun(*args, **kwargs)
+        state = extract_state(self.target)
+        params, pstate = state["params"], state["state"]
+        opt_state = self._ensure_opt_state(params)
+        key = self._cache_key(lossfun, args, kwargs)
+        step = self._step_cache.get(key)
+        if step is None:
+            step = self._make_step(lossfun)
+            self._step_cache[key] = step
+        new_params, new_pstate, new_opt_state, loss, grads, obs = step(
+            params, pstate, opt_state, self._hyper_values(),
+            self._next_rng_key(), args, kwargs)
+        self._write_back(new_params, new_pstate, grads)
+        self._opt_state = new_opt_state
+        self.t += 1
+        from . import reporter
+        reporter.report(obs)  # keys were prefixed at capture time
+        return loss
+
+    def _update_from_grads(self):
+        """Apply the update rule to gradients stored on Parameter.grad."""
+        params = {}
+        grads = {}
+        for path, p in self.target.namedparams():
+            if p.array is not None and p.grad is not None:
+                params[path] = p.array
+                grads[path] = p.grad
+        if not grads:
+            return None
+        opt_state = self._ensure_opt_state(params)
+        apply = self._step_cache.get("_from_grads")
+        if apply is None:
+            tx = self._transform()
+
+            @jax.jit
+            def apply(params, grads, opt_state, hyper):
+                updates, new_opt_state = tx.update(grads, opt_state, params)
+                updates = jax.tree.map(lambda u: -hyper["lr"] * u, updates)
+                return optax.apply_updates(params, updates), new_opt_state
+
+            self._step_cache["_from_grads"] = apply
+        new_params, self._opt_state = apply(params, grads, opt_state,
+                                            self._hyper_values())
+        load_param_tree(self.target, new_params)
+        self.t += 1
+        return None
+
+    def _write_back(self, params, pstate, grads=None):
+        load_param_tree(self.target, params)
+        slots = {full: (sublink, name)
+                 for sublink, name, full in _persistent_slots(self.target)}
+        for path, value in pstate.items():
+            if path in slots:
+                sublink, name = slots[path]
+                object.__setattr__(sublink, name, value)
+                sublink._persistent[name] = value
+        if grads is not None:
+            named = dict(self.target.namedparams())
+            for path, g in grads.items():
+                if path in named:
+                    named[path].grad = g
+
+    # -- serialization -----------------------------------------------------
+    def serialize(self, serializer):
+        # target first: restoring opt_state needs materialized params
+        if self.target is not None:
+            self.target.serialize(serializer["target"])
+        self.t = int(serializer("t", self.t))
+        self.epoch = int(serializer("epoch", self.epoch))
+        # per-step rng key: resumed stochastic layers (dropout) continue
+        # the exact key sequence of the uninterrupted run
+        if serializer.is_writer:
+            if getattr(self, "_rng_key", None) is not None:
+                serializer("rng_key", np.asarray(self._rng_key))
+        else:
+            try:
+                data = serializer("rng_key", None)
+            except KeyError:  # snapshots from before keys were saved
+                data = None
+            if data is not None and np.asarray(data).size:
+                self._rng_key = jnp.asarray(np.asarray(data,
+                                                       dtype=np.uint32))
+        if serializer.is_writer:
+            if self._opt_state is not None:
+                flat, treedef = jax.tree.flatten(self._opt_state)
+                serializer("opt_state_len", len(flat))
+                for i, leaf in enumerate(flat):
+                    serializer(f"opt_state_{i}", np.asarray(leaf))
+        else:
+            n = serializer("opt_state_len", None)
+            if n is not None and self.target is not None:
+                params = extract_state(self.target)["params"]
+                self._opt_state = self._transform().init(params)
+                flat, treedef = jax.tree.flatten(self._opt_state)
+                new_flat = []
+                for i, leaf in enumerate(flat[: int(n)]):
+                    data = serializer(f"opt_state_{i}", None)
+                    new_flat.append(jnp.asarray(data) if data is not None else leaf)
+                self._opt_state = jax.tree.unflatten(treedef, new_flat)
+
+
+class GradientMethod(Optimizer):
+    """Alias tier matching the reference hierarchy."""
+
+
+# ---------------------------------------------------------------------------
+# Concrete optimizers (reference: chainer/optimizers/*)
+# ---------------------------------------------------------------------------
+
+class SGD(GradientMethod):
+    def __init__(self, lr=0.01):
+        super().__init__()
+        self.lr = lr
+
+    def _base_transform(self):
+        return optax.identity()
+
+
+class MomentumSGD(GradientMethod):
+    def __init__(self, lr=0.01, momentum=0.9):
+        super().__init__()
+        self.lr = lr
+        self.momentum = momentum
+
+    def _base_transform(self):
+        # chainer momentum: v = m*v - lr*g ; p += v  == optax.trace(decay=m)
+        return optax.trace(decay=self.momentum)
+
+
+class NesterovAG(GradientMethod):
+    def __init__(self, lr=0.01, momentum=0.9):
+        super().__init__()
+        self.lr = lr
+        self.momentum = momentum
+
+    def _base_transform(self):
+        return optax.trace(decay=self.momentum, nesterov=True)
+
+
+class Adam(GradientMethod):
+    """Adam (reference: ``chainer/optimizers/adam.py``).
+
+    ``alpha`` is the step size as in the reference; ``lr`` is the bias-
+    corrected effective rate.  ``weight_decay_rate`` gives AdamW behavior.
+    """
+
+    _dynamic_hyper = ("lr",)
+
+    def __init__(self, alpha=0.001, beta1=0.9, beta2=0.999, eps=1e-8,
+                 weight_decay_rate=0.0, amsgrad=False):
+        super().__init__()
+        self.alpha = alpha
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.eps = eps
+        self.weight_decay_rate = weight_decay_rate
+        self.amsgrad = amsgrad
+
+    @property
+    def lr(self):
+        # optax.scale_by_adam already applies bias correction, so the
+        # traced step multiplies by alpha directly.
+        return self.alpha
+
+    @lr.setter
+    def lr(self, value):
+        self.alpha = value
+
+    def _base_transform(self):
+        parts = [optax.scale_by_adam(b1=self.beta1, b2=self.beta2,
+                                     eps=self.eps, nesterov=False)
+                 if not self.amsgrad else
+                 optax.scale_by_amsgrad(b1=self.beta1, b2=self.beta2, eps=self.eps)]
+        if self.weight_decay_rate:
+            parts.append(optax.add_decayed_weights(self.weight_decay_rate))
+        return optax.chain(*parts)
+
+
+class AdamW(Adam):
+    def __init__(self, alpha=0.001, beta1=0.9, beta2=0.999, eps=1e-8,
+                 weight_decay_rate=0.01):
+        super().__init__(alpha, beta1, beta2, eps, weight_decay_rate)
+
+
+class RMSprop(GradientMethod):
+    def __init__(self, lr=0.01, alpha=0.99, eps=1e-8):
+        super().__init__()
+        self.lr = lr
+        self.alpha = alpha
+        self.eps = eps
+
+    def _base_transform(self):
+        return optax.scale_by_rms(decay=self.alpha, eps=self.eps)
+
+
+class AdaGrad(GradientMethod):
+    def __init__(self, lr=0.001, eps=1e-8):
+        super().__init__()
+        self.lr = lr
+        self.eps = eps
+
+    def _base_transform(self):
+        return optax.scale_by_rss(initial_accumulator_value=0.0, eps=self.eps)
+
+
+class AdaDelta(GradientMethod):
+    def __init__(self, rho=0.95, eps=1e-6):
+        super().__init__()
+        self.lr = 1.0  # AdaDelta has no lr; scale by 1
+        self.rho = rho
+        self.eps = eps
+
+    def _base_transform(self):
+        return optax.scale_by_adadelta(rho=self.rho, eps=self.eps)

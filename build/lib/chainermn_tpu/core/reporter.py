@@ -1,0 +1,137 @@
+"""Observation reporting (consumed-Chainer surface: ``chainer.Reporter``).
+
+Reference: ``chainer/reporter.py · Reporter/report/report_scope`` (SURVEY.md
+§2.8, §5 metrics).  Extensions (LogReport/PrintReport) and the multi-node
+evaluator consume the observation dict this module builds.  Values may be
+``jax.Array`` scalars; ``Summary``/``DictSummary`` accumulate in float64 on
+host to keep aggregation out of compiled programs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+__all__ = ["Reporter", "report", "report_scope", "get_current_reporter",
+           "Summary", "DictSummary"]
+
+_thread_local = threading.local()
+
+
+def _reporter_stack():
+    if not hasattr(_thread_local, "stack"):
+        _thread_local.stack = []
+    return _thread_local.stack
+
+
+class Reporter:
+    """Collects named observations from registered observers."""
+
+    def __init__(self):
+        self._observer_names = {}
+        self.observation = {}
+
+    def add_observer(self, name, observer):
+        self._observer_names[id(observer)] = name
+
+    def add_observers(self, prefix, observers):
+        for name, observer in observers:
+            self._observer_names[id(observer)] = prefix + name
+
+    @contextlib.contextmanager
+    def scope(self, observation):
+        stack = _reporter_stack()
+        stack.append(self)
+        old = self.observation
+        self.observation = observation
+        try:
+            yield
+        finally:
+            self.observation = old
+            stack.pop()
+
+    def __enter__(self):
+        _reporter_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _reporter_stack().pop()
+
+    def report(self, values, observer=None):
+        if observer is not None:
+            observer_name = self._observer_names.get(id(observer))
+            if observer_name is None:
+                raise KeyError("observer is not registered: %r" % observer)
+            for key, value in values.items():
+                self.observation[f"{observer_name}/{key}"] = value
+        else:
+            self.observation.update(values)
+
+
+def get_current_reporter() -> Reporter:
+    stack = _reporter_stack()
+    if not stack:
+        stack.append(Reporter())
+    return stack[-1]
+
+
+def report(values, observer=None):
+    stack = _reporter_stack()
+    if stack:
+        stack[-1].report(values, observer)
+
+
+@contextlib.contextmanager
+def report_scope(observation):
+    with get_current_reporter().scope(observation):
+        yield
+
+
+class Summary:
+    """Online mean/std accumulator (reference: ``chainer.reporter.Summary``)."""
+
+    def __init__(self):
+        self._x = 0.0
+        self._x2 = 0.0
+        self._n = 0.0
+
+    def add(self, value, weight=1.0):
+        value = float(np.asarray(value))
+        self._x += weight * value
+        self._x2 += weight * value * value
+        self._n += weight
+
+    def compute_mean(self):
+        return self._x / self._n
+
+    def make_statistics(self):
+        mean = self._x / self._n
+        var = self._x2 / self._n - mean * mean
+        return mean, float(np.sqrt(max(var, 0.0)))
+
+    def serialize(self, serializer):
+        self._x = float(serializer("x", self._x))
+        self._x2 = float(serializer("x2", self._x2))
+        self._n = float(serializer("n", self._n))
+
+
+class DictSummary:
+    """Per-key ``Summary`` over observation dicts."""
+
+    def __init__(self):
+        self._summaries = {}
+
+    def add(self, d):
+        for key, value in d.items():
+            try:
+                arr = np.asarray(value)
+            except Exception:
+                continue
+            if arr.size != 1:
+                continue
+            self._summaries.setdefault(key, Summary()).add(arr)
+
+    def compute_mean(self):
+        return {k: s.compute_mean() for k, s in self._summaries.items()}

@@ -1,0 +1,66 @@
+"""Per-step randomness for stochastic layers under jit.
+
+The reference's ``F.dropout`` consumes a hidden global RNG — a new mask
+every call.  Under jit a naively-drawn key becomes a trace-time constant
+(same mask every step).  This module is the bridge: the compiled train
+step receives a fresh key as a *traced argument* each call and pushes it
+here; stochastic functions (``F.dropout``) draw deterministic subkeys via
+``fold_in`` on a per-trace counter — fresh randomness every step, zero
+recompilation, reproducible given the optimizer's seed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["push_key", "pop_key", "next_key", "key_scope"]
+
+_tl = threading.local()
+
+
+def _stack():
+    if not hasattr(_tl, "stack"):
+        _tl.stack = []
+    return _tl.stack
+
+
+class _KeyCtx:
+    __slots__ = ("key", "counter")
+
+    def __init__(self, key):
+        self.key = key
+        self.counter = 0
+
+
+def push_key(key):
+    _stack().append(_KeyCtx(key))
+
+
+def pop_key():
+    _stack().pop()
+
+
+class key_scope:
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        if self.key is not None:
+            push_key(self.key)
+        return self
+
+    def __exit__(self, *exc):
+        if self.key is not None:
+            pop_key()
+        return False
+
+
+def next_key():
+    """A fresh subkey from the innermost scope, or None outside any."""
+    stack = _stack()
+    if not stack:
+        return None
+    import jax
+    ctx = stack[-1]
+    ctx.counter += 1
+    return jax.random.fold_in(ctx.key, ctx.counter)

@@ -1,0 +1,12 @@
+from .datasets import (DatasetMixin, TupleDataset, DictDataset, SubDataset,
+                       TransformDataset, ConcatenatedDataset, split_dataset,
+                       split_dataset_random, get_mnist, get_cifar10,
+                       get_synthetic_imagenet)
+from .iterators import (Iterator, SerialIterator, MultiprocessIterator,
+                        MultithreadIterator)
+from .convert import concat_examples, to_device, identity_converter
+
+try:
+    from .native_iterator import NativeBatchIterator
+except Exception:  # pragma: no cover - no toolchain
+    NativeBatchIterator = None

@@ -1,0 +1,65 @@
+"""Batch assembly (consumed-Chainer surface: ``chainer.dataset.convert``).
+
+Reference: ``chainer/dataset/convert.py · concat_examples/to_device``.
+Batches are stacked on host with numpy; device placement happens once, at
+the jitted-step boundary (minimizing host↔HBM transfers — SURVEY §7 design
+stance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["concat_examples", "to_device", "identity_converter"]
+
+
+def identity_converter(batch, device=None):
+    """Pass-through converter for iterators that already emit stacked
+    arrays (``NativeBatchIterator``)."""
+    if device is not None:
+        return to_device(batch, device)
+    return batch
+
+
+def _stack(xs, padding=None):
+    first = xs[0]
+    if padding is None:
+        return np.stack([np.asarray(x) for x in xs])
+    shape = np.array(np.asarray(first).shape, dtype=int)
+    for x in xs[1:]:
+        shape = np.maximum(shape, np.asarray(x).shape)
+    out = np.full((len(xs),) + tuple(shape), padding,
+                  dtype=np.asarray(first).dtype)
+    for i, x in enumerate(xs):
+        x = np.asarray(x)
+        slices = tuple(slice(0, s) for s in x.shape)
+        out[(i,) + slices] = x
+    return out
+
+
+def concat_examples(batch, device=None, padding=None):
+    if not batch:
+        raise ValueError("batch is empty")
+    first = batch[0]
+    if isinstance(first, tuple):
+        result = tuple(
+            _stack([ex[i] for ex in batch],
+                   padding[i] if isinstance(padding, tuple) else padding)
+            for i in range(len(first)))
+    elif isinstance(first, dict):
+        result = {
+            key: _stack([ex[key] for ex in batch],
+                        padding[key] if isinstance(padding, dict) else padding)
+            for key in first}
+    else:
+        result = _stack(batch, padding)
+    if device is not None:
+        result = to_device(result, device)
+    return result
+
+
+def to_device(x, device=None):
+    dev = None if device in (None, -1, "@jax") else device
+    return jax.tree.map(lambda a: jax.device_put(a, dev), x)

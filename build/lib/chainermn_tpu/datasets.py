@@ -1,0 +1,96 @@
+"""Dataset scattering.
+
+Reference: ``chainermn/datasets.py · scatter_dataset, create_empty_dataset``
+(SURVEY.md §2.4, call stack §3.4).  The reference pickles per-rank
+``SubDataset`` specs over MPI (chunked at ~256 MiB).  Single-controller
+translation: ranks are devices driven by this process, so "scattering"
+ships no bytes — it returns an index-remapped view (permuted, padded by
+wrap-around to a multiple of ``comm.size`` so every rank's shard is equal
+length: the lock-step invariant that keeps collectives deadlock-free,
+SURVEY §7 hard-parts).  Multi-host, each controller gets its contiguous
+slice of the padded order; the order is agreed via the object channel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .dataset.datasets import SubDataset
+
+__all__ = ["scatter_dataset", "create_empty_dataset", "scatter_index",
+           "get_n_iterations_for_one_epoch"]
+
+
+def scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None,
+                    max_buf_len=256 * 1024 * 1024, force_equal_length=True):
+    """Return this host's equal-length shard of ``dataset``.
+
+    Reference signature preserved (``max_buf_len`` kept for parity; no
+    pickled transport exists to chunk on a single controller).  The shard
+    covers all devices this host drives — per-device slicing happens
+    inside the compiled step (shard_map splits the batch dimension), so
+    iterate with ``batchsize = per_rank_bs * comm.size``.
+    """
+    n = len(dataset)
+    if n == 0:
+        raise ValueError("cannot scatter an empty dataset")
+    size = comm.size
+    if shuffle:
+        if seed is None:
+            order = np.random.permutation(n)
+            order = comm.bcast_obj(order, root=root)
+        else:
+            order = np.random.RandomState(seed).permutation(n)
+    else:
+        order = np.arange(n)
+    if force_equal_length:
+        per_rank = -(-n // size)  # ceil
+        total = per_rank * size
+        if total > n:
+            # wrap-around padding (reference behavior) keeps shards equal
+            order = np.concatenate([order, order[: total - n]])
+    else:
+        total = (n // size) * size
+        order = order[:total]
+    n_hosts = max(comm.inter_size, 1)
+    host = comm.inter_rank
+    per_host = total // n_hosts
+    start, finish = host * per_host, (host + 1) * per_host
+    return SubDataset(dataset, start, finish, order=order)
+
+
+def scatter_index(n_total, comm, root=0):
+    """Reference ``chainermn.datasets.scatter_index``: evenly split
+    ``range(n_total)``; returns this host's (start, stop)."""
+    n_hosts = max(comm.inter_size, 1)
+    host = comm.inter_rank
+    per = -(-n_total // n_hosts)
+    return host * per, min((host + 1) * per, n_total)
+
+
+class _EmptyDataset:
+    def __init__(self, length):
+        self._length = length
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return [None] * len(range(*index.indices(self._length)))
+        if isinstance(index, (list, np.ndarray)):
+            return [None] * len(index)
+        if index < 0 or index >= self._length:
+            raise IndexError("dataset index out of range")
+        return None
+
+
+def create_empty_dataset(dataset):
+    """Same-length dataset of ``None``s (reference: ranks that feed no
+    data in model-parallel configurations still iterate in lock-step)."""
+    return _EmptyDataset(len(dataset))
+
+
+def get_n_iterations_for_one_epoch(dataset, local_batch_size, comm):
+    per_rank = -(-len(dataset) // comm.size)
+    return -(-per_rank // local_batch_size)

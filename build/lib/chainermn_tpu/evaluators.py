@@ -1,0 +1,40 @@
+"""Multi-node evaluator.
+
+Reference: ``chainermn/evaluators.py · create_multi_node_evaluator``
+(SURVEY.md §2.4): patches an ``Evaluator`` so every rank's local metric
+dict is allreduce-averaged, making report/trigger logic behave identically
+everywhere.
+
+Single-controller translation: evaluation runs once per *host* over the
+host's data shard; the average is taken across hosts (``allreduce_obj``
+over DCN when multi-host; identity on one host, where local metrics
+already cover all local devices' data).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["create_multi_node_evaluator"]
+
+
+def create_multi_node_evaluator(actual_evaluator, communicator):
+    """Patch ``actual_evaluator.evaluate`` in place (reference behavior:
+    returns the same object with a wrapped ``evaluate``)."""
+
+    actual_evaluator._mn_original_evaluate = actual_evaluator.evaluate
+    actual_evaluator._mn_communicator = communicator
+
+    def evaluate():
+        local = actual_evaluator._mn_original_evaluate()
+        comm = actual_evaluator._mn_communicator
+        gathered = comm.allgather_obj({k: float(np.asarray(v))
+                                       for k, v in local.items()})
+        keys = set()
+        for d in gathered:
+            keys.update(d)
+        return {k: float(np.mean([d[k] for d in gathered if k in d]))
+                for k in keys}
+
+    actual_evaluator.evaluate = evaluate
+    return actual_evaluator

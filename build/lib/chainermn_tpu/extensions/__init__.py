@@ -1,0 +1,12 @@
+"""Distributed trainer extensions (reference: ``chainermn.extensions``)."""
+
+from .checkpoint import create_multi_node_checkpointer, _MultiNodeCheckpointer
+from .observation_aggregator import ObservationAggregator
+
+try:
+    from .orbax_checkpoint import OrbaxCheckpointer
+except Exception:  # pragma: no cover - orbax optional
+    OrbaxCheckpointer = None
+
+__all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer",
+           "ObservationAggregator", "OrbaxCheckpointer"]

@@ -1,0 +1,143 @@
+"""Distributed checkpointing with consensus resume.
+
+Reference: ``chainermn/extensions/checkpoint.py ·
+create_multi_node_checkpointer, _MultiNodeCheckpointer`` (SURVEY.md §2.4,
+call stack §3.5): every rank snapshots its own trainer state
+(``<name>.<iteration>.<rank>``) on a trigger, old generations are
+garbage-collected, and ``maybe_load`` allgathers each rank's available
+snapshot iterations, picks the newest iteration present on *all* ranks,
+and resumes everyone consistently — the fail-stop recovery contract
+(crash → relaunch → converge on the newest common checkpoint).
+
+Single-controller translation: one snapshot per *host* (``comm.inter_rank``
+— this process drives all its devices' state); the consensus allgather
+runs over the object channel (DCN multi-host, loopback single-host).
+Device-sharded arrays are pulled to host by the npz serializer; for
+pod-scale sharded state see ``chainermn_tpu.extensions.orbax_checkpoint``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+import time
+
+from ..serializers.npz import load_npz, save_npz
+from ..training.trainer import Extension
+
+__all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer"]
+
+
+def create_multi_node_checkpointer(comm, name="", cp_interval=5,
+                                   gc_interval=5, path=None):
+    """Reference-shaped factory.
+
+    ``cp_interval``: number of snapshot generations kept.  ``gc_interval``:
+    collection cadence — stale generations are removed once they number at
+    least ``gc_interval`` (batching deletes instead of one unlink per save).
+    """
+    return _MultiNodeCheckpointer(comm, name, cp_interval, gc_interval, path)
+
+
+class _MultiNodeCheckpointer(Extension):
+    trigger = (1, "epoch")
+    priority = -100  # after everything else mutated state this iteration
+
+    def __init__(self, comm, name, cp_interval, gc_interval, path):
+        self.comm = comm
+        self.name = name
+        self.cp_interval = cp_interval
+        self.gc_interval = gc_interval
+        self.path = path
+        self.stats = {"snapshots": 0, "gc": 0, "save_time": 0.0}
+        self._files = []
+
+    @property
+    def rank(self):
+        return self.comm.inter_rank
+
+    def _dir(self, trainer=None):
+        if self.path is not None:
+            return self.path
+        assert trainer is not None
+        return trainer.out
+
+    def _filename(self, iteration):
+        return f"{self.name}.{iteration}.{self.rank}"
+
+    _pattern = property(lambda self: re.compile(
+        re.escape(self.name) + r"\.(\d+)\.(\d+)$"))
+
+    # -- save -------------------------------------------------------------
+    def __call__(self, trainer):
+        self.save(trainer, trainer.updater.iteration)
+
+    def save(self, trainer, iteration):
+        start = time.time()
+        out = self._dir(trainer)
+        os.makedirs(out, exist_ok=True)
+        fname = self._filename(iteration)
+        fd, tmp = tempfile.mkstemp(prefix=fname, dir=out)
+        os.close(fd)
+        try:
+            save_npz(tmp, trainer)
+        except Exception:
+            os.remove(tmp)
+            raise
+        os.replace(tmp, os.path.join(out, fname))
+        self._files.append(fname)
+        self.stats["snapshots"] += 1
+        self.stats["save_time"] += time.time() - start
+        if len(self._files) >= self.cp_interval + self.gc_interval:
+            self._gc(out)
+
+    def _gc(self, out):
+        keep = sorted(self._files,
+                      key=lambda f: int(self._pattern.match(f).group(1)))
+        stale, keep = keep[: -self.cp_interval], keep[-self.cp_interval:]
+        for fname in stale:
+            try:
+                os.remove(os.path.join(out, fname))
+                self.stats["gc"] += 1
+            except OSError:
+                pass
+        self._files = keep
+
+    # -- consensus resume ---------------------------------------------------
+    def maybe_load(self, trainer, optimizer=None, path=None):
+        """Resume from the newest iteration *every* rank has a snapshot of.
+
+        Reference semantics: local scan → allgather of iteration sets →
+        max of the intersection → ``load_npz`` on each rank's own file.
+        Returns the resumed iteration or None.
+        """
+        out = path or self._dir(trainer)
+        local = self._scan(out)
+        all_sets = self.comm.allgather_obj(sorted(local))
+        common = set(all_sets[0])
+        for s in all_sets[1:]:
+            common &= set(s)
+        if not common:
+            return None
+        iteration = max(common)
+        load_npz(os.path.join(out, self._filename(iteration)), trainer,
+                 strict=False)
+        self._files = [self._filename(i) for i in sorted(local)]
+        return iteration
+
+    def _scan(self, out):
+        iterations = set()
+        if not os.path.isdir(out):
+            return iterations
+        for fname in os.listdir(out):
+            m = self._pattern.match(fname)
+            if m and int(m.group(2)) == self.rank:
+                iterations.add(int(m.group(1)))
+        return iterations
+
+    def finalize(self):
+        pass
+
+    def serialize(self, serializer):
+        pass

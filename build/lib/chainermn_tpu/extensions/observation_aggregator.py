@@ -1,0 +1,39 @@
+"""Observation aggregation across ranks.
+
+Reference: ``chainermn/extensions/observation_aggregator.py ·
+ObservationAggregator`` (SURVEY.md §5 metrics note; chainer ≥ v6):
+allreduce-averages chosen training observations each interval so rank-0
+logs reflect the whole job.
+
+Here the compiled multi-node train step already pmeans in-forward
+observations across devices; this extension covers the *host* level
+(multi-host metric agreement) and arbitrary host-computed observations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..training.trainer import Extension, PRIORITY_EDITOR
+
+__all__ = ["ObservationAggregator"]
+
+
+class ObservationAggregator(Extension):
+    trigger = (1, "iteration")
+    priority = PRIORITY_EDITOR  # after writers, before readers (LogReport)
+
+    def __init__(self, comm, original_key, aggregated_key=None,
+                 aggregator=None):
+        self.comm = comm
+        self.original_key = original_key
+        self.aggregated_key = aggregated_key or original_key
+        self.aggregator = aggregator or (lambda xs: float(np.mean(xs)))
+
+    def __call__(self, trainer):
+        obs = trainer.observation
+        if self.original_key not in obs:
+            return
+        value = float(np.asarray(obs[self.original_key]))
+        gathered = self.comm.allgather_obj(value)
+        obs[self.aggregated_key] = self.aggregator(gathered)

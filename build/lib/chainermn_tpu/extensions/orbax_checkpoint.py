@@ -1,0 +1,70 @@
+"""Orbax-backed checkpointing for sharded pod-scale state.
+
+SURVEY.md §5 checkpoint note: "orbax-style sharded checkpoint of the
+jitted train state; keep the consensus-resume semantic".  The npz
+checkpointer (``extensions.checkpoint``) is the reference-parity path
+(per-host files, host-gathered arrays); this wrapper writes device-
+sharded pytrees directly — each host persists only its shards, restore
+re-places them — which is the right mechanics once models outgrow one
+host's memory.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..core.link import extract_state, load_param_tree, _persistent_slots
+
+__all__ = ["OrbaxCheckpointer"]
+
+
+class OrbaxCheckpointer:
+    def __init__(self, directory, max_to_keep=3):
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    # -- raw pytrees -------------------------------------------------------
+    def save(self, step, pytree):
+        self._manager.save(step, args=self._ocp.args.StandardSave(pytree))
+        self._manager.wait_until_finished()
+
+    def restore(self, step=None, template=None):
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if template is not None:
+            return self._manager.restore(
+                step, args=self._ocp.args.StandardRestore(template))
+        return self._manager.restore(step)
+
+    def latest_step(self):
+        return self._manager.latest_step()
+
+    def all_steps(self):
+        return list(self._manager.all_steps())
+
+    # -- links -------------------------------------------------------------
+    def save_link(self, step, link):
+        self.save(step, extract_state(link))
+
+    def restore_link(self, link, step=None):
+        state = self.restore(step, template=extract_state(link))
+        if state is None:
+            return False
+        load_param_tree(link, state["params"])
+        slots = {full: (sublink, name)
+                 for sublink, name, full in _persistent_slots(link)}
+        for path, value in state.get("state", {}).items():
+            if path in slots:
+                sublink, name = slots[path]
+                object.__setattr__(sublink, name, value)
+                sublink._persistent[name] = value
+        return True
+
+    def close(self):
+        self._manager.close()

@@ -1,0 +1,10 @@
+"""Differentiable communication ops (reference: ``chainermn.functions``)."""
+
+from .point_to_point_communication import (point_to_point, send, recv,
+                                           pseudo_connect)
+from .collective_communication import (allgather, alltoall, bcast, gather,
+                                       scatter, allreduce, psum_gradient)
+
+__all__ = ["point_to_point", "send", "recv", "pseudo_connect",
+           "allgather", "alltoall", "bcast", "gather", "scatter",
+           "allreduce", "psum_gradient"]

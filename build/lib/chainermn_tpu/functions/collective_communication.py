@@ -1,0 +1,119 @@
+"""Differentiable collective communication.
+
+Reference: ``chainermn/functions/collective_communication.py · AllGather,
+AllToAll, Bcast, Gather, Scatter, Allreduce`` (SURVEY.md §2.2) — each a
+FunctionNode whose backward performs the transposed communication
+(allgather ↔ reduce-scatter-sum, bcast ↔ gather+sum-to-root,
+alltoall ↔ alltoall).
+
+Here each op is a plain function over ``lax`` collectives used inside a
+``shard_map``ped program; JAX's AD transposition inserts exactly the
+reference's backward collectives, so no hand-written backward exists to
+get wrong.  These are the building blocks for tensor/hybrid parallelism
+(reference ``examples/parallel_convolution``) and the long-context layers
+(``parallel/ring_attention.py``, ``parallel/ulysses.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["allgather", "alltoall", "bcast", "gather", "scatter",
+           "allreduce", "psum_gradient"]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _psum_grad(x, axis_name):
+    return x
+
+
+def _psum_grad_fwd(x, axis_name):
+    return x, None
+
+
+def _psum_grad_bwd(axis_name, _, g):
+    return (lax.pmean(g, axis_name),)
+
+
+_psum_grad.defvjp(_psum_grad_fwd, _psum_grad_bwd)
+
+
+def psum_gradient(communicator, x):
+    """Identity forward, gradient allreduce backward.
+
+    The "copy into tensor-parallel region" primitive: a replicated tensor
+    consumed shard-wise by different ranks (each slicing its block) has
+    per-rank cotangents covering only that rank's slice; the backward
+    allreduce reassembles the full replicated gradient.
+
+    Scaling contract: this framework's SPMD convention is that the loss is
+    computed *redundantly on every rank* (MultiNodeChainList broadcasts
+    the terminal output; DP losses are per-shard means).  Under that
+    convention collective transposes already multiply cotangents by the
+    rank count, so the reassembly here is a ``pmean`` — the result equals
+    the single-process gradient exactly.
+    """
+    return _psum_grad(x, communicator.axis_name)
+
+
+def allgather(communicator, x):
+    """Every rank's ``x`` as a tuple (reference returns a list of size
+    variables).  Backward: each rank receives the summed shard gradients —
+    JAX's all_gather transpose (dynamic-slice + reduce-scatter-sum)."""
+    gathered = lax.all_gather(x, communicator.axis_name)
+    return tuple(gathered[i] for i in range(communicator.size))
+
+
+def alltoall(communicator, xs):
+    """Scatter a per-destination tuple, gather per-source (reference
+    AllToAll).  Backward is the reverse alltoall."""
+    if isinstance(xs, (tuple, list)):
+        if len(xs) != communicator.size:
+            raise ValueError(
+                f"alltoall expects {communicator.size} slices, got {len(xs)}")
+        xs = jnp.stack(list(xs))
+    out = lax.all_to_all(xs, communicator.axis_name,
+                         split_axis=0, concat_axis=0, tiled=False)
+    return tuple(out[i] for i in range(communicator.size))
+
+
+def bcast(communicator, x, root=0):
+    """Root's ``x`` on every rank.  Backward: gradients gather-summed to
+    root (transpose of the masked psum)."""
+    idx = lax.axis_index(communicator.axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, communicator.axis_name)
+
+
+def gather(communicator, x, root=0):
+    """All ranks' values as a tuple (meaningful on root; SPMD computes it
+    everywhere — the compiler drops unused results on other ranks)."""
+    gathered = lax.all_gather(x, communicator.axis_name)
+    return tuple(gathered[i] for i in range(communicator.size))
+
+
+def scatter(communicator, xs, root=0):
+    """Rank ``root`` holds a per-destination tuple; each rank gets its
+    slice.  Backward: gradients gathered back to root."""
+    if isinstance(xs, (tuple, list)):
+        xs = jnp.stack(list(xs))
+    from_root = bcast(communicator, xs, root)
+    idx = lax.axis_index(communicator.axis_name)
+    return jnp.take(from_root, idx, axis=0)
+
+
+def allreduce(communicator, x, op="sum"):
+    """Elementwise reduction across ranks on every rank.
+
+    Backward: the gradient is itself allreduced (reference Allreduce
+    backward) — automatic via psum's self-transpose.
+    """
+    if op == "sum":
+        return lax.psum(x, communicator.axis_name)
+    if op == "mean":
+        return lax.pmean(x, communicator.axis_name)
+    raise ValueError(f"unsupported op {op!r}")

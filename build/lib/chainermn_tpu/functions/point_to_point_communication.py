@@ -1,0 +1,109 @@
+"""Differentiable point-to-point communication.
+
+Reference: ``chainermn/functions/point_to_point_communication.py · Send,
+Recv, send, recv, pseudo_connect`` (SURVEY.md §2.2, call stack §3.3).
+
+The reference's machinery exists because its backward pass must *trigger*
+communication imperatively: ``Send.forward`` posts an MPI send and returns
+a zero-size **delegate variable** whose ``backward`` blocks on a recv of
+the gradient; delegates thread the per-process graphs together so
+``loss.backward()`` on the last pipeline stage transitively drives every
+stage (MPMD).
+
+The TPU rebuild is SPMD: every rank traces the *same* program, and a
+transfer is one ``lax.ppermute`` with a statically-known ``(src, dst)``
+edge.  JAX's AD transposes ``ppermute`` automatically (cotangents flow
+along the reversed edge), so the reference's hard part — "backward
+triggers a recv" (SURVEY §7) — dissolves: gradient communication is just
+the transposed collective XLA inserts.  ``send``/``recv``/delegate
+variables are kept as the user-facing vocabulary: ``send`` performs the
+transfer and stashes the in-flight traced value on the communicator
+(keyed by ``(tag, src, dst)``), ``recv`` claims it, and the delegate keeps
+reference code shapes working (including ``pseudo_connect`` fan-in).
+
+SPMD deviation from the reference, by design: both endpoints appear in the
+one traced program, so ``send``/``recv`` take the static pair (``dst`` and
+``src``); inside ``MultiNodeChainList`` these come from the registered
+``rank_in``/``rank_out`` topology exactly as the reference's do.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["point_to_point", "send", "recv", "pseudo_connect"]
+
+
+def point_to_point(x, communicator, src, dst):
+    """One transfer edge: rank ``src``'s ``x`` arrives on rank ``dst``.
+
+    Other ranks receive zeros (they still participate in the collective —
+    SPMD lock-step).  Differentiable: the transpose is the reversed edge.
+    """
+    perm = [(int(src), int(dst))]
+    return lax.ppermute(x, communicator.axis_name, perm)
+
+
+def send(x, communicator, rank, *, self_rank, tag=0):
+    """Send ``x`` to ``rank``; returns a zero-size delegate variable.
+
+    ``self_rank`` is the static rank of the sending stage (the reference
+    learns it from the process; SPMD needs it stated — MultiNodeChainList
+    supplies it from its topology table).
+    """
+    y = point_to_point(x, communicator, self_rank, rank)
+    stash = _stash(communicator)
+    stash.setdefault((tag, int(self_rank), int(rank)), []).append(y)
+    # zero-size delegate: carries graph connectivity, no payload
+    flat = jnp.ravel(y)
+    return jnp.sum(flat) * 0.0
+
+
+def recv(communicator, rank, delegate_variable=None, *, self_rank, tag=0,
+         force_tuple=False):
+    """Receive the value sent from ``rank`` to ``self_rank``.
+
+    If ``delegate_variable`` is given, it is fused into the result so the
+    local graph stays connected through prior sends (reference Recv
+    semantics with ``delegate_variable=``).
+    """
+    stash = _stash(communicator)
+    key = (tag, int(rank), int(self_rank))
+    queue = stash.get(key)
+    if not queue:
+        raise RuntimeError(
+            f"recv from rank {rank} to {self_rank} (tag {tag}) with no "
+            f"matching send in this traced program; SPMD send/recv pairs "
+            f"must both appear in one compiled step")
+    y = queue.pop(0)
+    if delegate_variable is not None:
+        y = pseudo_connect(delegate_variable, y)
+    return (y,) if force_tuple else y
+
+
+def pseudo_connect(delegate_variable, *actual_variables):
+    """Fuse a delegate into actual variables (reference: ``pseudo_connect``).
+
+    Adds a zero-valued dependency on the delegate so backward traverses the
+    send edge even when the sender's output is not otherwise used locally.
+    """
+    if not actual_variables:
+        return delegate_variable
+    zero = jnp.sum(jnp.ravel(delegate_variable)) * 0.0
+    connected = tuple(v + zero.astype(v.dtype) for v in actual_variables)
+    return connected[0] if len(connected) == 1 else connected
+
+
+def _stash(communicator):
+    # trace-scoped in-flight transfers; cleared per compiled call by the
+    # launching wrapper (run_spmd / MultiNodeChainList)
+    stash = getattr(communicator, "_p2p_stash", None)
+    if stash is None:
+        stash = {}
+        communicator._p2p_stash = stash
+    return stash
+
+
+def clear_stash(communicator):
+    communicator._p2p_stash = {}

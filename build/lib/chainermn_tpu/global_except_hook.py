@@ -1,0 +1,80 @@
+"""Global exception hook — fail-stop crash propagation.
+
+Reference: ``chainermn/global_except_hook.py · _add_hook_if_enabled``
+(SURVEY.md §2.4, §5 failure-detection): an uncaught exception on any rank
+prints its traceback and aborts the whole MPI job, so surviving ranks die
+loudly instead of deadlocking inside a collective.
+
+TPU translation: one controller per host; an uncaught exception here
+prints the traceback, asks the JAX distributed runtime to shut down (so
+the coordinator notifies peers), and hard-exits non-zero.  Peer hosts
+blocked in a DCN/ICI collective then fail fast instead of hanging —
+the same fail-stop contract; recovery is relaunch + the checkpointer's
+``maybe_load`` consensus (SURVEY §3.5).
+
+Enabled automatically on import when multi-host (mirroring the reference's
+env-gated install); force with ``CHAINERMN_TPU_FORCE_ABORT_ON_EXCEPTION=1``
+or disable with ``=0``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+__all__ = ["add_hook", "_add_hook_if_enabled"]
+
+_hook_installed = False
+
+
+def add_hook():
+    """Install the except hook (idempotent)."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    _hook_installed = True
+    original = sys.excepthook
+
+    def _hook(exc_type, exc_value, exc_traceback):
+        try:
+            import jax
+            host = jax.process_index()
+        except Exception:
+            host = -1
+        sys.stderr.write(
+            f"chainermn_tpu: uncaught exception on host {host} — "
+            f"aborting the distributed job (fail-stop)\n")
+        traceback.print_exception(exc_type, exc_value, exc_traceback)
+        sys.stderr.flush()
+        try:
+            import jax
+            if jax.process_count() > 1:
+                jax.distributed.shutdown()
+        except Exception:
+            pass
+        if exc_type is KeyboardInterrupt:
+            original(exc_type, exc_value, exc_traceback)
+            return
+        os._exit(1)
+
+    sys.excepthook = _hook
+
+
+def _add_hook_if_enabled():
+    flag = os.environ.get("CHAINERMN_TPU_FORCE_ABORT_ON_EXCEPTION")
+    if flag == "0":
+        return
+    if flag == "1":
+        add_hook()
+        return
+    # Auto-install only when the distributed runtime is already up.
+    # Deliberately avoids jax.process_count(): that would force backend
+    # initialization as an import side effect (slow, and wrong for
+    # processes that configure platforms after import).
+    try:
+        from jax._src import distributed
+        if getattr(distributed.global_state, "client", None) is not None:
+            add_hook()
+    except Exception:
+        pass

@@ -1,0 +1,123 @@
+"""Multi-node iterators.
+
+Reference: ``chainermn/iterators.py · create_multi_node_iterator,
+create_synchronized_iterator`` (SURVEY.md §2.4):
+
+* ``create_multi_node_iterator`` — the master rank runs the real iterator
+  and broadcasts each batch; replicas yield the received batch.  Used when
+  all ranks must see the *same* batch (model parallelism).
+* ``create_synchronized_iterator`` — synchronizes RNG state across ranks
+  so each rank's local iterator draws identical shuffles.
+
+Single-controller translation: within one host, every device trivially
+sees the controller's batch, so both wrappers are about *host*-level
+agreement: batches (resp. RNG seeds) are shipped over the object channel
+when ``inter_size > 1`` and are pass-through on one host — same
+observable contract, zero cost where the topology makes it free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .dataset.iterators import Iterator
+
+__all__ = ["create_multi_node_iterator", "create_synchronized_iterator"]
+
+
+class _MultiNodeIterator(Iterator):
+    def __init__(self, actual_iterator, communicator, rank_master=0):
+        self.comm = communicator
+        self.rank_master = rank_master
+        self.actual_iterator = actual_iterator
+
+    @property
+    def _is_master(self):
+        return self.comm.inter_rank == self.rank_master
+
+    def __next__(self):
+        if self.comm.inter_size <= 1:
+            return self.actual_iterator.next()
+        if self._is_master:
+            try:
+                batch = self.actual_iterator.next()
+                payload = ("batch", batch,
+                           self.actual_iterator.epoch,
+                           self.actual_iterator.is_new_epoch,
+                           self.actual_iterator.epoch_detail,
+                           self.actual_iterator.previous_epoch_detail)
+            except StopIteration:
+                payload = ("stop", None, None, None, None, None)
+            payload = self.comm.bcast_obj(payload, root=self.rank_master)
+        else:
+            payload = self.comm.bcast_obj(None, root=self.rank_master)
+        kind, batch, epoch, is_new_epoch, detail, prev_detail = payload
+        if kind == "stop":
+            raise StopIteration
+        self._epoch = epoch
+        self._is_new_epoch = is_new_epoch
+        self._epoch_detail = detail
+        self._previous_epoch_detail = prev_detail
+        return batch
+
+    next = __next__
+
+    @property
+    def epoch(self):
+        if self.comm.inter_size <= 1 or self._is_master:
+            return self.actual_iterator.epoch
+        return getattr(self, "_epoch", 0)
+
+    @property
+    def is_new_epoch(self):
+        if self.comm.inter_size <= 1 or self._is_master:
+            return self.actual_iterator.is_new_epoch
+        return getattr(self, "_is_new_epoch", False)
+
+    @property
+    def epoch_detail(self):
+        if self.comm.inter_size <= 1 or self._is_master:
+            return self.actual_iterator.epoch_detail
+        # replicas never advance their local iterator — epoch progress is
+        # part of the broadcast payload so 'epoch'-unit triggers stay in
+        # lock-step with the master (collective-bearing extensions depend
+        # on every host firing together)
+        return getattr(self, "_epoch_detail", 0.0)
+
+    @property
+    def previous_epoch_detail(self):
+        if self.comm.inter_size <= 1 or self._is_master:
+            return self.actual_iterator.previous_epoch_detail
+        return getattr(self, "_previous_epoch_detail", -1.0)
+
+    def reset(self):
+        if hasattr(self.actual_iterator, "reset"):
+            self.actual_iterator.reset()
+
+    def serialize(self, serializer):
+        self.actual_iterator.serialize(serializer)
+
+    def finalize(self):
+        self.actual_iterator.finalize()
+
+
+def create_multi_node_iterator(actual_iterator, communicator, rank_master=0):
+    return _MultiNodeIterator(actual_iterator, communicator, rank_master)
+
+
+def create_synchronized_iterator(actual_iterator, communicator):
+    """Agree on RNG state across hosts so local shuffles are identical.
+
+    The master's seed is broadcast and every host's iterator RNG is
+    re-seeded with it (reference: RNG state synchronization), then the
+    order is regenerated.
+    """
+    rng = getattr(actual_iterator, "_rng", None)
+    if rng is not None:
+        seed = int(np.random.RandomState().randint(0, 2**31 - 1)) \
+            if communicator.inter_rank == 0 else None
+        seed = communicator.bcast_obj(seed, root=0)
+        actual_iterator._rng = np.random.RandomState(seed)
+        if hasattr(actual_iterator, "reset"):
+            actual_iterator.reset()
+    return actual_iterator

@@ -1,0 +1,9 @@
+"""Distributed model components (reference: ``chainermn.links``)."""
+
+from .multi_node_chain_list import MultiNodeChainList
+from .batch_normalization import MultiNodeBatchNormalization
+from .create_mnbn_model import create_mnbn_model
+from .parallel_convolution import ParallelConvolution2D
+
+__all__ = ["MultiNodeChainList", "MultiNodeBatchNormalization",
+           "create_mnbn_model", "ParallelConvolution2D"]

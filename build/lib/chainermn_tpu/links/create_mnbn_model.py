@@ -1,0 +1,51 @@
+"""BatchNormalization → MultiNodeBatchNormalization rewrite.
+
+Reference: ``chainermn/links/create_mnbn_model.py · create_mnbn_model``
+(SURVEY.md §2.3): recursively rewrites a model, replacing every
+``BatchNormalization`` with the multi-node version so existing
+single-device model code gains global-batch statistics unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.links import BatchNormalization
+from .batch_normalization import MultiNodeBatchNormalization
+
+__all__ = ["create_mnbn_model"]
+
+
+def create_mnbn_model(link, comm):
+    """Return a copy of ``link`` with every BN replaced by sync-BN."""
+    model = copy.deepcopy(link)
+    _replace(model, comm)
+    return model
+
+
+def _replace(link, comm):
+    for name, child in list(link._children.items()):
+        if isinstance(child, BatchNormalization) and \
+                not isinstance(child, MultiNodeBatchNormalization):
+            mnbn = MultiNodeBatchNormalization(
+                child.size, comm, decay=child.decay, eps=child.eps,
+                use_gamma=child.use_gamma, use_beta=child.use_beta)
+            if child.use_gamma:
+                mnbn.gamma.array = child.gamma.array
+            if child.use_beta:
+                mnbn.beta.array = child.beta.array
+            mnbn.avg_mean = child.avg_mean
+            mnbn.avg_var = child.avg_var
+            mnbn.N = child.N
+            mnbn.name = name
+            link._children[name] = mnbn
+            object.__setattr__(link, name, mnbn)
+            # ChainList/Sequential also hold positional references
+            for attr in ("_chainlist", "_layers"):
+                seq = getattr(link, attr, None)
+                if seq is not None:
+                    for i, item in enumerate(seq):
+                        if item is child:
+                            seq[i] = mnbn
+        else:
+            _replace(child, comm)

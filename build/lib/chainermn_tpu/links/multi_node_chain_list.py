@@ -1,0 +1,181 @@
+"""Model-parallel chain composition.
+
+Reference: ``chainermn/links/multi_node_chain_list.py · MultiNodeChainList``
+(SURVEY.md §2.3, call stack §3.3): components registered with
+``add_link(chain, rank_in=, rank_out=)`` execute on their owner rank,
+receiving inputs from ``rank_in`` and sending outputs to ``rank_out`` via
+the differentiable point-to-point ops; fan-out/fan-in via rank lists;
+multi-head stitching via ``pseudo_connect``.
+
+SPMD translation (single controller): the reference is MPMD — each process
+constructs a chain list holding only *its* components.  Here one
+controller declares the whole topology: ``add_link`` takes the owning
+``rank`` explicitly (default: registration order, the common pipeline
+case).  ``forward`` runs as ONE program over the ``stage`` mesh axis:
+every rank traces every component (SPMD), transfer edges are
+``ppermute``s between statically-known (owner → consumer) pairs, and
+non-owner ranks' computations feed nothing and are dead-code-eliminated
+where XLA can prove it.  The terminal component's output is broadcast
+from its owner so every rank (and the host) sees the result — strictly
+more convenient than the reference's ``None`` on non-owners, and what the
+loss/optimizer path expects.
+
+The reference's sequential-per-minibatch schedule is reproduced here
+(SURVEY §3.3: no microbatching, bubble = (stages-1)/stages); the
+TPU-performance path with GPipe-style microbatching is
+``chainermn_tpu.parallel.pipeline``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..core.link import Chain
+from .. import functions as mnfn
+
+__all__ = ["MultiNodeChainList"]
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class MultiNodeChainList(Chain):
+    def __init__(self, comm):
+        super().__init__()
+        self._comm = comm
+        self._components = []  # (name, rank, rank_in, rank_out)
+        self._tag_counter = 0
+
+    def add_link(self, link, rank_in=None, rank_out=None, rank=None,
+                 pass_inputs=False):
+        """Register a component.
+
+        ``rank``: owner stage (default: registration order).  ``rank_in``:
+        rank(s) whose outputs feed this component (None → the original
+        inputs).  ``rank_out``: rank(s) consuming this component's output
+        (None → terminal output).  ``pass_inputs``: also forward the
+        original call inputs after the received values — the
+        single-controller stand-in for the reference pattern where a
+        downstream rank's own iterator feeds it side inputs (e.g. the
+        decoder's teacher-forcing batch).
+        """
+        index = len(self._components)
+        name = f"mn_component_{index}"
+        with self.init_scope():
+            setattr(self, name, link)
+        owner = index if rank is None else int(rank)
+        self._components.append((name, owner, _as_list(rank_in),
+                                 _as_list(rank_out), pass_inputs))
+        return link
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, *inputs):
+        comm = self._comm
+        from jax._src.core import get_axis_env
+        if get_axis_env().axis_exists(comm.axis_name):
+            # already inside a shard_map over the stage axis (e.g. the
+            # multi-node optimizer's compiled step) — emit edges directly
+            return self._forward_spmd(*inputs)
+        # Launch as a compiled SPMD program over the stage axis.  The
+        # current parameter/persistent arrays — possibly outer-jit tracers
+        # installed by an enclosing optimizer step — must enter the
+        # shard_map as explicit replicated ARGUMENTS: closing over outer
+        # tracers poisons the Manual mesh context (notably inside
+        # lax.scan bodies).
+        from ..core.link import bind_state, extract_state, _persistent_slots
+        state = extract_state(self)
+        n_in = len(inputs)
+
+        def fn(state, *args):
+            with bind_state(self, state) as handle:
+                out = self._forward_spmd(*args)
+                new_pstate = handle.collect()
+            return out, new_pstate
+
+        out, new_pstate = comm.run_spmd(
+            fn, state, *inputs,
+            in_specs=tuple(P() for _ in range(n_in + 1)),
+            out_specs=(P(), P()))
+        # re-install forward-mutated persistent values (BN stats inside
+        # pipeline stages) so an enclosing bind_state handle collects them
+        slots = {full: (sublink, name)
+                 for sublink, name, full in _persistent_slots(self)}
+        for path, value in new_pstate.items():
+            if path in slots:
+                sublink, name = slots[path]
+                object.__setattr__(sublink, name, value)
+                sublink._persistent[name] = value
+        return out
+
+    def _forward_spmd(self, *inputs):
+        comm = self._comm
+        from ..functions.point_to_point_communication import clear_stash
+        clear_stash(comm)
+        # per-producer output registry: owner rank → traced value
+        produced = {}
+        delegates = []
+        terminal = None
+        terminal_owner = None
+        for name, owner, rank_in, rank_out, pass_inputs in self._components:
+            link = getattr(self, name)
+            if rank_in is None:
+                x_in = inputs
+            else:
+                received = []
+                for src in rank_in:
+                    y = mnfn.recv(comm, src, self_rank=owner,
+                                  tag=self._edge_tag(src, owner))
+                    received.append(y)
+                x_in = tuple(received)
+                if pass_inputs:
+                    x_in = x_in + inputs
+            y = link(*x_in)
+            self._fix_persistent_to_owner(link, owner)
+            if rank_out is None:
+                if terminal is not None:
+                    raise ValueError(
+                        "multiple terminal components (rank_out=None); "
+                        "fan-in the graph explicitly instead")
+                terminal = y
+                terminal_owner = owner
+            else:
+                for dst in rank_out:
+                    delegate = mnfn.send(y, comm, dst, self_rank=owner,
+                                         tag=self._edge_tag(owner, dst))
+                    delegates.append(delegate)
+        if terminal is None:
+            raise ValueError("no terminal component (rank_out=None)")
+        # broadcast the terminal value from its owner so every rank (and
+        # the host) sees the result; fuse dangling delegates to keep all
+        # send edges on the backward path (pseudo_connect semantics)
+        out = mnfn.bcast(comm, terminal, root=terminal_owner)
+        for d in delegates:
+            out = mnfn.pseudo_connect(d, out)
+        return out
+
+    def _fix_persistent_to_owner(self, link, owner):
+        """Overwrite a component's forward-mutated persistent state (BN
+        running stats) with the owner rank's values.
+
+        SPMD ranks other than the owner execute the component on
+        zeros/garbage delivered by the transfer edges; without this
+        selection, any collector of persistent state could surface a
+        non-owner's corrupted statistics.
+        """
+        from ..core.link import _persistent_slots
+        for sublink, name, _ in _persistent_slots(link):
+            value = getattr(sublink, name)
+            if isinstance(value, jax.core.Tracer):
+                fixed = mnfn.bcast(self._comm, value, root=owner)
+                object.__setattr__(sublink, name, fixed)
+                sublink._persistent[name] = fixed
+
+    def _edge_tag(self, src, dst):
+        # one logical channel per (src, dst) edge; FIFO order of sends
+        # within the traced program matches recv order (reference MPI tag
+        # discipline)
+        return 0

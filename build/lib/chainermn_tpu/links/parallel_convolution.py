@@ -1,0 +1,86 @@
+"""Channel-parallel (tensor-parallel) convolution.
+
+Reference: ``examples/parallel_convolution/`` (SURVEY.md §2.6 TP row) —
+the reference's by-hand tensor parallelism: each rank owns a filter
+slice, computes its output-channel block, and the blocks are stitched
+with the differentiable ``allgather``.  Promoted from example to a
+first-class link here (the TPU mapping notes TP is "nearly free" — this
+link is the explicit-collective form; ``pjit`` sharding annotations on a
+plain ``Convolution2D`` are the automatic form).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.link import Link, Parameter
+from ..nn import functions as F
+from ..nn import initializers as I
+from .. import functions as mnfn
+
+__all__ = ["ParallelConvolution2D"]
+
+
+class ParallelConvolution2D(Link):
+    """Filter-split conv: rank r computes out-channel block r.
+
+    Inside a compiled step over ``comm``'s axis, each rank holds
+    ``out_channels // size`` filters (selected by ``axis_index``) and the
+    full output is assembled with the differentiable allgather; gradients
+    flow back to each rank's slice through the allgather transpose —
+    exactly the reference example's construction.
+
+    Eagerly (host mode) the full filter bank is applied directly
+    (single-controller: the controller owns all slices).
+    """
+
+    def __init__(self, comm, in_channels, out_channels, ksize, stride=1,
+                 pad=0, nobias=False, initialW=None, initial_bias=None,
+                 seed=None):
+        super().__init__()
+        if out_channels % comm.size != 0:
+            raise ValueError(
+                f"out_channels {out_channels} not divisible by "
+                f"comm.size {comm.size}")
+        self.comm = comm
+        self.out_channels = out_channels
+        self.stride = stride
+        self.pad = pad
+        self.nobias = nobias
+        rng = np.random.RandomState(seed) if seed is not None else np.random
+        initW = I._get_initializer(initialW, I.HeNormal())
+        initb = I._get_initializer(initial_bias, I.Zero())
+        kh, kw = (ksize, ksize) if np.isscalar(ksize) else ksize
+        with self.init_scope():
+            self.W = Parameter(initW((out_channels, in_channels, kh, kw),
+                                     np.float32, rng))
+            if not nobias:
+                self.b = Parameter(initb((out_channels,), np.float32, rng))
+
+    def forward(self, x):
+        comm = self.comm
+        from jax._src.core import get_axis_env
+        in_axis = comm.axis_name is not None and \
+            get_axis_env().axis_exists(comm.axis_name)
+        W = self.W.array
+        b = None if self.nobias else self.b.array
+        if not in_axis:
+            return F.convolution_2d(x, W, b, self.stride, self.pad)
+        # rank-local filter slice; psum_gradient reassembles the full
+        # replicated weight gradient from the per-rank slice cotangents
+        size = comm.size
+        block = self.out_channels // size
+        idx = jax.lax.axis_index(comm.axis_name)
+        W = mnfn.psum_gradient(comm, W)
+        if b is not None:
+            b = mnfn.psum_gradient(comm, b)
+        W_local = jax.lax.dynamic_slice_in_dim(W, idx * block, block, 0)
+        b_local = None if b is None else \
+            jax.lax.dynamic_slice_in_dim(b, idx * block, block, 0)
+        y_local = F.convolution_2d(x, W_local, b_local, self.stride,
+                                   self.pad)
+        blocks = mnfn.allgather(comm, y_local)
+        return jnp.concatenate(blocks, axis=1)

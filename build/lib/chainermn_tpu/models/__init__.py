@@ -1,0 +1,22 @@
+"""Model zoo (reference example model families, TPU-first designs)."""
+
+from .mlp import MLP, Classifier
+from .resnet import (ResNet, ResNet18, ResNet50, ResNet101,
+                     BottleneckBlock, BasicBlock)
+from .seq2seq import (Seq2seq, Encoder, Decoder, ModelParallelSeq2seq,
+                      create_model_parallel_seq2seq,
+                      make_synthetic_translation_data)
+from .dcgan import Generator, Discriminator, DCGANUpdater
+from .transformer import TransformerLM, TransformerBlock, MultiHeadAttention
+from .moe_transformer import (MoETransformerLM, MoETransformerBlock,
+                              MoEFeedForward)
+from .convnets import AlexNet, NIN, VGG16, GoogLeNet
+
+__all__ = ["MLP", "Classifier", "ResNet", "ResNet18", "ResNet50",
+           "ResNet101", "BottleneckBlock", "BasicBlock", "Seq2seq",
+           "Encoder", "Decoder", "ModelParallelSeq2seq",
+           "create_model_parallel_seq2seq",
+           "make_synthetic_translation_data", "Generator", "Discriminator",
+           "DCGANUpdater", "TransformerLM", "TransformerBlock",
+           "MultiHeadAttention", "MoETransformerLM", "MoETransformerBlock",
+           "MoEFeedForward", "AlexNet", "NIN", "VGG16", "GoogLeNet"]

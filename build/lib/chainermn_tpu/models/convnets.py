@@ -1,0 +1,193 @@
+"""Classic ImageNet convnets (reference ``examples/imagenet`` archs).
+
+The reference's ``train_imagenet.py --arch`` offered alex / nin /
+googlenet / resnet50; this module supplies the non-ResNet family —
+AlexNet, NIN, VGG16, and GoogLeNet (inception-v1) — as TPU-first NCHW
+``jnp`` programs (big static convs for the MXU, fused pools).
+"""
+
+from __future__ import annotations
+
+from ..core.link import Chain
+from ..nn import functions as F
+from ..nn import links as L
+
+__all__ = ["AlexNet", "NIN", "VGG16", "GoogLeNet"]
+
+
+class AlexNet(Chain):
+    """AlexNet (reference example ``alex.py``), 227×227 inputs."""
+
+    insize = 227
+
+    def __init__(self, n_classes=1000, seed=0):
+        super().__init__()
+        s = lambda k: seed + k
+        with self.init_scope():
+            self.conv1 = L.Convolution2D(3, 96, 11, stride=4, seed=s(0))
+            self.conv2 = L.Convolution2D(96, 256, 5, pad=2, seed=s(1))
+            self.conv3 = L.Convolution2D(256, 384, 3, pad=1, seed=s(2))
+            self.conv4 = L.Convolution2D(384, 384, 3, pad=1, seed=s(3))
+            self.conv5 = L.Convolution2D(384, 256, 3, pad=1, seed=s(4))
+            self.fc6 = L.Linear(None, 4096, seed=s(5))
+            self.fc7 = L.Linear(4096, 4096, seed=s(6))
+            self.fc8 = L.Linear(4096, n_classes, seed=s(7))
+
+    def forward(self, x):
+        h = F.max_pooling_2d(F.local_response_normalization(
+            F.relu(self.conv1(x))), 3, stride=2)
+        h = F.max_pooling_2d(F.local_response_normalization(
+            F.relu(self.conv2(h))), 3, stride=2)
+        h = F.relu(self.conv3(h))
+        h = F.relu(self.conv4(h))
+        h = F.max_pooling_2d(F.relu(self.conv5(h)), 3, stride=2)
+        h = F.dropout(F.relu(self.fc6(h)))
+        h = F.dropout(F.relu(self.fc7(h)))
+        return self.fc8(h)
+
+
+class NIN(Chain):
+    """Network-in-Network (reference example ``nin.py``), 227×227."""
+
+    insize = 227
+
+    def __init__(self, n_classes=1000, seed=0):
+        super().__init__()
+        s = lambda k: seed + k
+
+        def mlpconv(in_ch, out_ch, ksize, stride, pad, k):
+            return [L.Convolution2D(in_ch, out_ch, ksize, stride=stride,
+                                    pad=pad, seed=s(k)),
+                    L.Convolution2D(out_ch, out_ch, 1, seed=s(k + 1)),
+                    L.Convolution2D(out_ch, out_ch, 1, seed=s(k + 2))]
+
+        with self.init_scope():
+            for i, (layers, name) in enumerate(zip(
+                    [mlpconv(3, 96, 11, 4, 0, 0),
+                     mlpconv(96, 256, 5, 1, 2, 10),
+                     mlpconv(256, 384, 3, 1, 1, 20)],
+                    ["mlp1", "mlp2", "mlp3"])):
+                for j, layer in enumerate(layers):
+                    setattr(self, f"{name}_{j}", layer)
+            self.out_0 = L.Convolution2D(384, n_classes, 3, pad=1, seed=s(30))
+            self.out_1 = L.Convolution2D(n_classes, n_classes, 1, seed=s(31))
+            self.out_2 = L.Convolution2D(n_classes, n_classes, 1, seed=s(32))
+        self.n_classes = n_classes
+
+    def _mlp(self, prefix, h):
+        for j in range(3):
+            h = F.relu(getattr(self, f"{prefix}_{j}")(h))
+        return h
+
+    def forward(self, x):
+        h = F.max_pooling_2d(self._mlp("mlp1", x), 3, stride=2)
+        h = F.max_pooling_2d(self._mlp("mlp2", h), 3, stride=2)
+        h = F.max_pooling_2d(self._mlp("mlp3", h), 3, stride=2)
+        h = F.relu(self.out_0(h))
+        h = F.relu(self.out_1(h))
+        h = self.out_2(h)
+        return F.global_average_pooling_2d(h)
+
+
+class VGG16(Chain):
+    """VGG-16 (reference ``L.VGG16Layers`` shape), 224×224."""
+
+    insize = 224
+
+    def __init__(self, n_classes=1000, seed=0):
+        super().__init__()
+        cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+               (128, 256), (256, 256), (256, 256), "M",
+               (256, 512), (512, 512), (512, 512), "M",
+               (512, 512), (512, 512), (512, 512), "M"]
+        self._plan = []
+        with self.init_scope():
+            idx = 0
+            for item in cfg:
+                if item == "M":
+                    self._plan.append("M")
+                    continue
+                in_ch, out_ch = item
+                name = f"conv{idx}"
+                setattr(self, name, L.Convolution2D(in_ch, out_ch, 3,
+                                                    pad=1, seed=seed + idx))
+                self._plan.append(name)
+                idx += 1
+            self.fc6 = L.Linear(None, 4096, seed=seed + 100)
+            self.fc7 = L.Linear(4096, 4096, seed=seed + 101)
+            self.fc8 = L.Linear(4096, n_classes, seed=seed + 102)
+
+    def forward(self, x):
+        h = x
+        for item in self._plan:
+            if item == "M":
+                h = F.max_pooling_2d(h, 2, stride=2, cover_all=False)
+            else:
+                h = F.relu(getattr(self, item)(h))
+        h = F.dropout(F.relu(self.fc6(h)))
+        h = F.dropout(F.relu(self.fc7(h)))
+        return self.fc8(h)
+
+
+class _Inception(Chain):
+    """GoogLeNet inception block (1x1 / 3x3 / 5x5 / pool-proj)."""
+
+    def __init__(self, in_ch, c1, r3, c3, r5, c5, pp, seed=0):
+        super().__init__()
+        s = lambda k: seed + k
+        with self.init_scope():
+            self.b1 = L.Convolution2D(in_ch, c1, 1, seed=s(0))
+            self.b3r = L.Convolution2D(in_ch, r3, 1, seed=s(1))
+            self.b3 = L.Convolution2D(r3, c3, 3, pad=1, seed=s(2))
+            self.b5r = L.Convolution2D(in_ch, r5, 1, seed=s(3))
+            self.b5 = L.Convolution2D(r5, c5, 5, pad=2, seed=s(4))
+            self.bp = L.Convolution2D(in_ch, pp, 1, seed=s(5))
+
+    def forward(self, x):
+        a = F.relu(self.b1(x))
+        b = F.relu(self.b3(F.relu(self.b3r(x))))
+        c = F.relu(self.b5(F.relu(self.b5r(x))))
+        d = F.relu(self.bp(F.max_pooling_2d(x, 3, stride=1, pad=1,
+                                            cover_all=False)))
+        return F.concat([a, b, c, d], axis=1)
+
+
+class GoogLeNet(Chain):
+    """GoogLeNet / inception-v1 (reference example ``googlenet.py``),
+    224×224 (main head only; train-time aux heads omitted — modern
+    practice, and BN-free inception is already stable at these depths)."""
+
+    insize = 224
+
+    def __init__(self, n_classes=1000, seed=0):
+        super().__init__()
+        s = lambda k: seed + 1000 * k
+        with self.init_scope():
+            self.conv1 = L.Convolution2D(3, 64, 7, stride=2, pad=3,
+                                         seed=s(1))
+            self.conv2r = L.Convolution2D(64, 64, 1, seed=s(2))
+            self.conv2 = L.Convolution2D(64, 192, 3, pad=1, seed=s(3))
+            self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32, s(4))
+            self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64, s(5))
+            self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64, s(6))
+            self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64, s(7))
+            self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64, s(8))
+            self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64, s(9))
+            self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128, s(10))
+            self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128, s(11))
+            self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128, s(12))
+            self.fc = L.Linear(1024, n_classes, seed=s(13))
+
+    def forward(self, x):
+        h = F.max_pooling_2d(F.relu(self.conv1(x)), 3, stride=2, pad=1,
+                             cover_all=False)
+        h = F.relu(self.conv2(F.relu(self.conv2r(h))))
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        h = self.inc3b(self.inc3a(h))
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        h = self.inc4e(self.inc4d(self.inc4c(self.inc4b(self.inc4a(h)))))
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        h = self.inc5b(self.inc5a(h))
+        h = F.global_average_pooling_2d(h)
+        h = F.dropout(h, 0.4)
+        return self.fc(h)

@@ -1,0 +1,51 @@
+"""MLP + Classifier (BASELINE config #1 model; reference: the mnist
+example MLP and ``chainer.links.Classifier``)."""
+
+from __future__ import annotations
+
+from ..core.link import Chain
+from ..core import reporter
+from ..nn import functions as F
+from ..nn import links as L
+
+__all__ = ["MLP", "Classifier"]
+
+
+class MLP(Chain):
+    def __init__(self, n_units=1000, n_out=10, seed=0):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(None, n_units, seed=seed)
+            self.l2 = L.Linear(None, n_units,
+                               seed=None if seed is None else seed + 1)
+            self.l3 = L.Linear(None, n_out,
+                               seed=None if seed is None else seed + 2)
+
+    def forward(self, x):
+        h = F.relu(self.l1(x))
+        h = F.relu(self.l2(h))
+        return self.l3(h)
+
+
+class Classifier(Chain):
+    """Loss head (reference: ``L.Classifier``): wraps a predictor,
+    reports loss/accuracy."""
+
+    def __init__(self, predictor, lossfun=F.softmax_cross_entropy,
+                 accfun=F.accuracy):
+        super().__init__()
+        self.lossfun = lossfun
+        self.accfun = accfun
+        with self.init_scope():
+            self.predictor = predictor
+
+    def forward(self, *args):
+        *inputs, t = args
+        y = self.predictor(*inputs)
+        loss = self.lossfun(y, t)
+        if self.accfun is not None:
+            reporter.report({"loss": loss,
+                             "accuracy": self.accfun(y, t)}, self)
+        else:
+            reporter.report({"loss": loss}, self)
+        return loss

@@ -1,0 +1,147 @@
+"""Mixture-of-experts transformer LM (expert parallelism end to end).
+
+Beyond-reference model family: Switch-style MoE feed-forward blocks whose
+expert bank shards one-expert-per-rank over an ``ep`` mesh axis
+(``parallel.moe``), composed with the attention stack of
+``models.transformer``.  Inside a compiled step each rank slices its
+expert from the replicated bank (``functions.psum_gradient`` keeps the
+bank's gradients exact under the replicated-loss convention) and tokens
+are exchanged with one ``all_to_all`` round trip per layer.  Outside any
+mesh axis the layer degrades to dense top-1 routing — same math, no
+collectives — so the same weights run single-device and expert-parallel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.link import Chain, ChainList, Parameter
+from ..core import reporter
+from ..nn import functions as F
+from ..nn import links as L
+from .. import functions as mnfn
+from .transformer import MultiHeadAttention, _axis_bound
+
+__all__ = ["MoEFeedForward", "MoETransformerBlock", "MoETransformerLM"]
+
+
+class MoEFeedForward(Chain):
+    def __init__(self, d_model, d_ff, ep_comm, capacity_factor=1.25,
+                 seed=0):
+        super().__init__()
+        self.ep_comm = ep_comm
+        self.capacity_factor = capacity_factor
+        E = ep_comm.size
+        rng = np.random.RandomState(seed)
+        with self.init_scope():
+            self.router = Parameter(rng.normal(0, 0.02, (d_model, E))
+                                    .astype(np.float32))
+            self.w_in = Parameter(rng.normal(0, 0.02, (E, d_model, d_ff))
+                                  .astype(np.float32))
+            self.b_in = Parameter(np.zeros((E, d_ff), np.float32))
+            self.w_out = Parameter(rng.normal(0, 0.02, (E, d_ff, d_model))
+                                   .astype(np.float32))
+            self.b_out = Parameter(np.zeros((E, d_model), np.float32))
+
+    def forward(self, x, aux_sink=None):
+        B, T, D = x.shape
+        tokens = x.reshape(B * T, D)
+        comm = self.ep_comm
+        if _axis_bound(comm):
+            from ..parallel.moe import moe_dispatch_combine
+            # slice this rank's expert from the (replicated) bank;
+            # psum_gradient reassembles the bank's gradient exactly
+            idx = jax.lax.axis_index(comm.axis_name)
+            w_in = jax.lax.dynamic_index_in_dim(
+                mnfn.psum_gradient(comm, self.w_in.array), idx, 0, False)
+            b_in = jax.lax.dynamic_index_in_dim(
+                mnfn.psum_gradient(comm, self.b_in.array), idx, 0, False)
+            w_out = jax.lax.dynamic_index_in_dim(
+                mnfn.psum_gradient(comm, self.w_out.array), idx, 0, False)
+            b_out = jax.lax.dynamic_index_in_dim(
+                mnfn.psum_gradient(comm, self.b_out.array), idx, 0, False)
+            gate_logits = tokens @ self.router.array
+
+            def expert_fn(h):
+                return F.gelu(h @ w_in + b_in) @ w_out + b_out
+
+            out, aux = moe_dispatch_combine(
+                comm, tokens, gate_logits, expert_fn,
+                capacity_factor=self.capacity_factor)
+            if aux_sink is not None:
+                aux_sink.append(aux["aux_loss"])
+            return out.reshape(B, T, D)
+        # dense top-1 fallback (no mesh axis): every expert computed,
+        # argmax-selected per token — identical routing math
+        probs = jax.nn.softmax(tokens @ self.router.array, axis=-1)
+        eidx = jnp.argmax(probs, axis=-1)
+        gate = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+        h = jnp.einsum("td,edh->teh", tokens, self.w_in.array) \
+            + self.b_in.array[None]
+        y = jnp.einsum("teh,ehd->ted", F.gelu(h), self.w_out.array) \
+            + self.b_out.array[None]
+        out = jnp.take_along_axis(
+            y, eidx[:, None, None].repeat(D, axis=2), 1)[:, 0]
+        if aux_sink is not None:
+            E = comm.size
+            frac = jnp.mean(jax.nn.one_hot(eidx, E), axis=0)
+            aux_sink.append(E * jnp.sum(frac * jnp.mean(probs, axis=0)))
+        return (out * gate[:, None]).reshape(B, T, D)
+
+
+class MoETransformerBlock(Chain):
+    def __init__(self, d_model, n_heads, d_ff, ep_comm, seed=0,
+                 sp_comm=None, sp_mode="ring", capacity_factor=1.25):
+        super().__init__()
+        with self.init_scope():
+            self.ln1 = L.LayerNormalization(d_model)
+            self.attn = MultiHeadAttention(d_model, n_heads, seed=seed,
+                                           sp_comm=sp_comm, sp_mode=sp_mode)
+            self.ln2 = L.LayerNormalization(d_model)
+            self.moe = MoEFeedForward(d_model, d_ff, ep_comm,
+                                      capacity_factor, seed=seed + 50)
+
+    def forward(self, x, aux_sink=None, causal=True):
+        h = x + self.attn(self.ln1(x), causal=causal)
+        return h + self.moe(self.ln2(h), aux_sink=aux_sink)
+
+
+class MoETransformerLM(Chain):
+    """Causal LM with MoE feed-forwards; ``aux_weight`` scales the Switch
+    load-balancing loss added to the LM loss."""
+
+    def __init__(self, n_vocab, ep_comm, d_model=128, n_heads=4,
+                 n_layers=2, d_ff=None, max_len=2048, seed=0,
+                 aux_weight=0.01, capacity_factor=1.25):
+        super().__init__()
+        d_ff = d_ff or 4 * d_model
+        self.aux_weight = aux_weight
+        with self.init_scope():
+            self.embed = L.EmbedID(n_vocab, d_model, seed=seed)
+            self.pos_embed = L.EmbedID(max_len, d_model, seed=seed + 1)
+            self.blocks = ChainList(*[
+                MoETransformerBlock(d_model, n_heads, d_ff, ep_comm,
+                                    seed=seed + 100 * (i + 1),
+                                    capacity_factor=capacity_factor)
+                for i in range(n_layers)])
+            self.ln_f = L.LayerNormalization(d_model)
+            self.head = L.Linear(d_model, n_vocab, nobias=True,
+                                 seed=seed + 999)
+
+    def forward(self, x, t):
+        B, T = x.shape
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
+        aux_sink = []
+        for block in self.blocks:
+            h = block(h, aux_sink=aux_sink)
+        h = self.ln_f(h)
+        logits = self.head(h.reshape(B * T, -1))
+        loss = F.softmax_cross_entropy(logits, t.reshape(-1),
+                                       ignore_label=-1)
+        aux = sum(aux_sink) / max(len(aux_sink), 1)
+        reporter.report({"loss": loss, "moe_aux": aux}, self)
+        return loss + self.aux_weight * aux

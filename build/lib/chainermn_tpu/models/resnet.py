@@ -1,0 +1,202 @@
+"""ResNet family (the ImageNet vertical — BASELINE config #2).
+
+Reference capability: ``chainer.links.model.vision.resnet ·
+ResNet50Layers`` and ChainerMN's ``examples/imagenet/train_imagenet.py``
+(SURVEY.md §6: ResNet-50/ImageNet is the reference's headline benchmark).
+Freshly designed for TPU rather than transcribed:
+
+* NCHW activations feed ``lax.conv_general_dilated`` — XLA re-layouts
+  onto the MXU; all convs are large static-shape GEMM-like ops.
+* ``compute_dtype=bfloat16`` runs conv/matmul compute in bf16 (MXU-native)
+  with fp32 parameters and fp32 BN statistics — the TPU translation of the
+  reference era's fp16 training recipe.
+* Identity shortcuts use stride-slicing + channel-pad (option A) or
+  projection (option B, the ResNet-50 default), all fusible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.link import Chain, ChainList
+from ..nn import functions as F
+from ..nn import links as L
+
+__all__ = ["ResNet50", "ResNet18", "ResNet101", "BottleneckBlock",
+           "BasicBlock"]
+
+
+class ConvBN(Chain):
+    def __init__(self, in_ch, out_ch, ksize, stride=1, pad=0, seed=None):
+        super().__init__()
+        self.stride = stride
+        self.pad = pad
+        with self.init_scope():
+            self.conv = L.Convolution2D(in_ch, out_ch, ksize, stride=stride,
+                                        pad=pad, nobias=True, seed=seed)
+            self.bn = L.BatchNormalization(out_ch)
+
+    def forward(self, x, activate=True):
+        # conv compute in the activation dtype (bf16 on the MXU when the
+        # model casts), BN statistics in fp32, result back in x.dtype
+        W = self.conv.W.array.astype(x.dtype)
+        h = F.convolution_2d(x, W, None, self.stride, self.pad)
+        h = self.bn(h.astype(jnp.float32))
+        if activate:
+            h = F.relu(h)
+        return h.astype(x.dtype)
+
+
+class BottleneckBlock(Chain):
+    """1x1 → 3x3 → 1x1 bottleneck with optional projection shortcut."""
+
+    def __init__(self, in_ch, mid_ch, out_ch, stride=1, project=False,
+                 seed=0):
+        super().__init__()
+        self.project = project or in_ch != out_ch or stride != 1
+        with self.init_scope():
+            self.a = ConvBN(in_ch, mid_ch, 1, seed=seed)
+            self.b = ConvBN(mid_ch, mid_ch, 3, stride=stride, pad=1,
+                            seed=seed + 1)
+            self.c = ConvBN(mid_ch, out_ch, 1, seed=seed + 2)
+            if self.project:
+                self.shortcut = ConvBN(in_ch, out_ch, 1, stride=stride,
+                                       seed=seed + 3)
+
+    def forward(self, x):
+        h = self.a(x)
+        h = self.b(h)
+        h = self.c(h, activate=False)
+        s = self.shortcut(x, activate=False) if self.project else x
+        return F.relu(h + s)
+
+
+class BasicBlock(Chain):
+    """3x3 → 3x3 block (ResNet-18/34)."""
+
+    def __init__(self, in_ch, out_ch, stride=1, seed=0):
+        super().__init__()
+        self.project = in_ch != out_ch or stride != 1
+        with self.init_scope():
+            self.a = ConvBN(in_ch, out_ch, 3, stride=stride, pad=1, seed=seed)
+            self.b = ConvBN(out_ch, out_ch, 3, pad=1, seed=seed + 1)
+            if self.project:
+                self.shortcut = ConvBN(in_ch, out_ch, 1, stride=stride,
+                                       seed=seed + 2)
+
+    def forward(self, x):
+        h = self.a(x)
+        h = self.b(h, activate=False)
+        s = self.shortcut(x, activate=False) if self.project else x
+        return F.relu(h + s)
+
+
+class _Stage(ChainList):
+    def __init__(self, n_blocks, in_ch, mid_ch, out_ch, stride, seed):
+        blocks = [BottleneckBlock(in_ch, mid_ch, out_ch, stride=stride,
+                                  project=True, seed=seed)]
+        for i in range(1, n_blocks):
+            blocks.append(BottleneckBlock(out_ch, mid_ch, out_ch,
+                                          seed=seed + 10 * i))
+        super().__init__(*blocks)
+
+    def forward(self, x):
+        for block in self:
+            x = block(x)
+        return x
+
+
+class ResNet(Chain):
+    def __init__(self, block_counts, n_classes=1000, compute_dtype=None,
+                 seed=42, remat=False):
+        super().__init__()
+        self.compute_dtype = compute_dtype
+        self.remat = remat
+        with self.init_scope():
+            self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
+            self.res2 = _Stage(block_counts[0], 64, 64, 256, 1, seed + 100)
+            self.res3 = _Stage(block_counts[1], 256, 128, 512, 2, seed + 200)
+            self.res4 = _Stage(block_counts[2], 512, 256, 1024, 2, seed + 300)
+            self.res5 = _Stage(block_counts[3], 1024, 512, 2048, 2, seed + 400)
+            self.fc = L.Linear(2048, n_classes, seed=seed + 500)
+
+    def _apply_stage(self, stage, h):
+        if not self.remat:
+            return stage(h)
+        # rematerialize per stage: backward recomputes activations instead
+        # of keeping them resident — trades MXU FLOPs for HBM (SURVEY §7
+        # hardware note), buying larger per-chip batches.  BN running
+        # stats must flow through the checkpoint boundary as explicit
+        # inputs/outputs (attribute mutation would leak tracers out of the
+        # remat region).
+        import jax
+        from ..core.link import _persistent_slots
+        slots = list(_persistent_slots(stage))
+
+        def run(h, values):
+            for (sl, n, _), v in zip(slots, values):
+                object.__setattr__(sl, n, v)
+                sl._persistent[n] = v
+            out = stage(h)
+            new = tuple(getattr(sl, n) for sl, n, _ in slots)
+            return out, new
+
+        values = tuple(getattr(sl, n) for sl, n, _ in slots)
+        out, new = jax.checkpoint(run)(h, values)
+        for (sl, n, _), v in zip(slots, new):
+            object.__setattr__(sl, n, v)
+            sl._persistent[n] = v
+        return out
+
+    def forward(self, x):
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+        h = self.conv1(x)
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        h = self._apply_stage(self.res2, h)
+        h = self._apply_stage(self.res3, h)
+        h = self._apply_stage(self.res4, h)
+        h = self._apply_stage(self.res5, h)
+        h = F.global_average_pooling_2d(h)
+        return self.fc(h.astype(jnp.float32))
+
+
+class ResNet50(ResNet):
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
+                 remat=False):
+        super().__init__([3, 4, 6, 3], n_classes, compute_dtype, seed,
+                         remat=remat)
+
+
+class ResNet101(ResNet):
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
+                 remat=False):
+        super().__init__([3, 4, 23, 3], n_classes, compute_dtype, seed,
+                         remat=remat)
+
+
+class ResNet18(Chain):
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42):
+        super().__init__()
+        self.compute_dtype = compute_dtype
+        cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
+        with self.init_scope():
+            self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
+            stages = []
+            for i, (in_ch, out_ch, stride) in enumerate(cfg):
+                stages.append(BasicBlock(in_ch, out_ch, stride,
+                                         seed=seed + 100 * (i + 1)))
+                stages.append(BasicBlock(out_ch, out_ch,
+                                         seed=seed + 100 * (i + 1) + 50))
+            self.body = ChainList(*stages)
+            self.fc = L.Linear(512, n_classes, seed=seed + 999)
+
+    def forward(self, x):
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+        h = self.conv1(x)
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        for block in self.body:
+            h = block(h)
+        h = F.global_average_pooling_2d(h)
+        return self.fc(h.astype(jnp.float32))

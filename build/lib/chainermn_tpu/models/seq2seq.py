@@ -1,0 +1,213 @@
+"""Sequence-to-sequence NMT model (BASELINE configs #3/#4).
+
+Reference capability: ChainerMN ``examples/seq2seq/seq2seq.py`` (encoder/
+decoder LSTM NMT on WMT) and its model-parallel enc/dec split via
+``MultiNodeChainList`` (SURVEY.md §2.3, §3.3).  TPU-first design: the
+recurrence is a ``lax.scan`` over a packed-gate LSTM cell (one MXU GEMM
+per step), batch-major static shapes, teacher forcing in a single
+compiled program — no per-token Python.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.link import Chain
+from ..nn import functions as F
+from ..nn import links as L
+from ..links import MultiNodeChainList
+
+__all__ = ["Seq2seq", "Encoder", "Decoder", "create_model_parallel_seq2seq"]
+
+PAD = -1
+
+
+def _scan_lstm(cell, xs, c0=None, h0=None, reverse=False):
+    """Run a StatelessLSTM over [B, T, D] with lax.scan (time-major scan)."""
+    B = xs.shape[0]
+    H = cell.out_size
+    c0 = jnp.zeros((B, H), xs.dtype) if c0 is None else c0
+    h0 = jnp.zeros((B, H), xs.dtype) if h0 is None else h0
+    xs_t = jnp.swapaxes(xs, 0, 1)  # [T, B, D]
+
+    def step(carry, x_t):
+        c, h = carry
+        c, h = cell(c, h, x_t)
+        return (c, h), h
+
+    (c, h), hs = lax.scan(step, (c0, h0), xs_t, reverse=reverse)
+    return c, h, jnp.swapaxes(hs, 0, 1)  # [B, T, H]
+
+
+class Encoder(Chain):
+    """n-layer LSTM encoder (reference example: 3-layer NStepLSTM).
+
+    PAD positions freeze the recurrent state (length masking), so the
+    final state reflects each sequence's true last token.
+    """
+
+    def __init__(self, n_vocab, n_units, n_layers=1, seed=0):
+        super().__init__()
+        with self.init_scope():
+            self.embed = L.EmbedID(n_vocab, n_units, ignore_label=PAD,
+                                   seed=seed)
+            self.lstm = L.NStepLSTM(n_layers, n_units, n_units,
+                                    seed=seed + 1)
+
+    def forward(self, xs):
+        """xs: int [B, T] (PAD-padded) → state stacked [2, L, B, H]."""
+        emb = self.embed(xs)
+        hy, cy, _ = self.lstm(None, None, emb, mask=(xs != PAD))
+        return jnp.stack([cy, hy])
+
+
+class Decoder(Chain):
+    def __init__(self, n_vocab, n_units, n_layers=1, seed=10):
+        super().__init__()
+        self.n_units = n_units
+        with self.init_scope():
+            self.embed = L.EmbedID(n_vocab, n_units, ignore_label=PAD,
+                                   seed=seed)
+            self.lstm = L.NStepLSTM(n_layers, n_units, n_units,
+                                    seed=seed + 1)
+            self.out = L.Linear(n_units, n_vocab, seed=seed + 2)
+
+    def forward(self, state, ys_in, ys_out):
+        """Teacher-forced loss.  state: [2, L, B, H] from the encoder."""
+        cx, hx = state[0], state[1]
+        emb = self.embed(ys_in)
+        _, _, hs = self.lstm(hx, cx, emb)
+        logits = self.out(hs.reshape(-1, self.n_units))
+        loss = F.softmax_cross_entropy(logits, ys_out.reshape(-1),
+                                       ignore_label=PAD)
+        return loss
+
+    def step_tokens(self, c, h, tok):
+        """One greedy-decoding step through all layers: (c, h [L,B,H],
+        tok [B]) → (c, h, next_tok)."""
+        inp = self.embed(tok)
+        new_c, new_h = [], []
+        for layer, cell in enumerate(self.lstm):
+            c_l, h_l = cell(c[layer], h[layer], inp)
+            new_c.append(c_l)
+            new_h.append(h_l)
+            inp = h_l
+        logits = self.out(inp)
+        tok = jnp.argmax(logits, axis=1).astype(jnp.int32)
+        return jnp.stack(new_c), jnp.stack(new_h), tok
+
+
+class Seq2seq(Chain):
+    """Single-process encoder-decoder (reference example model shape)."""
+
+    def __init__(self, n_source_vocab, n_target_vocab, n_units,
+                 n_layers=1, seed=0):
+        super().__init__()
+        with self.init_scope():
+            self.encoder = Encoder(n_source_vocab, n_units,
+                                   n_layers=n_layers, seed=seed)
+            self.decoder = Decoder(n_target_vocab, n_units,
+                                   n_layers=n_layers, seed=seed + 100)
+
+    def forward(self, xs, ys_in, ys_out):
+        from ..core import reporter
+        state = self.encoder(xs)
+        loss = self.decoder(state, ys_in, ys_out)
+        reporter.report({"loss": loss}, self)
+        return loss
+
+    def translate(self, xs, bos_id, eos_id, max_length=32):
+        """Greedy decoding as one compiled scan (inference path)."""
+        state = self.encoder(xs)
+        c, h = state[0], state[1]
+        B = xs.shape[0]
+        tok0 = jnp.full((B,), bos_id, jnp.int32)
+
+        def step(carry, _):
+            c, h, tok = carry
+            c, h, tok = self.decoder.step_tokens(c, h, tok)
+            return (c, h, tok), tok
+
+        _, toks = lax.scan(step, (c, h, tok0), None, length=max_length)
+        return jnp.swapaxes(toks, 0, 1)  # [B, max_length]
+
+
+class _EncoderComponent(Chain):
+    def __init__(self, encoder):
+        super().__init__()
+        with self.init_scope():
+            self.encoder = encoder
+
+    def forward(self, xs, ys_in, ys_out):
+        return self.encoder(xs)
+
+
+class _DecoderWrapper(Chain):
+    def __init__(self, decoder):
+        super().__init__()
+        with self.init_scope():
+            self.decoder = decoder
+
+    def forward(self, state, xs, ys_in, ys_out):
+        # receives the encoder state over the stage edge plus the original
+        # call inputs (pass_inputs=True); xs is the encoder's input, unused
+        return self.decoder(state, ys_in, ys_out)
+
+
+class ModelParallelSeq2seq(MultiNodeChainList):
+    """Enc/dec split across two stage ranks (reference: the seq2seq
+    model-parallel example; BASELINE config #4).
+
+    The encoder's [2, B, H] state crosses the stage edge via the
+    differentiable send/recv pair; the decoder's loss is the terminal
+    output, broadcast to all ranks.
+    """
+
+    def __init__(self, comm, n_source_vocab, n_target_vocab, n_units,
+                 rank_encoder=0, rank_decoder=1, n_layers=1, seed=0):
+        super().__init__(comm)
+        enc = Encoder(n_source_vocab, n_units, n_layers=n_layers, seed=seed)
+        dec = Decoder(n_target_vocab, n_units, n_layers=n_layers,
+                      seed=seed + 100)
+        self._enc_component = _EncoderComponent(enc)
+        self._dec_component = _DecoderWrapper(dec)
+        self.add_link(self._enc_component, rank_in=None,
+                      rank_out=rank_decoder, rank=rank_encoder)
+        self.add_link(self._dec_component, rank_in=rank_encoder,
+                      rank_out=None, rank=rank_decoder, pass_inputs=True)
+
+    def forward(self, xs, ys_in, ys_out):
+        from ..core import reporter
+        loss = super().forward(xs, ys_in, ys_out)
+        reporter.report({"loss": loss}, self)
+        return loss
+
+
+def create_model_parallel_seq2seq(comm, n_source_vocab, n_target_vocab,
+                                  n_units, **kwargs):
+    return ModelParallelSeq2seq(comm, n_source_vocab, n_target_vocab,
+                                n_units, **kwargs)
+
+
+def make_synthetic_translation_data(n=256, src_vocab=40, tgt_vocab=40,
+                                    max_len=12, seed=0):
+    """Deterministic toy translation task: target = reversed source mapped
+    through a fixed permutation (learnable; no network access)."""
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(tgt_vocab - 3) + 3  # reserve 0=bos,1=eos,2=unk
+    xs = np.full((n, max_len), PAD, np.int32)
+    ys_in = np.full((n, max_len + 1), PAD, np.int32)
+    ys_out = np.full((n, max_len + 1), PAD, np.int32)
+    for i in range(n):
+        length = rng.randint(3, max_len + 1)
+        src = rng.randint(3, src_vocab, size=length)
+        tgt = perm[(src[::-1] - 3) % (tgt_vocab - 3)]
+        xs[i, :length] = src
+        ys_in[i, 0] = 0
+        ys_in[i, 1:length + 1] = tgt
+        ys_out[i, :length] = tgt
+        ys_out[i, length] = 1
+    return xs, ys_in, ys_out

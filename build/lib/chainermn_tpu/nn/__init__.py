@@ -1,0 +1,3 @@
+from . import functions
+from . import initializers
+from . import links

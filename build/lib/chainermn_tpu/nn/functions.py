@@ -1,0 +1,744 @@
+"""Neural-network functions (consumed-Chainer surface: ``chainer.functions``).
+
+Reference anchors: ``chainer/functions/ · relu, softmax_cross_entropy,
+convolution_2d, max_pooling_2d, batch_normalization, ...`` (SURVEY.md §2.8).
+All functions are pure ``jnp`` programs: differentiable by ``jax.grad``,
+fusible by XLA, layout NCHW to match the reference's convention (XLA
+re-layouts internally for the MXU; the API contract is what matters here).
+Stochastic functions (``dropout``) take an explicit ``key`` — the idiomatic
+JAX replacement for the reference's hidden global RNG; if omitted, a
+fresh per-step subkey comes from the compiled train step's key scope
+(``core.rng``), falling back to a host-drawn key in eager use.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "relu", "leaky_relu", "elu", "sigmoid", "tanh", "softplus", "gelu", "silu",
+    "softmax", "log_softmax", "softmax_cross_entropy", "sigmoid_cross_entropy",
+    "mean_squared_error", "mean_absolute_error", "huber_loss", "accuracy",
+    "dropout", "linear", "embed_id",
+    "convolution_2d", "deconvolution_2d", "depthwise_convolution_2d",
+    "max_pooling_2d", "average_pooling_2d", "unpooling_2d",
+    "global_average_pooling_2d", "resize_images",
+    "batch_normalization", "fixed_batch_normalization", "layer_normalization",
+    "concat", "stack", "hstack", "vstack", "split_axis", "separate",
+    "average", "select_item", "absolute", "maximum", "minimum", "swish",
+    "normalize", "local_response_normalization", "squared_error",
+    "reshape", "flatten", "transpose", "expand_dims", "squeeze", "tile",
+    "broadcast_to", "sum", "mean", "max", "min", "argmax", "sqrt", "exp",
+    "log", "clip", "matmul", "batch_matmul", "where", "pad",
+]
+
+
+# -- activations -----------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def leaky_relu(x, slope=0.2):
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def elu(x, alpha=1.0):
+    return jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1))
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def softplus(x, beta=1.0):
+    return jax.nn.softplus(beta * x) / beta
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def softmax(x, axis=1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# -- losses ----------------------------------------------------------------
+
+def softmax_cross_entropy(x, t, ignore_label=-1, reduce="mean",
+                          normalize=True, class_weight=None):
+    """Softmax + NLL with ignore-label masking.
+
+    Matches the reference semantics (``F.softmax_cross_entropy``): ``t`` holds
+    int class ids; entries equal to ``ignore_label`` contribute zero loss and
+    are excluded from the normalizer; ``class_weight`` ([n_classes]) scales
+    each example's loss by its target class's weight.
+    """
+    logp = jax.nn.log_softmax(x, axis=1)
+    t_safe = jnp.where(t == ignore_label, 0, t)
+    # gather the log-prob of the target class along axis 1
+    nll = -jnp.take_along_axis(
+        logp, t_safe[:, None] if logp.ndim == 2 else jnp.expand_dims(t_safe, 1), axis=1
+    ).squeeze(1)
+    if class_weight is not None:
+        nll = nll * jnp.asarray(class_weight)[t_safe]
+    mask = (t != ignore_label)
+    nll = jnp.where(mask, nll, 0.0)
+    if reduce == "no":
+        return nll
+    if normalize:
+        count = jnp.maximum(mask.sum(), 1)
+    else:
+        count = x.shape[0]
+    return nll.sum() / count
+
+
+def sigmoid_cross_entropy(x, t, reduce="mean"):
+    t = t.astype(x.dtype)
+    loss = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if reduce == "no":
+        return loss
+    return loss.mean()
+
+
+def mean_squared_error(x, t):
+    return jnp.mean((x - t) ** 2)
+
+
+def mean_absolute_error(x, t):
+    return jnp.mean(jnp.abs(x - t))
+
+
+def huber_loss(x, t, delta=1.0, reduce="sum_along_second_axis"):
+    d = x - t
+    abs_d = jnp.abs(d)
+    loss = jnp.where(abs_d <= delta, 0.5 * d * d, delta * (abs_d - 0.5 * delta))
+    if reduce == "no":
+        return loss
+    return loss.sum(axis=1)
+
+
+def accuracy(y, t, ignore_label=None):
+    pred = jnp.argmax(y, axis=1)
+    if ignore_label is not None:
+        mask = (t != ignore_label)
+        correct = jnp.where(mask, pred == t, False)
+        return correct.sum() / jnp.maximum(mask.sum(), 1)
+    return jnp.mean((pred == t).astype(jnp.float32))
+
+
+# -- stochastic ------------------------------------------------------------
+
+def dropout(x, ratio=0.5, key=None, train: bool | None = None):
+    from ..core.config import config
+    if train is None:
+        train = config.train
+    if not train or ratio == 0.0:
+        return x
+    if key is None:
+        # per-step key pushed by the compiled train step (core.rng);
+        # outside any step scope, fall back to a host-drawn key (eager
+        # use — matches the reference's hidden global RNG)
+        from ..core import rng as rng_module
+        key = rng_module.next_key()
+    if key is None:
+        key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+# -- linear / embedding ----------------------------------------------------
+
+def linear(x, W, b=None, n_batch_axes=1):
+    if n_batch_axes > 1:
+        batch_shape = x.shape[:n_batch_axes]
+        x = x.reshape((int(np.prod(batch_shape)), -1))
+    elif x.ndim > 2:
+        x = x.reshape((x.shape[0], -1))
+        batch_shape = None
+    else:
+        batch_shape = None
+    y = x @ W.T
+    if b is not None:
+        y = y + b
+    if n_batch_axes > 1:
+        y = y.reshape(batch_shape + (W.shape[0],))
+    return y
+
+
+def embed_id(x, W, ignore_label=None):
+    if ignore_label is not None:
+        safe = jnp.where(x == ignore_label, 0, x)
+        emb = W[safe]
+        return jnp.where((x == ignore_label)[..., None], 0.0, emb)
+    return W[x]
+
+
+# -- convolutions (NCHW, kernel OIHW — reference layout) --------------------
+
+def _pair(v):
+    return (v, v) if np.isscalar(v) else tuple(v)
+
+
+def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1):
+    sy, sx = _pair(stride)
+    ph, pw = _pair(pad)
+    dy, dx = _pair(dilate)
+    y = lax.conv_general_dilated(
+        x, W,
+        window_strides=(sy, sx),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dy, dx),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def deconvolution_2d(x, W, b=None, stride=1, pad=0, outsize=None):
+    """Transposed convolution; kernel (in_ch, out_ch, kh, kw) like the
+    reference (``L.Deconvolution2D``).
+
+    Implemented as the literal transpose of the corresponding forward
+    convolution (the reference's definition) via ``jax.vjp`` — XLA lowers
+    this to a single transposed-conv kernel, and the kernel-layout
+    conventions can't drift from the conv they transpose.
+    """
+    sy, sx = _pair(stride)
+    ph, pw = _pair(pad)
+    in_ch, out_ch, kh, kw = W.shape
+    n, _, h, w = x.shape
+    if outsize is None:
+        oh, ow = sy * (h - 1) + kh - 2 * ph, sx * (w - 1) + kw - 2 * pw
+    else:
+        oh, ow = outsize
+
+    # analytic shape check: the forward conv of (oh, ow) must give (h, w)
+    if (oh + 2 * ph - kh) // sy + 1 != h or (ow + 2 * pw - kw) // sx + 1 != w \
+            or oh + 2 * ph < kh or ow + 2 * pw < kw:
+        raise ValueError(
+            f"invalid outsize {(oh, ow)} for input {(h, w)} with "
+            f"k={(kh, kw)} s={(sy, sx)} p={(ph, pw)}")
+
+    def fwd(a):  # [N, out_ch, oh, ow] → [N, in_ch, h, w]
+        return lax.conv_general_dilated(
+            a, W, (sy, sx), ((ph, ph), (pw, pw)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    # fwd is linear in its input — linear_transpose traces it once and
+    # never evaluates the discarded primal
+    f_t = jax.linear_transpose(
+        fwd, jax.ShapeDtypeStruct((n, out_ch, oh, ow), x.dtype))
+    (y,) = f_t(x)
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def depthwise_convolution_2d(x, W, b=None, stride=1, pad=0):
+    # W: (channel_multiplier, in_channels, kh, kw) in the reference
+    cm, ic, kh, kw = W.shape
+    Wg = W.transpose(1, 0, 2, 3).reshape(ic * cm, 1, kh, kw)
+    return convolution_2d(x, Wg, b, stride, pad, groups=ic)
+
+
+# -- pooling ---------------------------------------------------------------
+
+def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    if cover_all:
+        # reference semantics: pad enough that every element is covered
+        h, w = x.shape[2], x.shape[3]
+        # NB: this module shadows builtin max with the F.max alias
+        eh = builtins.max(0, (-(h + 2 * ph - kh) % sy)) if sy > 1 else 0
+        ew = builtins.max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
+    else:
+        eh = ew = 0
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, neg, lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sy, sx),
+        padding=((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)),
+    )
+
+
+def average_pooling_2d(x, ksize, stride=None, pad=0):
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sy, sx),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+    # reference divides by the full window size (count_include_pad=True)
+    return summed / (kh * kw)
+
+
+def unpooling_2d(x, ksize, stride=None, pad=0, outsize=None, cover_all=True):
+    """Inverse of sum-pooling: each value scatter-adds over its k×k window.
+
+    Reference semantics (``F.unpooling_2d``): output size
+    ``s*(in-1)+k-2p`` (minus ``s-1`` under ``cover_all``).  Implemented as
+    the VJP of sum-pooling — the transposed scatter-add XLA compiles to a
+    single fused kernel.
+    """
+    kh, kw = _pair(ksize)
+    sy, sx = _pair(stride if stride is not None else ksize)
+    ph, pw = _pair(pad)
+    h, w = x.shape[2], x.shape[3]
+    if outsize is None:
+        oh = sy * (h - 1) + kh - 2 * ph - (sy - 1 if cover_all else 0)
+        ow = sx * (w - 1) + kw - 2 * pw - (sx - 1 if cover_all else 0)
+    else:
+        oh, ow = outsize
+    if (sy, sx) == (kh, kw) and (ph, pw) == (0, 0) and (oh, ow) == (h * kh, w * kw):
+        return jnp.repeat(jnp.repeat(x, kh, axis=2), kw, axis=3)
+    # trailing pad so that pooling the (oh, ow) plane yields exactly (h, w)
+    prh = (h - 1) * sy + kh - oh - ph
+    prw = (w - 1) * sx + kw - ow - pw
+
+    def pool(y):
+        return lax.reduce_window(
+            y, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, sy, sx),
+            padding=((0, 0), (0, 0), (ph, prh), (pw, prw)))
+
+    zeros = jnp.zeros(x.shape[:2] + (oh, ow), x.dtype)
+    _, vjp = jax.vjp(pool, zeros)
+    (y,) = vjp(x)
+    return y
+
+
+def global_average_pooling_2d(x):
+    return x.mean(axis=(2, 3))
+
+
+def resize_images(x, output_shape):
+    n, c, _, _ = x.shape
+    oh, ow = output_shape
+    return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+
+# -- normalization ---------------------------------------------------------
+
+def batch_normalization(x, gamma, beta, eps=2e-5, axis=None):
+    if axis is None:
+        axis = (0,) + tuple(range(2, x.ndim))
+    mean = x.mean(axis=axis)
+    var = x.var(axis=axis)
+    return _apply_bn(x, gamma, beta, mean, var, eps, axis)
+
+
+def fixed_batch_normalization(x, gamma, beta, mean, var, eps=2e-5, axis=None):
+    if axis is None:
+        axis = (0,) + tuple(range(2, x.ndim))
+    return _apply_bn(x, gamma, beta, mean, var, eps, axis)
+
+
+def _apply_bn(x, gamma, beta, mean, var, eps, axis):
+    shape = [1] * x.ndim
+    kept = [d for d in range(x.ndim) if d not in axis]
+    for d in kept:
+        shape[d] = x.shape[d]
+    mean = mean.reshape(shape)
+    var = var.reshape(shape)
+    gamma = gamma.reshape(shape)
+    beta = beta.reshape(shape)
+    inv = lax.rsqrt(var + eps)
+    return (x - mean) * inv * gamma + beta
+
+
+def layer_normalization(x, gamma, beta, eps=1e-5):
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+
+
+# -- shape / array ops (thin jnp aliases, reference names) ------------------
+
+def concat(xs, axis=1):
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+def stack(xs, axis=0):
+    return jnp.stack(list(xs), axis=axis)
+
+
+def hstack(xs):
+    return jnp.hstack(list(xs))
+
+
+def vstack(xs):
+    return jnp.vstack(list(xs))
+
+
+def split_axis(x, indices_or_sections, axis):
+    return tuple(jnp.split(x, indices_or_sections, axis=axis))
+
+
+def separate(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x):
+    return jnp.reshape(x, (-1,))
+
+
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes)
+
+
+def expand_dims(x, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+def tile(x, reps):
+    return jnp.tile(x, reps)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def sum(x, axis=None, keepdims=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+def mean(x, axis=None, keepdims=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+def max(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+def min(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+def argmax(x, axis=None):
+    return jnp.argmax(x, axis=axis)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def clip(x, x_min, x_max):
+    return jnp.clip(x, x_min, x_max)
+
+
+def matmul(a, b, transa=False, transb=False):
+    if transa:
+        a = jnp.swapaxes(a, -1, -2)
+    if transb:
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+def batch_matmul(a, b, transa=False, transb=False):
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if b.ndim == 2:
+        b = b[:, :, None]
+    return matmul(a, b, transa, transb)
+
+
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def pad(x, pad_width, mode="constant", **kwargs):
+    return jnp.pad(x, pad_width, mode=mode, **kwargs)
+
+
+# -- additional reference-surface functions ---------------------------------
+
+def average(x, axis=None, weights=None, keepdims=False):
+    """Weighted mean (reference: ``F.average``)."""
+    if weights is None:
+        return jnp.mean(x, axis=axis, keepdims=keepdims)
+    return jnp.average(x, axis=axis, weights=weights)
+
+
+def select_item(x, t):
+    """x[i, t[i]] for each row (reference: ``F.select_item``)."""
+    return jnp.take_along_axis(x, t[:, None], axis=1).squeeze(1)
+
+
+def absolute(x):
+    return jnp.abs(x)
+
+
+def maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+def minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def normalize(x, eps=1e-5, axis=1):
+    """L2 normalization along ``axis`` (reference: ``F.normalize``)."""
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True)) + eps
+    return x / norm
+
+
+def local_response_normalization(x, n=5, k=2.0, alpha=1e-4, beta=0.75):
+    """Cross-channel LRN on NCHW (reference: ``F.local_response_
+    normalization``; AlexNet-era)."""
+    sq = x * x
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    # note: this module shadows builtin sum with the reference F.sum alias
+    window = padded[:, 0:x.shape[1]]
+    for i in range(1, n):
+        window = window + padded[:, i:i + x.shape[1]]
+    return x / (k + alpha * window) ** beta
+
+
+def squared_error(x, t):
+    return (x - t) ** 2
+
+
+def log_softmax_cross_entropy_components(x, t, ignore_label=-1):
+    """(per-example nll, valid mask) — building block for custom losses."""
+    nll = softmax_cross_entropy(x, t, ignore_label=ignore_label, reduce="no")
+    return nll, t != ignore_label
+
+
+# -- elementwise math aliases (reference F.* long tail) ---------------------
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def arcsin(x):
+    return jnp.arcsin(x)
+
+
+def arccos(x):
+    return jnp.arccos(x)
+
+
+def arctan(x):
+    return jnp.arctan(x)
+
+
+def arctan2(x1, x2):
+    return jnp.arctan2(x1, x2)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+def prod(x, axis=None, keepdims=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdims)
+
+
+def logsumexp(x, axis=None):
+    return jax.scipy.special.logsumexp(x, axis=axis)
+
+
+def fmod(x, divisor):
+    return jnp.fmod(x, divisor)
+
+
+def fix(x):
+    return jnp.fix(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def hard_sigmoid(x):
+    return jnp.clip(x * 0.2 + 0.5, 0.0, 1.0)
+
+
+def softmin(x, axis=1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+def crelu(x, axis=1):
+    return jnp.concatenate([jnp.maximum(x, 0), jnp.maximum(-x, 0)],
+                           axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis)
+
+
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+def flipud(x):
+    return jnp.flipud(x)
+
+
+def rollaxis(x, axis, start=0):
+    return jnp.rollaxis(x, axis, start)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def repeat(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset, axis1, axis2)
+
+
+def cast(x, typ):
+    return x.astype(typ)
+
+
+def identity(*xs):
+    return xs[0] if len(xs) == 1 else xs
+
+
+def scale(x, y, axis=1):
+    shape = [1] * x.ndim
+    for i, s in enumerate(jnp.shape(y)):
+        shape[axis + i] = s
+    return x * jnp.reshape(y, shape)
+
+
+def bias(x, y, axis=1):
+    shape = [1] * x.ndim
+    for i, s in enumerate(jnp.shape(y)):
+        shape[axis + i] = s
+    return x + jnp.reshape(y, shape)
+
+
+def matmul_nn(a, b):
+    return a @ b
+
+
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+def einsum(subscripts, *operands):
+    return jnp.einsum(subscripts, *operands)

@@ -1,0 +1,157 @@
+"""Recurrent links (consumed-Chainer surface: ``chainer.links`` RNNs).
+
+Reference anchors: ``chainer/links/connection/n_step_lstm.py ·
+NStepLSTM``, ``n_step_gru.py · NStepGRU``, ``gru.py · GRU/StatelessGRU``
+(SURVEY.md §2.8 — the seq2seq example family consumes these).
+
+TPU-first formulation: every cell packs its gates into one GEMM; whole
+sequences run as a single ``lax.scan`` (batch-major [B, T, D] API, the
+scan is time-major internally).  Unlike the reference's cuDNN-backed
+NStep links which take ragged per-example lists, these take padded
+batches with an optional length mask — the static-shape contract XLA
+needs; ``chainermn_tpu.models.seq2seq`` shows the padding convention.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.link import Chain, ChainList
+from . import functions as F
+from .links import Linear, StatelessLSTM
+
+__all__ = ["StatelessGRU", "GRU", "NStepLSTM", "NStepGRU"]
+
+
+class StatelessGRU(Chain):
+    """One GRU step: (h, x) -> h  (reference: ``L.StatelessGRU``)."""
+
+    def __init__(self, in_size, out_size, seed=None):
+        super().__init__()
+        self.out_size = out_size
+        s = (lambda k: None if seed is None else seed + k)
+        with self.init_scope():
+            # [reset, update] gates fused; candidate separate (its lateral
+            # term is gated by r before the matmul)
+            self.w_rz = Linear(in_size, 2 * out_size, seed=s(0))
+            self.u_rz = Linear(out_size, 2 * out_size, nobias=True,
+                               seed=s(1))
+            self.w_h = Linear(in_size, out_size, seed=s(2))
+            self.u_h = Linear(out_size, out_size, nobias=True, seed=s(3))
+
+    def forward(self, h, x):
+        if h is None:
+            h = jnp.zeros((x.shape[0], self.out_size), x.dtype)
+        rz = F.sigmoid(self.w_rz(x) + self.u_rz(h))
+        r, z = jnp.split(rz, 2, axis=1)
+        h_bar = F.tanh(self.w_h(x) + self.u_h(r * h))
+        return (1 - z) * h + z * h_bar
+
+
+class GRU(StatelessGRU):
+    """Stateful GRU (reference: ``L.GRU``)."""
+
+    _volatile_attrs = ("h",)
+
+    def __init__(self, in_size, out_size, seed=None):
+        super().__init__(in_size, out_size, seed=seed)
+        self.h = None
+
+    def reset_state(self):
+        self.h = None
+
+    def set_state(self, h):
+        self.h = h
+
+    def forward(self, x):
+        self.h = super().forward(self.h, x)
+        return self.h
+
+
+def _mask_step(new, old, mask_t):
+    return jnp.where(mask_t[:, None], new, old)
+
+
+class _NStepRNNBase(ChainList):
+    def __init__(self, n_layers, in_size, out_size, cell_factory, seed=0):
+        cells = []
+        for i in range(n_layers):
+            cells.append(cell_factory(in_size if i == 0 else out_size,
+                                      out_size, seed + 10 * i))
+        super().__init__(*cells)
+        self.n_layers = n_layers
+        self.out_size = out_size
+
+
+class NStepLSTM(_NStepRNNBase):
+    """Multi-layer LSTM over padded sequences.
+
+    ``forward(hx, cx, xs, mask=None)``: xs [B, T, D]; hx/cx [L, B, H] or
+    None; mask [B, T] bool (True = valid).  Returns (hy, cy, ys) with ys
+    [B, T, H] — the reference's (hy, cy, ys) contract on padded batches.
+    """
+
+    def __init__(self, n_layers, in_size, out_size, dropout=0.0, seed=0):
+        super().__init__(n_layers, in_size, out_size,
+                         lambda i, o, s: StatelessLSTM(i, o, seed=s), seed)
+        self.dropout = dropout
+
+    def forward(self, hx, cx, xs, mask=None):
+        B, T, _ = xs.shape
+        L, H = self.n_layers, self.out_size
+        hx = jnp.zeros((L, B, H), xs.dtype) if hx is None else hx
+        cx = jnp.zeros((L, B, H), xs.dtype) if cx is None else cx
+        mask_t = (jnp.ones((B, T), bool) if mask is None else mask)
+        h_seq = xs
+        hy, cy = [], []
+        for layer, cell in enumerate(self):
+            if layer > 0 and self.dropout:
+                # reference semantics: inter-layer dropout during training
+                h_seq = F.dropout(h_seq, self.dropout)
+            def step(carry, inp):
+                c, h = carry
+                x_t, m_t = inp
+                c_new, h_new = cell(c, h, x_t)
+                c = _mask_step(c_new, c, m_t)
+                h = _mask_step(h_new, h, m_t)
+                return (c, h), h
+            (c_f, h_f), ys = lax.scan(
+                step, (cx[layer], hx[layer]),
+                (jnp.swapaxes(h_seq, 0, 1), jnp.swapaxes(mask_t, 0, 1)))
+            h_seq = jnp.swapaxes(ys, 0, 1)
+            hy.append(h_f)
+            cy.append(c_f)
+        return jnp.stack(hy), jnp.stack(cy), h_seq
+
+
+class NStepGRU(_NStepRNNBase):
+    """Multi-layer GRU over padded sequences: ``forward(hx, xs, mask)`` →
+    (hy, ys)."""
+
+    def __init__(self, n_layers, in_size, out_size, dropout=0.0, seed=0):
+        super().__init__(n_layers, in_size, out_size,
+                         lambda i, o, s: StatelessGRU(i, o, seed=s), seed)
+        self.dropout = dropout
+
+    def forward(self, hx, xs, mask=None):
+        B, T, _ = xs.shape
+        L, H = self.n_layers, self.out_size
+        hx = jnp.zeros((L, B, H), xs.dtype) if hx is None else hx
+        mask_t = (jnp.ones((B, T), bool) if mask is None else mask)
+        h_seq = xs
+        hy = []
+        for layer, cell in enumerate(self):
+            if layer > 0 and self.dropout:
+                h_seq = F.dropout(h_seq, self.dropout)
+            def step(h, inp):
+                x_t, m_t = inp
+                h_new = cell(h, x_t)
+                h = _mask_step(h_new, h, m_t)
+                return h, h
+            h_f, ys = lax.scan(
+                step, hx[layer],
+                (jnp.swapaxes(h_seq, 0, 1), jnp.swapaxes(mask_t, 0, 1)))
+            h_seq = jnp.swapaxes(ys, 0, 1)
+            hy.append(h_f)
+        return jnp.stack(hy), h_seq

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for hot ops (the rebuild's N2/N3 escape hatch)."""
+
+from .flash_attention import attention, flash_attention, xla_attention
+
+__all__ = ["attention", "flash_attention", "xla_attention"]

@@ -1,0 +1,399 @@
+"""Fused flash attention — Pallas TPU kernel.
+
+N2/N3-class component (SURVEY.md §2.5): where the reference hand-wrote
+CUDA kernels for its hot paths, the TPU rebuild's escape hatch beyond
+XLA fusion is Pallas.  Attention is the canonical case: the fused kernel
+keeps the [Tq, Tk] score matrix out of HBM entirely — scores live in VMEM
+tiles, softmax runs online (running max/normalizer), and the MXU sees one
+[BQ, D]×[D, Tk-block] matmul stream per query tile.
+
+``attention(q, k, v)`` dispatches: Pallas kernel on TPU backends, a
+jnp reference elsewhere (CPU tests run the kernel in interpreter mode to
+pin kernel↔reference equivalence).
+
+Ring-attention composition: ``parallel.ring_attention`` rotates KV blocks
+between chips; within a chip this kernel computes each block's
+contribution — ICI transfers at the outer level, VMEM tiling at the
+inner.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["attention", "flash_attention", "xla_attention"]
+
+
+def xla_attention(q, k, v, causal=False, scale=None):
+    """jnp reference implementation (and non-TPU fallback)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = s.shape[-2], s.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        s = jnp.where((qpos >= kpos)[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
+                      causal, scale):
+    """Forward kernel variant that also writes the log-sum-exp row
+    statistics (softmax normalizer) needed by the backward kernels."""
+    bq, d = q_ref.shape
+    tk = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+    n_kblocks = tk // block_k
+    q_pos = (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        last = jnp.minimum((qi * bq + bq + block_k - 1) // block_k,
+                           n_kblocks)
+    else:
+        last = n_kblocks
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    lse_ref[:] = (m_safe + jnp.log(jnp.maximum(l, 1e-30))).reshape(bq)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k, causal, scale):
+    """dq for one query block: recompute P from (q, k, lse); then
+    dq = scale * sum_j (P_ij (g_i·v_j - delta_i)) k_j."""
+    bq, d = q_ref.shape
+    tk = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale
+    g = g_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].reshape(bq, 1)
+    delta = delta_ref[:].reshape(bq, 1)
+    n_kblocks = tk // block_k
+    q_pos = (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+    dq = jnp.zeros((bq, d), jnp.float32)
+
+    def body(ki, dq):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+        gv = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (gv - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        last = jnp.minimum((qi * bq + bq + block_k - 1) // block_k,
+                           n_kblocks)
+    else:
+        last = n_kblocks
+    dq = jax.lax.fori_loop(0, last, body, dq)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q, causal, scale):
+    """dk/dv for one key block: loop over query blocks;
+    dv = P^T g ; dk = scale * sum_i (P_ij (g_i·v_j - delta_i)) q_i."""
+    bk, d = k_ref.shape
+    tq = q_ref.shape[0]
+    ki = pl.program_id(1)
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    n_qblocks = tq // block_q
+    k_pos = (ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32) * scale
+        g_blk = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(qi * block_q, block_q)].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(qi * block_q, block_q)] \
+            .reshape(block_q, 1)
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = (qi * block_q
+                     + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        gv = jax.lax.dot_general(g_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (gv - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # query blocks at or after this key block participate
+        first = (ki * bk) // block_q
+    else:
+        first = 0
+    dk, dv = jax.lax.fori_loop(first, n_qblocks, body, (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                  q_offset_blocks):
+    """One (batch*head, q-block) program: stream K/V blocks through VMEM
+    with the online-softmax recurrence."""
+    bq, d = q_ref.shape
+    tk = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    n_kblocks = tk // block_k
+    q_pos = (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, block_k]
+        if causal:
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # only blocks that intersect the causal triangle contribute
+        last_needed = jnp.minimum(
+            (qi * bq + bq + block_k - 1) // block_k, n_kblocks)
+    else:
+        last_needed = n_kblocks
+    m, l, acc = jax.lax.fori_loop(0, last_needed, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Fused attention via Pallas.  q/k/v: [B, H, T, D]."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        return xla_attention(q, k, v, causal=causal, scale=scale)
+
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale,
+                               q_offset_blocks=0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
+                        block_k=128, interpret=False):
+    """Forward kernel returning (out, lse [B, H, Tq])."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    kernel = functools.partial(_flash_kernel_lse, block_k=block_k,
+                               causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
+
+
+def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
+                        block_q=128, block_k=128, interpret=False):
+    """Backward kernels: (dq, dk, dv) with flash memory behavior."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    gr = g.reshape(B * H, Tq, D)
+    lser = lse.reshape(B * H, Tq)
+    # delta_i = rowsum(g_i * out_i) — one fused elementwise reduce
+    delta = jnp.sum(gr.astype(jnp.float32)
+                    * out.reshape(B * H, Tq, D).astype(jnp.float32),
+                    axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid=(B * H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lser, delta)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_diff(q, k, v, causal, scale, interpret):
+    return flash_attention(q, k, v, causal=causal, scale=scale,
+                           interpret=interpret)
+
+
+def _flash_diff_fwd(q, k, v, causal, scale, interpret):
+    Tq, Tk = q.shape[2], k.shape[2]
+    if Tq % min(128, Tq) or Tk % min(128, Tk):
+        # irregular shapes: XLA fallback for both directions
+        out = xla_attention(q, k, v, causal=causal, scale=scale)
+        return out, (q, k, v, None, None)
+    out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    if lse is None:
+        _, vjp = jax.vjp(
+            lambda q, k, v: xla_attention(q, k, v, causal=causal,
+                                          scale=scale), q, k, v)
+        return vjp(g)
+    return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                               scale=scale, interpret=interpret)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def attention(q, k, v, causal=False, scale=None):
+    """Dispatch: Pallas kernels on TPU (flash forward AND backward via
+    custom VJP), XLA reference elsewhere."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return _flash_diff(q, k, v, causal, scale, False)
+    return xla_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,229 @@
+"""Multi-node optimizer wrappers.
+
+Reference: ``chainermn/optimizers.py · _MultiNodeOptimizer,
+_DoubleBufferingOptimizer, create_multi_node_optimizer`` (SURVEY.md §2.4,
+call stack §3.2).
+
+The reference interposes ``communicator.allreduce_grad(target)`` between
+``loss.backward()`` and ``optimizer.update()`` as a separate host-driven
+step (pack kernel → NCCL → unpack kernel).  Here the *entire* data-parallel
+step — per-rank forward/backward on the local batch shard, gradient mean
+over the communicator axis (optionally dtype-compressed / flat-bucketed),
+and the optax update — is one ``shard_map``ped, jit-compiled program:
+SURVEY §3.2's "this whole stack becomes ONE train_step".  XLA overlaps the
+gradient collective with remaining backward compute automatically.
+
+Batch convention (single-controller translation of "each rank feeds its
+local batch"): ``update(lossfun, *args)`` receives the *global* batch
+(leading dim divisible by ``comm.size``); the shard_map in_spec splits it
+across ranks.  A per-rank batchsize of ``b`` in reference scripts becomes
+an iterator batchsize of ``b * comm.size`` here (see
+``examples/train_mnist_dp.py``).
+
+``double_buffering=True`` reproduces the reference's one-step-stale
+gradient semantics (SURVEY §7 hard-parts note: defined by *observable
+semantics*, not stream mechanics): step ``t`` applies the mean gradient
+computed at step ``t-1`` while step ``t``'s gradients are produced in the
+same compiled program.  Since XLA already overlaps the collective with
+compute, the staleness is the semantic contract kept for parity, and it
+additionally lets the runtime pipeline consecutive steps (the update no
+longer serializes on the current step's collective).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .core import reporter as reporter_module
+from .core.link import bind_state, extract_state
+
+__all__ = ["create_multi_node_optimizer", "_MultiNodeOptimizer",
+           "_DoubleBufferingOptimizer"]
+
+
+def create_multi_node_optimizer(actual_optimizer, communicator,
+                                double_buffering=False, zero_fill=True):
+    """Wrap an optimizer so updates average gradients over the communicator.
+
+    Reference signature and delegation semantics preserved: the returned
+    object forwards attribute access to ``actual_optimizer``.
+    """
+    if double_buffering:
+        if communicator.name not in ("pure_nccl", "jax_ici", "hierarchical",
+                                     "two_dimensional", "single_node", "flat",
+                                     "dummy"):
+            # reference restricts double buffering to PureNcclCommunicator
+            raise ValueError(
+                "double buffering requires a fused-bucket communicator "
+                f"(reference: pure_nccl); got {communicator.name!r}")
+        return _DoubleBufferingOptimizer(actual_optimizer, communicator,
+                                         zero_fill)
+    return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill)
+
+
+class _MultiNodeOptimizer:
+    def __init__(self, actual_optimizer, communicator, zero_fill=True):
+        super().__setattr__("communicator", communicator)
+        super().__setattr__("actual_optimizer", actual_optimizer)
+        super().__setattr__("zero_fill", zero_fill)
+        from .core.optimizer import _LRUCache
+        super().__setattr__("_mn_step_cache", _LRUCache())
+        super().__setattr__("_stale_grads", None)  # double-buffer slot
+
+    _double_buffering = False
+
+    # -- reference-style delegation ---------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.actual_optimizer, name)
+
+    def __setattr__(self, name, value):
+        if name in self.__dict__ or hasattr(type(self), name):
+            super().__setattr__(name, value)
+        else:
+            setattr(self.actual_optimizer, name, value)
+
+    def setup(self, link):
+        self.actual_optimizer.setup(link)
+        return self
+
+    # -- update -------------------------------------------------------------
+    def update(self, lossfun=None, *args, **kwargs):
+        actual = self.actual_optimizer
+        if actual.target is None:
+            raise RuntimeError("setup(link) was not called")
+        if lossfun is None:
+            # eager path: grads already on Parameter.grad (reference flow:
+            # backward → allreduce_grad → update)
+            self.communicator.multi_node_mean_grad(actual.target,
+                                                   zero_fill=self.zero_fill)
+            return actual.update()
+        if self.communicator.axis_name is None:
+            # dummy communicator: plain local update
+            return actual.update(lossfun, *args, **kwargs)
+
+        if any(p.array is None for p in actual.target.params()):
+            with bind_state(actual.target, extract_state(actual.target)):
+                lossfun(*jax.tree.map(lambda a: a, args), **kwargs)
+        if hasattr(self.communicator, "verify_step_signature"):
+            # debug communicator: agree on shapes/dtypes across hosts
+            # before launching (fail fast instead of collective deadlock)
+            self.communicator.verify_step_signature((args, kwargs))
+        state = extract_state(actual.target)
+        params, pstate = state["params"], state["state"]
+        opt_state = actual._ensure_opt_state(params)
+        key = actual._cache_key(lossfun, args, kwargs) + (self._double_buffering,)
+        step = self._mn_step_cache.get(key)
+        if step is None:
+            step = self._make_step(lossfun, args, kwargs)
+            self._mn_step_cache[key] = step
+
+        if self._double_buffering:
+            if self._stale_grads is None:
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                super().__setattr__("_stale_grads", zeros)
+            new_params, new_pstate, new_opt_state, loss, grads, obs = step(
+                params, pstate, opt_state, actual._hyper_values(),
+                actual._next_rng_key(), (self._stale_grads,), args, kwargs)
+            super().__setattr__("_stale_grads", grads)
+        else:
+            new_params, new_pstate, new_opt_state, loss, grads, obs = step(
+                params, pstate, opt_state, actual._hyper_values(),
+                actual._next_rng_key(), (), args, kwargs)
+        actual._write_back(new_params, new_pstate, grads)
+        actual._opt_state = new_opt_state
+        actual.t += 1
+        reporter_module.report(obs)
+        return loss
+
+    # -- compiled DP step ------------------------------------------------------
+    def _batch_spec(self, leaf, axis, size):
+        """Batch-sharding heuristic: leaves with a leading dim divisible by
+        ``size`` are split across ranks; scalars are replicated; anything
+        else is a shape error (scatter_dataset guarantees divisibility —
+        silent replication would quietly discard data parallelism)."""
+        if not hasattr(leaf, "shape") or leaf.ndim == 0:
+            return P()
+        if leaf.shape[0] % size == 0 and leaf.shape[0] > 0:
+            return P(axis)
+        raise ValueError(
+            f"batch leaf with leading dim {leaf.shape[0]} is not divisible "
+            f"by communicator size {size}; scatter_dataset keeps shards "
+            f"equal — use batchsize = per_rank_bs * comm.size (pass "
+            f"per-example weights with a batch-sized leading axis, scalars "
+            f"as 0-d arrays)")
+
+    def _make_step(self, lossfun, ex_args, ex_kwargs):
+        from jax import shard_map
+        from .core.optimizer import (apply_transform_update,
+                                     make_loss_and_grad)
+        comm = self.communicator
+        actual = self.actual_optimizer
+        tx = actual._transform()
+        grad_transform = comm.grad_transform()
+        axis = comm.axis_name
+        size = comm.size
+        double_buffering = self._double_buffering
+        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
+
+        def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
+                      args, kwargs):
+            # decorrelate stochastic masks across ranks (each rank holds a
+            # different batch shard)
+            rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
+            with jax.named_scope("mn_forward_backward"):
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_local, args, kwargs)
+            # the reference's allreduce_grad: mean over ranks, optional
+            # dtype compression, optional flat bucket — all in-program
+            with jax.named_scope("mn_allreduce_grad"):
+                grads = grad_transform(grads)
+            apply_grads = stale[0] if double_buffering else grads
+            with jax.named_scope("mn_optimizer_update"):
+                new_params, new_opt_state = apply_transform_update(
+                    tx, apply_grads, opt_state, params, hyper["lr"])
+            # per-rank scalars → global means for reporting / BN state
+            loss = lax.pmean(loss, axis)
+            obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
+            new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis), new_pstate)
+            return new_params, new_pstate, new_opt_state, loss, grads, obs
+
+        args_specs = jax.tree.map(
+            lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
+        kwargs_specs = jax.tree.map(
+            lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
+        mapped = shard_map(
+            rank_step, mesh=comm.mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), args_specs,
+                      kwargs_specs),
+            out_specs=(P(), P(), P(), P(), P(), P()),
+            check_vma=False)
+        # donate opt_state only (see core/optimizer.py note: Link arrays
+        # may be user-aliased)
+        return jax.jit(mapped, donate_argnums=(2,))
+
+    # -- misc reference API -----------------------------------------------------
+    def new_epoch(self):
+        self.actual_optimizer.new_epoch()
+
+    def add_hook(self, hook, name=None, timing="pre"):
+        self.actual_optimizer.add_hook(hook, name, timing)
+        self._mn_step_cache.clear()
+
+    def serialize(self, serializer):
+        self.actual_optimizer.serialize(serializer)
+
+
+class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
+    """One-step-stale gradient application (reference semantics).
+
+    Reference: ``optimizers.py · _DoubleBufferingOptimizer`` — allreduce of
+    step *t*'s grads overlaps step *t+1*'s compute; the applied gradient is
+    one step old.  Here both live in the same compiled program and XLA's
+    async dispatch provides the overlap; the observable contract (first
+    update applies zeros, update ``t`` applies grads of ``t-1``) matches.
+    """
+
+    _double_buffering = True

@@ -1,0 +1,22 @@
+"""Parallelism strategies beyond the reference's surface.
+
+SURVEY.md §2.6 accounting: DP/MP/PP and hand-TP are reference parity
+(communicators, MultiNodeChainList, functions); this package adds the
+TPU-native extensions — sequence/context parallelism (ring attention,
+Ulysses), microbatched pipelining, and N-D mesh helpers for hybrid
+layouts.
+"""
+
+from .mesh import make_mesh, axis_communicators, shard_batch, replicate
+from .ring_attention import ring_self_attention, ring_attention
+from .ulysses import (ulysses_attention, seq_to_head_shard,
+                      head_to_seq_shard)
+from .pipeline import gpipe_apply, split_microbatches, merge_microbatches
+from .moe import switch_moe, moe_dispatch_combine
+from .one_f_one_b import one_f_one_b, make_pipeline_train_step
+
+__all__ = ["make_mesh", "axis_communicators", "shard_batch", "replicate",
+           "ring_self_attention", "ring_attention", "ulysses_attention",
+           "seq_to_head_shard", "head_to_seq_shard", "gpipe_apply",
+           "split_microbatches", "merge_microbatches", "switch_moe",
+           "moe_dispatch_combine", "one_f_one_b", "make_pipeline_train_step"]

@@ -1,0 +1,59 @@
+"""Mesh construction helpers for hybrid parallelism.
+
+Reference: hybrid DP×MP via ``CommunicatorBase.split`` + two communicators
+(SURVEY.md §2.6).  The TPU idiom is one N-D mesh with named axes; these
+helpers build it and hand back per-axis communicators so reference-shaped
+code keeps working.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..communicators.mesh_communicator import MeshCommunicator
+
+__all__ = ["make_mesh", "axis_communicators", "shard_batch", "replicate"]
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """``make_mesh({'data': 4, 'model': 2})`` over the device list.
+
+    One axis size may be -1 (inferred).  Device order follows
+    ``jax.devices()`` — on real pods, order devices so the fastest-moving
+    axis rides ICI neighbors.
+    """
+    devices = list(devices) if devices is not None else list(jax.devices())
+    names = list(axis_sizes)
+    sizes = [axis_sizes[n] for n in names]
+    unknown = [i for i, s in enumerate(sizes) if s == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if unknown:
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {known}")
+        sizes[unknown[0]] = len(devices) // known
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} != {len(devices)} devices")
+    return Mesh(np.asarray(devices).reshape(sizes), tuple(names))
+
+
+def axis_communicators(mesh: Mesh, **kwargs) -> dict:
+    """One communicator per mesh axis (hybrid DP×MP×SP wiring)."""
+    return {name: MeshCommunicator.from_mesh_axis(mesh, name, **kwargs)
+            for name in mesh.axis_names}
+
+
+def shard_batch(x, mesh: Mesh, axis: str):
+    """Place a host batch sharded along ``axis`` on its leading dim."""
+    spec = P(axis)
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def replicate(x, mesh: Mesh):
+    return jax.device_put(x, NamedSharding(mesh, P()))

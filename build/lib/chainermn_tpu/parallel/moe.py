@@ -1,0 +1,106 @@
+"""Expert parallelism — Switch-style mixture-of-experts over all_to_all.
+
+Reference status: **absent** in ChainerMN (SURVEY.md §2.6 EP row: "not
+required for parity; all_to_all primitive should still be first-class").
+This module is the beyond-parity realization: experts are sharded one (or
+more) per rank along the communicator axis; tokens are routed top-1
+(Switch Transformer) with fixed per-expert capacity, exchanged with one
+``all_to_all``, transformed by the local expert's fused GEMMs, and
+returned by the reverse ``all_to_all`` — two collectives per MoE layer,
+the canonical EP pattern.
+
+Static shapes throughout (capacity-bounded dispatch with drop/pad), so
+XLA compiles one program regardless of routing decisions; gradients flow
+through the combine weights (straight-through on the router probability).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["switch_moe", "moe_dispatch_combine"]
+
+
+def _one_hot_capacity(expert_idx, n_experts, capacity):
+    """Position-in-expert assignment with capacity truncation.
+
+    Returns (dispatch_mask [T, E, C] bool, position [T]) — token t goes to
+    slot ``position[t]`` of its expert's buffer unless over capacity
+    (dropped: contributes zero output, gradient flows only via the
+    router's load-balancing loss).
+    """
+    T = expert_idx.shape[0]
+    onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [T,E]
+    # position of each token within its expert's queue
+    position = jnp.cumsum(onehot, axis=0) * onehot  # [T, E]
+    position = position.sum(axis=1) - 1             # [T]
+    keep = position < capacity
+    pos_cap = jnp.clip(position, 0, capacity - 1)
+    dispatch = (jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.bool_)
+                [:, :, None]
+                & jax.nn.one_hot(pos_cap, capacity, dtype=jnp.bool_)
+                [:, None, :]
+                & keep[:, None, None])
+    return dispatch, keep
+
+
+def moe_dispatch_combine(comm, x, gate_logits, expert_fn,
+                         capacity_factor=1.25):
+    """Route rank-local tokens through rank-sharded experts.
+
+    ``x``: [T_local, D] tokens on this rank; ``gate_logits``: [T_local, E]
+    with E == comm.size (one expert per rank); ``expert_fn(h)`` applies
+    this rank's expert to [E*C', D]... returns same shape.  Returns
+    ([T_local, D] combined output, aux dict with load-balancing stats).
+    """
+    axis = comm.axis_name
+    E = comm.size
+    T, D = x.shape
+    capacity = max(1, int(capacity_factor * T / E))
+
+    probs = jax.nn.softmax(gate_logits, axis=-1)            # [T, E]
+    expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]  # [T]
+
+    dispatch, keep = _one_hot_capacity(expert_idx, E, capacity)
+
+    # [E, C, D] buffer of tokens headed to each expert
+    send = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    # exchange: slot e of every rank converges on rank e
+    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                      # [E, C, D]
+    # local expert processes all ranks' contributions
+    h = expert_fn(recv.reshape(E * capacity, D)).reshape(E, capacity, D)
+    # return trip
+    back = lax.all_to_all(h, axis, split_axis=0, concat_axis=0,
+                          tiled=False)                      # [E, C, D]
+    combined = jnp.einsum("tec,ecd->td", dispatch.astype(x.dtype), back)
+    combined = combined * gate[:, None]
+
+    # Switch load-balancing loss: E * sum_e fraction_e * mean_prob_e
+    frac = jnp.mean(dispatch.any(axis=2).astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(frac * mean_prob)
+    return combined, {"aux_loss": aux_loss,
+                      "dropped": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+                      "capacity": capacity}
+
+
+def switch_moe(comm, x, router_w, w_in, b_in, w_out, b_out,
+               capacity_factor=1.25, activation=jax.nn.gelu):
+    """Complete Switch-MoE layer: router + rank-local expert MLP.
+
+    ``x``: [T_local, D].  ``router_w``: [D, E] (replicated).  ``w_in``:
+    this rank's expert weights [D, H]; ``w_out``: [H, D] (shard the
+    stacked [E, ...] expert bank with ``P(axis)``).  Returns
+    ([T_local, D], aux).
+    """
+    gate_logits = x @ router_w
+
+    def expert_fn(h):
+        return activation(h @ w_in + b_in) @ w_out + b_out
+
+    return moe_dispatch_combine(comm, x, gate_logits, expert_fn,
+                                capacity_factor=capacity_factor)

@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Reference status: **absent** in ChainerMN (SURVEY.md §2.6: SP/CP row —
+"rebuild extension"); SURVEY §5 long-context note prescribes ring
+attention via ppermute KV rotation built on the L3 primitives.
+
+Design (blockwise ring attention, Liu et al.-style): the sequence is
+sharded over the communicator axis ([B, H, T/n, D] per rank).  Each rank
+keeps its query block resident and rotates K/V blocks around the ring
+with ``lax.ppermute`` (ICI neighbor exchanges); partial attention is
+accumulated with the numerically-stable online-softmax recurrence
+(running max ``m``, normalizer ``l``, weighted accumulator) so the result
+is exact — identical to full attention on the gathered sequence — while
+no rank ever materializes more than one remote KV block.  Peak memory is
+O(T/n), and XLA overlaps each step's ppermute with the previous block's
+matmuls.
+
+Causal masking is chunk-aware: a KV block strictly in the future is
+skipped-by-masking, the diagonal block gets the triangular mask, past
+blocks attend fully.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_self_attention", "ring_attention"]
+
+
+def _block_attention(q, k, v, m, l, acc, mask, scale):
+    """One online-softmax accumulation step for a KV block."""
+    # q: [B, H, Tq, D]; k/v: [B, H, Tk, D]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m_block = jnp.max(scores, axis=-1, keepdims=True)     # [B,H,Tq,1]
+    m_new = jnp.maximum(m, m_block)
+    # all-masked blocks produce -inf maxima; keep the recurrence finite
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - m_safe)
+    p = jnp.where(mask, p, 0.0)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_self_attention(comm, q, k, v, causal=False, scale=None):
+    """Exact self-attention over a sequence sharded on ``comm``'s axis.
+
+    ``q``/``k``/``v``: rank-local [B, H, T_local, D] (call inside a
+    ``shard_map`` over the axis, e.g. via ``comm.run_spmd`` with specs
+    splitting the T dimension).  Returns the local [B, H, T_local, D]
+    output block.
+    """
+    axis = comm.axis_name
+    size = comm.size
+    B, H, Tq, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    my_chunk = lax.axis_index(axis)
+    perm = [(i, (i + 1) % size) for i in range(size)]
+
+    q32 = q.astype(jnp.float32)
+    m = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    acc = jnp.zeros((B, H, Tq, D), jnp.float32)
+
+    q_pos = my_chunk * Tq + lax.broadcasted_iota(jnp.int32, (Tq, 1), 0)
+
+    def step(carry, step_idx):
+        k_cur, v_cur, m, l, acc = carry
+        # KV block currently held arrived from rank (me - step) mod size
+        kv_chunk = (my_chunk - step_idx) % size
+        Tk = k_cur.shape[2]
+        if causal:
+            kv_pos = kv_chunk * Tk + lax.broadcasted_iota(
+                jnp.int32, (1, Tk), 1)
+            mask = (q_pos >= kv_pos)[None, None]          # [1,1,Tq,Tk]
+        else:
+            mask = jnp.ones((1, 1, Tq, Tk), bool)
+        m, l, acc = _block_attention(q32, k_cur.astype(jnp.float32),
+                                     v_cur, m, l, acc, mask, scale)
+        # rotate KV to the next rank (no-op effect on the last step's
+        # carry, but keeps the loop uniform; XLA overlaps it with compute)
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    (k_f, v_f, m, l, acc), _ = lax.scan(
+        step, (k, v, m, l, acc), jnp.arange(size))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(comm, q, k, v, causal=False, scale=None):
+    """Cross-attention variant: same rotation, ``q`` and KV may have
+    different local lengths."""
+    return ring_self_attention(comm, q, k, v, causal=causal, scale=scale)

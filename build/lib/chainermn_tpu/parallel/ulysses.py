@@ -1,0 +1,65 @@
+"""Ulysses-style sequence parallelism — all_to_all head exchange.
+
+Reference status: **absent** in ChainerMN (SURVEY.md §2.6); SURVEY §5
+names the differentiable ``alltoall`` as the Ulysses-shaped primitive.
+
+The sequence axis is sharded across ranks; for attention, an
+``all_to_all`` re-shards from sequence-split [B, H, T/n, D] to head-split
+[B, H/n, T, D], full attention runs per local head group over the whole
+sequence, and a reverse ``all_to_all`` restores sequence sharding.  Two
+collectives per attention layer, each moving activations once — the
+bandwidth-optimal exchange when H ≥ n.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ulysses_attention", "seq_to_head_shard", "head_to_seq_shard"]
+
+
+def seq_to_head_shard(comm, x):
+    """[B, H, T_local, D] (sequence-sharded) → [B, H/n, T, D] (head-sharded)."""
+    size = comm.size
+    B, H, Tl, D = x.shape
+    if H % size != 0:
+        raise ValueError(f"head count {H} not divisible by axis size {size}")
+    return lax.all_to_all(x, comm.axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def head_to_seq_shard(comm, x):
+    """[B, H/n, T, D] (head-sharded) → [B, H, T_local, D] (sequence-sharded)."""
+    return lax.all_to_all(x, comm.axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _full_attention(q, k, v, causal, scale):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        T = scores.shape[-1]
+        qpos = lax.broadcasted_iota(jnp.int32, (T, T), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (T, T), 1)
+        scores = jnp.where((qpos >= kpos)[None, None], scores, -jnp.inf)
+    p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      preferred_element_type=jnp.float32)
+
+
+def ulysses_attention(comm, q, k, v, causal=False, scale=None):
+    """Exact attention with Ulysses sequence parallelism.
+
+    Inputs rank-local [B, H, T_local, D] sequence shards; output the same.
+    Identical math to full attention on the gathered sequence.
+    """
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    qh = seq_to_head_shard(comm, q)
+    kh = seq_to_head_shard(comm, k)
+    vh = seq_to_head_shard(comm, v)
+    out = _full_attention(qh, kh, vh, causal, scale).astype(q.dtype)
+    return head_to_seq_shard(comm, out)

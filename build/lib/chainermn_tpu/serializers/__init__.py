@@ -1,0 +1,1 @@
+from .npz import (DictionarySerializer, NpzDeserializer, save_npz, load_npz)

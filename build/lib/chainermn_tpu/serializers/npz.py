@@ -1,0 +1,97 @@
+"""NPZ serializers (consumed-Chainer surface: ``chainer.serializers``).
+
+Reference: ``chainer/serializers/npz.py · save_npz/load_npz,
+DictionarySerializer, NpzDeserializer`` (SURVEY.md §2.8).  The serializer
+protocol — ``serializer('key', value)`` plus ``serializer['child']``
+hierarchical descent — is what ``Link.serialize``, ``Optimizer.serialize``,
+``Trainer.serialize`` and the distributed checkpointer (SURVEY §3.5) speak.
+Arrays cross through numpy; ``jax.Array`` leaves are pulled to host on save
+and re-placed lazily on load.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DictionarySerializer", "NpzDeserializer", "save_npz", "load_npz"]
+
+
+class Serializer:
+    is_writer = False
+
+    def __getitem__(self, name):
+        raise NotImplementedError
+
+    def __call__(self, key, value):
+        raise NotImplementedError
+
+
+class DictionarySerializer(Serializer):
+    is_writer = True
+
+    def __init__(self, target=None, path=""):
+        self.target = {} if target is None else target
+        self.path = path
+
+    def __getitem__(self, name):
+        return DictionarySerializer(self.target, self.path + name + "/")
+
+    def __call__(self, key, value):
+        if value is None:
+            arr = np.array([], dtype=np.float32)
+        elif np.isscalar(value) or isinstance(value, (bool, int, float)):
+            arr = np.asarray(value)
+        else:
+            arr = np.asarray(value)
+        self.target[self.path + key] = arr
+        return value
+
+
+class NpzDeserializer(Serializer):
+    is_writer = False
+
+    def __init__(self, npz, path="", strict=True):
+        self.npz = npz
+        self.path = path
+        self.strict = strict
+
+    def __getitem__(self, name):
+        return NpzDeserializer(self.npz, self.path + name + "/", self.strict)
+
+    def __call__(self, key, value):
+        full = self.path + key
+        if full not in self.npz:
+            if self.strict:
+                raise KeyError(f"key {full!r} not found in snapshot")
+            return value
+        data = self.npz[full]
+        if data.size == 0 and value is None:
+            return None
+        return data
+
+
+def save_npz(file, obj, compression=True):
+    s = DictionarySerializer()
+    obj.serialize(s)
+    with open(file, "wb") if isinstance(file, str) else _nullctx(file) as f:
+        if compression:
+            np.savez_compressed(f, **s.target)
+        else:
+            np.savez(f, **s.target)
+
+
+def load_npz(file, obj, path="", strict=True):
+    with np.load(file, allow_pickle=False) as npz:
+        d = NpzDeserializer(npz, path=path, strict=strict)
+        obj.serialize(d)
+
+
+class _nullctx:
+    def __init__(self, f):
+        self.f = f
+
+    def __enter__(self):
+        return self.f
+
+    def __exit__(self, *exc):
+        return False

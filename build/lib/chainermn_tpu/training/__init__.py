@@ -1,0 +1,5 @@
+from .trainer import (Trainer, Extension, make_extension, PRIORITY_WRITER,
+                      PRIORITY_EDITOR, PRIORITY_READER)
+from .updaters import Updater, StandardUpdater
+from . import triggers
+from . import extensions

@@ -1,0 +1,183 @@
+"""Trainer loop (consumed-Chainer surface: ``chainer.training.Trainer``).
+
+Reference: ``chainer/training/trainer.py · Trainer`` (SURVEY.md §2.8, §3.2).
+Runs the updater until ``stop_trigger`` fires, invoking extensions by
+priority inside a per-iteration ``Reporter`` observation scope — the exact
+interposition surface the multi-node evaluator / checkpointer / log
+extensions rely on.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import time
+import traceback
+
+from ..core import reporter as reporter_module
+from .triggers import get_trigger
+
+__all__ = ["Trainer", "Extension", "make_extension",
+           "PRIORITY_WRITER", "PRIORITY_EDITOR", "PRIORITY_READER"]
+
+PRIORITY_WRITER = 300
+PRIORITY_EDITOR = 200
+PRIORITY_READER = 100
+
+
+class Extension:
+    """Base extension (reference: ``chainer/training/extension.py``)."""
+
+    trigger = (1, "iteration")
+    priority = PRIORITY_READER
+    name = None
+
+    @property
+    def default_name(self):
+        return type(self).__name__
+
+    def __call__(self, trainer):
+        raise NotImplementedError
+
+    def initialize(self, trainer):
+        pass
+
+    def finalize(self):
+        pass
+
+    def on_error(self, trainer, exc, tb):
+        pass
+
+    def serialize(self, serializer):
+        pass
+
+
+def make_extension(trigger=(1, "iteration"), default_name=None,
+                   priority=PRIORITY_READER, initializer=None):
+    def decorator(ext):
+        ext.trigger = trigger
+        ext.default_name = default_name or getattr(ext, "__name__", "extension")
+        ext.priority = priority
+        if initializer is not None:
+            ext.initialize = initializer
+        return ext
+    return decorator
+
+
+class _ExtensionEntry:
+    def __init__(self, extension, name, trigger, priority):
+        self.extension = extension
+        self.name = name
+        self.trigger = get_trigger(trigger)
+        self.priority = priority
+
+
+class Trainer:
+    def __init__(self, updater, stop_trigger=None, out="result"):
+        self.updater = updater
+        # None → train until interrupted (reference semantics)
+        self.stop_trigger = get_trigger(stop_trigger) or (lambda trainer: False)
+        self.out = out
+        self.observation = {}
+        self.reporter = reporter_module.Reporter()
+        for name, optimizer in updater.get_all_optimizers().items():
+            self.reporter.add_observer(name, optimizer.target)
+            self.reporter.add_observers(
+                name, optimizer.target.namedlinks(skipself=True))
+        self._extensions = collections.OrderedDict()
+        self._start_at = None
+        self._snapshot_elapsed_time = 0.0
+        self._done = False
+        updater.connect_trainer(self)
+
+    @property
+    def elapsed_time(self):
+        if self._start_at is None:
+            return self._snapshot_elapsed_time
+        return time.time() - self._start_at + self._snapshot_elapsed_time
+
+    def extend(self, extension, name=None, trigger=None, priority=None,
+               call_before_training=False):
+        if name is None:
+            name = getattr(extension, "name", None) or \
+                getattr(extension, "default_name", None) or \
+                getattr(extension, "__name__", None) or \
+                type(extension).__name__
+        if trigger is None:
+            trigger = getattr(extension, "trigger", (1, "iteration"))
+        if priority is None:
+            priority = getattr(extension, "priority", PRIORITY_READER)
+        original = name
+        ordinal = 0
+        while name in self._extensions:
+            ordinal += 1
+            name = f"{original}_{ordinal}"
+        entry = _ExtensionEntry(extension, name, trigger, priority)
+        entry.call_before_training = call_before_training
+        self._extensions[name] = entry
+
+    def get_extension(self, name):
+        return self._extensions[name].extension
+
+    def run(self, show_loop_exception_msg=True):
+        if self._done:
+            raise RuntimeError("cannot run training loop multiple times")
+        os.makedirs(self.out, exist_ok=True)
+        extensions = sorted(self._extensions.values(),
+                            key=lambda e: -e.priority)
+        self._start_at = time.time()
+        for entry in extensions:
+            initializer = getattr(entry.extension, "initialize", None)
+            if initializer:
+                initializer(self)
+        for entry in extensions:
+            if getattr(entry, "call_before_training", False):
+                entry.extension(self)
+        update = self.updater.update
+        try:
+            while not self.stop_trigger(self):
+                self.observation = {}
+                with self.reporter.scope(self.observation):
+                    update()
+                    for entry in extensions:
+                        if entry.trigger is None or entry.trigger(self):
+                            entry.extension(self)
+        except Exception as e:
+            if show_loop_exception_msg:
+                print("Exception in main training loop:", e)
+                traceback.print_exc()
+            for entry in extensions:
+                on_error = getattr(entry.extension, "on_error", None)
+                if on_error:
+                    on_error(self, e, None)
+            raise
+        finally:
+            for entry in extensions:
+                finalize = getattr(entry.extension, "finalize", None)
+                if finalize:
+                    finalize()
+            self.updater.finalize()
+            self._done = True
+
+    def serialize(self, serializer):
+        self.updater.serialize(serializer["updater"])
+        if hasattr(self.stop_trigger, "serialize"):
+            self.stop_trigger.serialize(serializer["stop_trigger"])
+        s = serializer["extensions"]
+        t = serializer["extension_triggers"]
+        for name, entry in self._extensions.items():
+            if hasattr(entry.extension, "serialize"):
+                try:
+                    entry.extension.serialize(s[name])
+                except Exception:
+                    pass
+            if hasattr(entry.trigger, "serialize"):
+                try:
+                    entry.trigger.serialize(t[name])
+                except Exception:
+                    pass
+        if serializer.is_writer:
+            serializer("_snapshot_elapsed_time", self.elapsed_time)
+        else:
+            self._snapshot_elapsed_time = float(
+                serializer("_snapshot_elapsed_time", 0.0))

@@ -1,0 +1,94 @@
+"""Triggers (consumed-Chainer surface: ``chainer.training.triggers``).
+
+Reference: ``chainer/training/triggers/interval_trigger.py ·
+IntervalTrigger``, ``minmax_value_trigger.py``, ``once_trigger.py``
+(SURVEY.md §2.8).  A trigger is a callable ``trigger(trainer) -> bool``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["IntervalTrigger", "OnceTrigger", "MaxValueTrigger",
+           "MinValueTrigger", "get_trigger"]
+
+
+class IntervalTrigger:
+    def __init__(self, period, unit):
+        assert unit in ("iteration", "epoch")
+        self.period = period
+        self.unit = unit
+        self._previous_iteration = 0
+        self._previous_epoch_detail = 0.0
+
+    def __call__(self, trainer):
+        updater = trainer.updater
+        if self.unit == "epoch":
+            prev = self._previous_epoch_detail
+            self._previous_epoch_detail = updater.epoch_detail
+            return (prev // self.period) != (updater.epoch_detail // self.period)
+        prev = self._previous_iteration
+        self._previous_iteration = updater.iteration
+        return (prev // self.period) != (updater.iteration // self.period)
+
+    def serialize(self, serializer):
+        self._previous_iteration = int(serializer(
+            "previous_iteration", self._previous_iteration))
+        self._previous_epoch_detail = float(serializer(
+            "previous_epoch_detail", self._previous_epoch_detail))
+
+    def __str__(self):
+        return f"IntervalTrigger({self.period}, '{self.unit}')"
+
+
+class OnceTrigger:
+    def __init__(self, call_on_resume=False):
+        self._flag_first = True
+        self._flag_resumed = call_on_resume
+
+    def __call__(self, trainer):
+        fire = self._flag_first or self._flag_resumed
+        self._flag_first = False
+        self._flag_resumed = False
+        return fire
+
+
+class _BestValueTrigger:
+    def __init__(self, key, compare, trigger=(1, "epoch")):
+        self._key = key
+        self._compare = compare
+        self._interval = get_trigger(trigger)
+        self._best = None
+        self._summary = []
+
+    def __call__(self, trainer):
+        obs = trainer.observation
+        if self._key in obs:
+            self._summary.append(float(np.asarray(obs[self._key])))
+        if not self._interval(trainer) or not self._summary:
+            return False
+        value = float(np.mean(self._summary))
+        self._summary = []
+        if self._best is None or self._compare(self._best, value):
+            self._best = value
+            return True
+        return False
+
+
+class MaxValueTrigger(_BestValueTrigger):
+    def __init__(self, key, trigger=(1, "epoch")):
+        super().__init__(key, lambda best, new: new > best, trigger)
+
+
+class MinValueTrigger(_BestValueTrigger):
+    def __init__(self, key, trigger=(1, "epoch")):
+        super().__init__(key, lambda best, new: new < best, trigger)
+
+
+def get_trigger(trigger):
+    if trigger is None:
+        return None
+    if callable(trigger):
+        return trigger
+    period, unit = trigger
+    return IntervalTrigger(period, unit)

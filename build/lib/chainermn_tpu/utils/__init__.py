@@ -1,0 +1,2 @@
+from .platform import use_platform, simulate_devices
+from .profiling import trace, annotate, Profile

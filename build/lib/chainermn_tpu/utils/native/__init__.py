@@ -1,0 +1,118 @@
+"""ctypes binding + build for the native data-loader core.
+
+Compiled on first use with g++ (cached beside the source); degrades
+gracefully to None when no toolchain is available — consumers fall back
+to the pure-Python iterators.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["load_library", "NativeLoader"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build(src, out):
+    subprocess.run(
+        ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+         "-pthread", src, "-o", out],
+        check=True, capture_output=True)
+
+
+def load_library():
+    """Build (if needed) and load the shared library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        here = os.path.dirname(__file__)
+        src = os.path.join(here, "dataloader.cpp")
+        out = os.path.join(here, "_dataloader.so")
+        try:
+            if not os.path.exists(out) or \
+                    os.path.getmtime(out) < os.path.getmtime(src):
+                _build(src, out)
+            lib = ctypes.CDLL(out)
+        except Exception:
+            return None
+        lib.loader_create.restype = ctypes.c_void_p
+        lib.loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        lib.loader_submit.restype = ctypes.c_int
+        lib.loader_submit.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        lib.loader_next.restype = ctypes.c_int
+        lib.loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeLoader:
+    """One gather engine over a contiguous [N, ...] numpy array."""
+
+    def __init__(self, array: np.ndarray, max_batch: int, n_buffers=3,
+                 n_threads=4):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (no g++?)")
+        self._lib = lib
+        self._array = np.ascontiguousarray(array)  # keep alive
+        self.row_shape = self._array.shape[1:]
+        self.dtype = self._array.dtype
+        self._row_bytes = int(self._array.dtype.itemsize
+                              * np.prod(self.row_shape, dtype=np.int64))
+        self.max_batch = max_batch
+        self._handle = lib.loader_create(
+            self._array.ctypes.data_as(ctypes.c_void_p),
+            self._array.shape[0], self._row_bytes, max_batch,
+            n_buffers, n_threads)
+
+    def submit(self, indices: np.ndarray):
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        rc = self._lib.loader_submit(
+            self._handle, idx.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int64)), idx.size)
+        if rc != 0:
+            raise ValueError("invalid indices or batch too large")
+
+    def next(self) -> tuple:
+        """→ (batch_copy, buffer_id is auto-released)."""
+        ptr = ctypes.c_void_p()
+        rows = ctypes.c_int64()
+        buf_id = self._lib.loader_next(self._handle, ctypes.byref(ptr),
+                                       ctypes.byref(rows))
+        if buf_id < 0:
+            raise RuntimeError("loader stopped")
+        n = rows.value
+        raw = (ctypes.c_char * (n * self._row_bytes)).from_address(ptr.value)
+        batch = np.frombuffer(raw, dtype=self.dtype).reshape(
+            (n,) + self.row_shape).copy()
+        self._lib.loader_release(self._handle, buf_id)
+        return batch
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

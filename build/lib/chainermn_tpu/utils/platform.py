@@ -1,0 +1,31 @@
+"""Platform selection helpers.
+
+This machine's interpreter boots with the axon TPU plugin registered by a
+``sitecustomize`` (JAX_PLATFORMS=axon baked in before any user code), so
+ordinary ``JAX_PLATFORMS=cpu`` env overrides are ineffective —
+``jax.config.update`` after import is the reliable lever.  Used by the
+examples' ``--platform`` flags and the test conftest.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["use_platform", "simulate_devices"]
+
+
+def use_platform(name: str | None):
+    """Pin the JAX platform ('cpu'/'tpu'/'axon'); None keeps the default."""
+    if name:
+        jax.config.update("jax_platforms", name)
+
+
+def simulate_devices(n: int):
+    """Request n simulated host devices (effective only before the CPU
+    backend first initializes — call early)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n} " + flags)

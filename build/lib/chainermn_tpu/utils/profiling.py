@@ -1,0 +1,68 @@
+"""Profiling helpers (SURVEY.md §5 tracing note).
+
+The reference's story was TimerHook/CupyMemoryProfileHook + nvprof; the
+TPU rebuild rides ``jax.profiler`` (XProf/TensorBoard traces with HLO,
+fusion, and ICI collective timelines) — strictly better out of the box.
+These helpers wrap it in the framework's vocabulary, plus a trainer
+extension that captures a trace window mid-run.  The ``dummy``
+communicator remains the tool for compute-vs-communication attribution
+(run the same script twice, diff the step times — the reference's own
+methodology).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..training.trainer import Extension
+
+__all__ = ["trace", "annotate", "Profile"]
+
+
+@contextlib.contextmanager
+def trace(log_dir="/tmp/chainermn_tpu_trace"):
+    """Capture a jax.profiler trace (open with TensorBoard/XProf)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name):
+    """Named scope visible in trace timelines (``jax.named_scope``)."""
+    return jax.named_scope(name)
+
+
+class Profile(Extension):
+    """Trainer extension: trace iterations [start, start+n_steps).
+
+    ``trainer.extend(Profile(start=10, n_steps=3))`` captures steady-state
+    steps (skipping compilation) into ``<out>/trace``.
+    """
+
+    trigger = (1, "iteration")
+    priority = 400  # before anything else each iteration
+
+    def __init__(self, start=10, n_steps=3, log_dir=None):
+        self.start = start
+        self.n_steps = n_steps
+        self.log_dir = log_dir
+        self._active = False
+
+    def __call__(self, trainer):
+        it = trainer.updater.iteration
+        if not self._active and it == self.start:
+            jax.profiler.start_trace(
+                self.log_dir or f"{trainer.out}/trace")
+            self._active = True
+        elif self._active and it >= self.start + self.n_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def finalize(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
