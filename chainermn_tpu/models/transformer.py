@@ -164,6 +164,31 @@ class _GenerationMixin:
         shape = (n, 2, batch, H, max_len, D)
         return jnp.zeros(shape, jnp.float32)
 
+    def _prefill(self, prompt, cache):
+        """Full-forward pass over the prompt, capturing per-layer K/V into
+        the cache; returns (cache, last-position logits)."""
+        B, T0 = prompt.shape
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, T0), 1)
+        h = self.embed(prompt) + self.pos_embed(
+            jnp.broadcast_to(pos, (B, T0)))
+        for i, block in enumerate(self.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x.reshape(B * T0, -1)).reshape(
+                B, T0, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = [jnp.moveaxis(qkv[:, :, j], 1, 2) for j in range(3)]
+            cache = cache.at[i, 0, :, :, :T0].set(k.astype(jnp.float32))
+            cache = cache.at[i, 1, :, :, :T0].set(v.astype(jnp.float32))
+            from ..ops import xla_attention
+            att = xla_attention(q, k, v, causal=True)
+            att = jnp.moveaxis(att, 2, 1).reshape(B * T0, -1)
+            h = h + block.attn.proj(att).reshape(B, T0, -1)
+            m = block.fc2(F.gelu(block.fc1(
+                block.ln2(h).reshape(B * T0, -1))))
+            h = h + m.reshape(B, T0, -1)
+        h = self.ln_f(h)
+        logits = self.head(h[:, -1])
+        return cache, logits
+
     def _step_logits(self, tok, pos, cache):
         """One-token forward through all blocks using/updating the cache."""
         B = tok.shape[0]
@@ -199,18 +224,9 @@ class _GenerationMixin:
         B, T0 = prompt.shape
         max_len = T0 + max_new_tokens
         cache = self.init_cache(B, max_len)
-
-        # prefill: feed the prompt token by token (simple + exact; a
-        # batched prefill is the obvious follow-up optimization)
-        def prefill(carry, t):
-            cache, _ = carry
-            tok = jax.lax.dynamic_index_in_dim(prompt, t, 1, False)
-            logits, cache = self._step_logits(tok, t, cache)
-            return (cache, logits), None
-
-        (cache, logits), _ = jax.lax.scan(
-            prefill, (cache, jnp.zeros((B, self.head.out_size))),
-            jnp.arange(T0))
+        # batched prefill: one full forward over the prompt fills every
+        # layer's K/V cache (MXU-sized GEMMs instead of T0 tiny steps)
+        cache, logits = self._prefill(prompt, cache)
 
         def pick(logits, k):
             if temperature == 0.0:
@@ -234,5 +250,6 @@ class _GenerationMixin:
 
 # graft generation onto the LM (kept separate for readability)
 TransformerLM.init_cache = _GenerationMixin.init_cache
+TransformerLM._prefill = _GenerationMixin._prefill
 TransformerLM._step_logits = _GenerationMixin._step_logits
 TransformerLM.generate = _GenerationMixin.generate
