@@ -12,11 +12,12 @@ from .ring_attention import ring_self_attention, ring_attention
 from .ulysses import (ulysses_attention, seq_to_head_shard,
                       head_to_seq_shard)
 from .pipeline import gpipe_apply, split_microbatches, merge_microbatches
-from .moe import switch_moe, moe_dispatch_combine
+from .moe import (switch_moe, moe_dispatch_combine,
+                  moe_dispatch_combine_topk)
 from .one_f_one_b import one_f_one_b, make_pipeline_train_step
 
 __all__ = ["make_mesh", "axis_communicators", "shard_batch", "replicate",
            "ring_self_attention", "ring_attention", "ulysses_attention",
            "seq_to_head_shard", "head_to_seq_shard", "gpipe_apply",
            "split_microbatches", "merge_microbatches", "switch_moe",
-           "moe_dispatch_combine", "one_f_one_b", "make_pipeline_train_step"]
+           "moe_dispatch_combine", "moe_dispatch_combine_topk", "one_f_one_b", "make_pipeline_train_step"]

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["switch_moe", "moe_dispatch_combine"]
+__all__ = ["switch_moe", "moe_dispatch_combine", "moe_dispatch_combine_topk"]
 
 
 def _one_hot_capacity(expert_idx, n_experts, capacity):
@@ -104,3 +104,63 @@ def switch_moe(comm, x, router_w, w_in, b_in, w_out, b_out,
 
     return moe_dispatch_combine(comm, x, gate_logits, expert_fn,
                                 capacity_factor=capacity_factor)
+
+
+def _topk_dispatch(probs, k, capacity):
+    """Joint top-k capacity assignment.
+
+    Returns (dispatch [T, k, E, C] bool, gates [T, k], keep [T, k]).
+    Queue positions are counted jointly across all (token, slot) pairs in
+    (token-major, slot-minor) order so no two routed copies collide in an
+    expert's buffer.
+    """
+    T, E = probs.shape
+    gates, experts = jax.lax.top_k(probs, k)          # [T, k]
+    flat_expert = experts.reshape(T * k)
+    onehot = jax.nn.one_hot(flat_expert, E, dtype=jnp.int32)  # [T*k, E]
+    position = jnp.cumsum(onehot, axis=0) * onehot
+    position = position.sum(axis=1) - 1               # [T*k]
+    keep = (position < capacity).reshape(T, k)
+    pos_cap = jnp.clip(position, 0, capacity - 1)
+    dispatch = (jax.nn.one_hot(flat_expert, E, dtype=jnp.bool_)[:, :, None]
+                & jax.nn.one_hot(pos_cap, capacity, dtype=jnp.bool_)
+                [:, None, :])
+    dispatch = dispatch.reshape(T, k, E, capacity) & keep[:, :, None, None]
+    return dispatch, gates, keep
+
+
+def moe_dispatch_combine_topk(comm, x, gate_logits, expert_fn, k=2,
+                              capacity_factor=1.25, normalize_gates=True):
+    """Top-k routing variant of :func:`moe_dispatch_combine`.
+
+    Each token is processed by its ``k`` highest-probability experts and
+    the outputs are combined with (optionally renormalized) gate weights —
+    the GShard-style generalization of Switch routing.
+    """
+    axis = comm.axis_name
+    E = comm.size
+    T, D = x.shape
+    capacity = max(1, int(capacity_factor * k * T / E))
+
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    dispatch, gates, keep = _topk_dispatch(probs, k, capacity)
+    if normalize_gates:
+        denom = jnp.maximum(gates.sum(axis=1, keepdims=True), 1e-9)
+        gates = gates / denom
+    gates = gates * keep.astype(gates.dtype)
+
+    send = jnp.einsum("tkec,td->ecd", dispatch.astype(x.dtype), x)
+    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    h = expert_fn(recv.reshape(E * capacity, D)).reshape(E, capacity, D)
+    back = lax.all_to_all(h, axis, split_axis=0, concat_axis=0,
+                          tiled=False)
+    combined = jnp.einsum("tkec,tk,ecd->td", dispatch.astype(x.dtype),
+                          gates, back)
+
+    frac = jnp.mean(dispatch.any(axis=(1, 3)).astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(frac * mean_prob)
+    return combined, {"aux_loss": aux_loss,
+                      "dropped": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+                      "capacity": capacity}

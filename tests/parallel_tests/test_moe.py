@@ -92,3 +92,41 @@ def test_moe_trains():
         if '_l0' not in dir():
             _l0 = float(np.asarray(l)[0])
     assert float(np.asarray(l)[0]) < _l0
+
+
+def test_topk_moe_matches_dense_topk():
+    """k=2 routing at generous capacity == dense top-2 mixture."""
+    from chainermn_tpu.parallel import moe_dispatch_combine_topk
+    D, H = 8, 16
+    router, w_in, b_in, w_out, b_out = _weights(D, H, seed=4)
+    E = COMM.size
+    T_local = 4
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.normal(0, 1, (E * T_local, D)).astype(np.float32))
+
+    def body(x, router, w_in, b_in, w_out, b_out):
+        def expert(h):
+            return jax.nn.gelu(h @ w_in[0] + b_in[0]) @ w_out[0] + b_out[0]
+        out, aux = moe_dispatch_combine_topk(
+            COMM, x, x @ router, expert, k=2, capacity_factor=float(E))
+        return out
+
+    out = COMM.run_spmd(
+        body, x, router, w_in, b_in, w_out, b_out,
+        in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
+        out_specs=P("ep"))
+
+    xn = np.asarray(x)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(xn) @ router, axis=-1))
+    topk = np.argsort(-probs, axis=1)[:, :2]
+    expect = np.zeros_like(xn)
+    for t in range(xn.shape[0]):
+        g = probs[t, topk[t]]
+        g = g / g.sum()
+        for j, e in enumerate(topk[t]):
+            h = np.asarray(jax.nn.gelu(jnp.asarray(
+                xn[t] @ np.asarray(w_in)[e] + np.asarray(b_in)[e])))
+            expect[t] += g[j] * (h @ np.asarray(w_out)[e]
+                                 + np.asarray(b_out)[e])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=3e-4,
+                               atol=3e-5)
