@@ -5,6 +5,7 @@ from .datasets import (DatasetMixin, TupleDataset, DictDataset, SubDataset,
 from .iterators import (Iterator, SerialIterator, MultiprocessIterator,
                         MultithreadIterator)
 from .convert import concat_examples, to_device, identity_converter
+from .image_dataset import ImageDataset, LabeledImageDataset
 
 try:
     from .native_iterator import NativeBatchIterator

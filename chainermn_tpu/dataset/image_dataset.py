@@ -1,0 +1,90 @@
+"""File-based image datasets.
+
+Reference: ``chainer/datasets/image_dataset.py · ImageDataset,
+LabeledImageDataset`` (SURVEY.md §2.8; the reference's ImageNet example
+scatters file *paths*, not tensors — §3.4 note).  Files are read lazily
+per example (PIL for standard formats, ``.npy`` natively), decoded to
+float32 NCHW; combine with ``scatter_dataset`` (which ships index specs)
+and ``MultithreadIterator`` for a prefetching input pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .datasets import DatasetMixin
+
+__all__ = ["ImageDataset", "LabeledImageDataset"]
+
+
+def _read_image(path, dtype=np.float32):
+    if path.endswith(".npy"):
+        arr = np.load(path)
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[0] not in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)  # HWC → CHW
+        return arr.astype(dtype)
+    from PIL import Image
+    with Image.open(path) as img:
+        arr = np.asarray(img, dtype=dtype)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+class ImageDataset(DatasetMixin):
+    """Dataset of image file paths → float32 CHW arrays.
+
+    ``paths``: list of paths or a text file with one path per line.
+    """
+
+    def __init__(self, paths, root=".", dtype=np.float32):
+        if isinstance(paths, str):
+            with open(paths) as f:
+                paths = [line.strip() for line in f if line.strip()]
+        self._paths = list(paths)
+        self._root = root
+        self._dtype = dtype
+
+    def __len__(self):
+        return len(self._paths)
+
+    def get_example(self, i):
+        return _read_image(os.path.join(self._root, self._paths[i]),
+                           self._dtype)
+
+
+class LabeledImageDataset(DatasetMixin):
+    """(image, label) pairs from files.
+
+    ``pairs``: list of (path, int) tuples or a text file of
+    ``<path> <label>`` lines (the reference's ImageNet list format).
+    """
+
+    def __init__(self, pairs, root=".", dtype=np.float32,
+                 label_dtype=np.int32):
+        if isinstance(pairs, str):
+            parsed = []
+            with open(pairs) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 2:
+                        parsed.append((parts[0], int(parts[1])))
+            pairs = parsed
+        self._pairs = list(pairs)
+        self._root = root
+        self._dtype = dtype
+        self._label_dtype = label_dtype
+
+    def __len__(self):
+        return len(self._pairs)
+
+    def get_example(self, i):
+        path, label = self._pairs[i]
+        image = _read_image(os.path.join(self._root, path), self._dtype)
+        return image, np.asarray(label, self._label_dtype)

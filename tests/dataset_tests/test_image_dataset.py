@@ -1,0 +1,53 @@
+"""File-based image datasets (npy + PIL paths)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.dataset import ImageDataset, LabeledImageDataset
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(4):
+        arr = rng.randint(0, 255, (5, 6, 3)).astype(np.uint8)  # HWC
+        p = tmp_path / f"im{i}.npy"
+        np.save(str(p), arr)
+        paths.append(f"im{i}.npy")
+    try:
+        from PIL import Image
+        png = rng.randint(0, 255, (5, 6, 3)).astype(np.uint8)
+        Image.fromarray(png).save(str(tmp_path / "im_png.png"))
+        paths.append("im_png.png")
+    except ImportError:
+        pass
+    return str(tmp_path), paths
+
+
+def test_image_dataset(image_dir):
+    root, paths = image_dir
+    ds = ImageDataset(paths, root=root)
+    assert len(ds) == len(paths)
+    img = ds[0]
+    assert img.shape == (3, 5, 6)       # CHW
+    assert img.dtype == np.float32
+    if len(paths) == 5:                  # the PNG
+        assert ds[4].shape == (3, 5, 6)
+
+
+def test_labeled_image_dataset_and_listfile(image_dir, tmp_path):
+    root, paths = image_dir
+    pairs = [(p, i % 3) for i, p in enumerate(paths[:4])]
+    ds = LabeledImageDataset(pairs, root=root)
+    img, label = ds[1]
+    assert img.shape == (3, 5, 6) and int(label) == 1
+
+    listfile = tmp_path / "list.txt"
+    listfile.write_text("".join(f"{p} {l}\n" for p, l in pairs))
+    ds2 = LabeledImageDataset(str(listfile), root=root)
+    assert len(ds2) == 4
+    img2, label2 = ds2[2]
+    np.testing.assert_array_equal(img2, ds[2][0])
