@@ -152,17 +152,42 @@ class _Inception(Chain):
         return F.concat([a, b, c, d], axis=1)
 
 
+class _AuxHead(Chain):
+    """GoogLeNet auxiliary classifier (avg-pool 5/3 → 1x1 conv → fc)."""
+
+    def __init__(self, in_ch, n_classes, seed=0):
+        super().__init__()
+        with self.init_scope():
+            self.conv = L.Convolution2D(in_ch, 128, 1, seed=seed)
+            self.fc1 = L.Linear(None, 1024, seed=seed + 1)
+            self.fc2 = L.Linear(1024, n_classes, seed=seed + 2)
+
+    def forward(self, x):
+        if x.shape[2] >= 5 and x.shape[3] >= 5:
+            h = F.average_pooling_2d(x, 5, stride=3)
+        else:  # small-input regimes (tests, CIFAR-scale)
+            h = F.global_average_pooling_2d(x)[:, :, None, None]
+        h = F.relu(self.conv(h))
+        h = F.relu(self.fc1(h))
+        return self.fc2(F.dropout(h, 0.7))
+
+
 class GoogLeNet(Chain):
     """GoogLeNet / inception-v1 (reference example ``googlenet.py``),
-    224×224 (main head only; train-time aux heads omitted — modern
-    practice, and BN-free inception is already stable at these depths)."""
+    224×224, with the reference's train-time auxiliary heads at inc4a and
+    inc4d (``forward`` returns the main logits; ``forward_with_aux`` the
+    triple; ``loss`` combines them with the 0.3 aux weights)."""
 
     insize = 224
 
-    def __init__(self, n_classes=1000, seed=0):
+    def __init__(self, n_classes=1000, seed=0, aux_heads=True):
         super().__init__()
+        self.aux_heads = aux_heads
         s = lambda k: seed + 1000 * k
         with self.init_scope():
+            if aux_heads:
+                self.aux1 = _AuxHead(512, n_classes, seed=s(20))
+                self.aux2 = _AuxHead(528, n_classes, seed=s(21))
             self.conv1 = L.Convolution2D(3, 64, 7, stride=2, pad=3,
                                          seed=s(1))
             self.conv2r = L.Convolution2D(64, 64, 1, seed=s(2))
@@ -178,16 +203,38 @@ class GoogLeNet(Chain):
             self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128, s(12))
             self.fc = L.Linear(1024, n_classes, seed=s(13))
 
-    def forward(self, x):
+    def _features(self, x):
         h = F.max_pooling_2d(F.relu(self.conv1(x)), 3, stride=2, pad=1,
                              cover_all=False)
         h = F.relu(self.conv2(F.relu(self.conv2r(h))))
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
         h = self.inc3b(self.inc3a(h))
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
-        h = self.inc4e(self.inc4d(self.inc4c(self.inc4b(self.inc4a(h)))))
+        h4a = self.inc4a(h)
+        h4d = self.inc4d(self.inc4c(self.inc4b(h4a)))
+        h = self.inc4e(h4d)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
         h = self.inc5b(self.inc5a(h))
         h = F.global_average_pooling_2d(h)
         h = F.dropout(h, 0.4)
-        return self.fc(h)
+        return self.fc(h), h4a, h4d
+
+    def forward_with_aux(self, x):
+        main, h4a, h4d = self._features(x)
+        if not self.aux_heads:
+            return main, None, None
+        return main, self.aux1(h4a), self.aux2(h4d)
+
+    def forward(self, x):
+        return self._features(x)[0]
+
+    def loss(self, x, t, aux_weight=0.3):
+        """Reference training objective: main + 0.3·(aux1 + aux2)."""
+        from ..core.config import config
+        main, a1, a2 = self.forward_with_aux(x)
+        total = F.softmax_cross_entropy(main, t)
+        if self.aux_heads and config.train:
+            total = total + aux_weight * (
+                F.softmax_cross_entropy(a1, t)
+                + F.softmax_cross_entropy(a2, t))
+        return total

@@ -170,3 +170,23 @@ def test_classic_convnets_forward_and_train():
         t = jnp.asarray(rng.randint(0, 5, 2).astype(np.int32))
         loss = opt.update(m, x, t)
         assert np.isfinite(float(loss)), cls.__name__
+
+
+def test_googlenet_aux_heads():
+    from chainermn_tpu.models import GoogLeNet
+    from chainermn_tpu.core.optimizer import SGD
+    m = GoogLeNet(n_classes=7, seed=0)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 3, 64, 64)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 7, 2).astype(np.int32))
+    main, a1, a2 = m.forward_with_aux(x)
+    assert main.shape == a1.shape == a2.shape == (2, 7)
+    opt = SGD(lr=0.01).setup(m)
+    loss = opt.update(m.loss, x, t)
+    assert np.isfinite(float(loss))
+    # eval mode: loss excludes aux terms
+    with ct.using_config("train", False):
+        eval_loss = m.loss(x, t)
+        main_only = F.softmax_cross_entropy(m(x), t)
+    np.testing.assert_allclose(float(eval_loss), float(main_only),
+                               rtol=1e-5)
