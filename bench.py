@@ -1,7 +1,13 @@
 """Benchmark harness: ResNet-50/ImageNet training throughput per chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+   "mfu": ..., "compile_s": ..., "platform": ..., ...}
+
+Never dies with a bare traceback: on backend failure it retries on CPU
+(explicitly marked ``platform: "cpu_fallback"``) and, failing even that,
+emits a JSON line with an ``error`` field so the driver always records a
+machine-readable result (VERDICT r1 Weak #1).
 
 Baseline derivation (BASELINE.md: reference published numbers): the
 ChainerMN scaling study (arXiv:1710.11351) trains ResNet-50/ImageNet 100
@@ -9,21 +15,54 @@ epochs in ~4.4 h on 128 P100s → 1.28M images × 100 / (4.4·3600 s) / 128
 ≈ 225 images/sec/GPU.  ``vs_baseline`` is measured throughput per chip
 against that per-device figure.
 
+MFU: analytic ResNet-50 flops model.  Forward ≈ 4.1 GFLOP/image at 224²
+(standard count, multiply-add = 2 flops); training step ≈ 3× forward
+(bwd ≈ 2× fwd).  MFU = achieved flops/sec ÷ peak bf16 flops of the chip
+(TPU v5 lite: 197 TFLOP/s bf16; override with BENCH_PEAK_TFLOPS).
+
 The training step is the framework's real data-parallel path:
 ``create_multi_node_optimizer`` over a ``jax_ici`` communicator spanning
 all available chips (one on this box), bf16 conv compute, bf16 gradient
 compression — the TPU translation of the reference's flagship
-``pure_nccl`` fp16 configuration.
+``pure_nccl`` fp16 configuration (SURVEY §2.1 pure_nccl).
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
+BASELINE_IMG_PER_SEC = 225.0  # ChainerMN-era images/sec/P100 (docstring)
 
-def main():
+# Peak bf16 flops by TPU generation (per chip).  v5 lite = v5e.
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+    "v4": 275.0, "v6e": 918.0, "cpu": None,
+}
+
+
+def _resnet50_train_flops_per_image(image_size):
+    """Analytic flops model: fwd ~4.1 GFLOP at 224² (scales with area),
+    train = fwd + bwd ≈ 3× fwd."""
+    fwd = 4.1e9 * (image_size / 224.0) ** 2
+    return 3.0 * fwd
+
+
+def _peak_tflops(devices):
+    override = os.environ.get("BENCH_PEAK_TFLOPS")
+    if override:
+        return float(override)
+    kind = getattr(devices[0], "device_kind", "") or ""
+    kl = kind.lower()
+    for name, peak in _PEAK_TFLOPS.items():
+        if name in kl and peak:
+            return peak
+    return None
+
+
+def _run_bench():
     import jax
     try:  # persistent compile cache: repeat runs skip the ~30s XLA compile
         jax.config.update("jax_compilation_cache_dir",
@@ -41,9 +80,12 @@ def main():
     per_chip_bs = int(os.environ.get("BENCH_BS", "64"))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     image_size = int(os.environ.get("BENCH_SIZE", "224"))
-    n_steps = int(os.environ.get("BENCH_STEPS", "10"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "40"))
 
-    n_devices = len(jax.devices())
+    devices = jax.devices()  # raises if the backend is unavailable
+    n_devices = len(devices)
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", platform)
 
     def run(per_chip_bs):
         global_bs = per_chip_bs * n_devices
@@ -60,37 +102,107 @@ def main():
             0, 1, (global_bs, 3, image_size, image_size)).astype(np.float32))
         t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
 
-        for _ in range(3):  # warmup: compile + 2 steady steps
-            loss = opt.update(model, x, t)
-        jax.block_until_ready(loss)
+        # NOTE: timing uses a real device->host value fetch, not
+        # jax.block_until_ready — through the remote-tunnel backend on this
+        # box, block_until_ready returns before execution completes, which
+        # inflated round-1-style numbers past physical peak flops.  A value
+        # fetch cannot be faked.
+        t0 = time.perf_counter()
+        loss = opt.update(model, x, t)  # first call: trace + XLA compile
+        float(loss)
+        compile_s = time.perf_counter() - t0
 
-        start = time.perf_counter()
-        for _ in range(n_steps):
+        for _ in range(2):  # steady-state warmup
             loss = opt.update(model, x, t)
-        jax.block_until_ready(loss)
-        elapsed = time.perf_counter() - start
-        return n_steps * global_bs / elapsed
+        float(loss)
+
+        best = None
+        for _ in range(3):  # best-of-3 trials; one sync per trial
+            start = time.perf_counter()
+            for _ in range(n_steps):
+                loss = opt.update(model, x, t)
+            float(loss)
+            elapsed = time.perf_counter() - start
+            best = elapsed if best is None else min(best, elapsed)
+        return n_steps * global_bs / best, compile_s
 
     images_per_sec = None
     last_err = None
+    used_bs = None
     for bs in (per_chip_bs, per_chip_bs // 2, per_chip_bs // 4):
         if bs < 1:
             break
         try:
-            images_per_sec = run(bs)
+            images_per_sec, compile_s = run(bs)
+            used_bs = bs
             break
         except Exception as e:  # e.g. HBM OOM at the largest batch
             last_err = e
     if images_per_sec is None:
         raise last_err
+
     per_chip = images_per_sec / n_devices
-    baseline = 225.0  # ChainerMN-era images/sec/GPU (see module docstring)
-    print(json.dumps({
+    result = {
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / baseline, 3),
-    }))
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC, 3),
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_devices": n_devices,
+        "per_chip_batch": used_bs,
+        "image_size": image_size,
+        "compile_s": round(compile_s, 1),
+    }
+    peak = _peak_tflops(devices)
+    if peak:
+        flops = _resnet50_train_flops_per_image(image_size)
+        result["mfu"] = round(per_chip * flops / (peak * 1e12), 4)
+        result["peak_tflops_bf16"] = peak
+    return result
+
+
+def main():
+    try:
+        result = _run_bench()
+    except Exception as e:
+        err = f"{type(e).__name__}: {e}"
+        if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
+                and os.environ.get("BENCH_NO_FALLBACK") != "1"):
+            # Backend wedged → rerun ourselves on CPU so the round still
+            # yields a datum, explicitly marked as a fallback.
+            import subprocess
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       BENCH_BS=os.environ.get("BENCH_BS_CPU", "8"),
+                       BENCH_STEPS="3")
+            result = None
+            try:
+                proc = subprocess.run([sys.executable, __file__],
+                                      env=env, capture_output=True,
+                                      text=True, timeout=1200)
+                line = (proc.stdout.strip().splitlines() or [""])[-1]
+                child = json.loads(line)
+                child_err = child.get("error")
+                result = child
+                result["error"] = err
+                if child.get("value") is not None:
+                    result["platform"] = "cpu_fallback"
+                else:  # child failed too — keep its own diagnostic
+                    result["fallback_error"] = child_err
+            except Exception as fb:
+                result = {
+                    "metric": "resnet50_imagenet_train_throughput",
+                    "value": None, "unit": "images/sec/chip",
+                    "vs_baseline": None, "error": err,
+                    "fallback_error": f"{type(fb).__name__}: {fb}"[:500],
+                }
+        else:
+            result = {
+                "metric": "resnet50_imagenet_train_throughput",
+                "value": None, "unit": "images/sec/chip",
+                "vs_baseline": None, "error": err,
+            }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

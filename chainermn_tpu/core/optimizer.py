@@ -158,11 +158,17 @@ def make_loss_and_grad(target, lossfun):
     return loss_and_grad
 
 
-def apply_transform_update(tx, grads, opt_state, params, lr):
+def apply_transform_update(tx, grads, opt_state, params, lr, decoupled_wd=0.0):
     """Shared tail of every compiled step: hook-chained transform, then the
-    -lr scaling (lr is a traced argument — schedule changes don't recompile)."""
+    -lr scaling (lr is a traced argument — schedule changes don't recompile).
+
+    ``decoupled_wd`` is applied OUTSIDE the -lr scaling: the reference's
+    Adam adds ``eta * weight_decay_rate * param`` to the update un-scaled
+    by alpha (reference `chainer/optimizers/adam.py · AdamRule.update_core`),
+    so folding it into the lr-scaled updates would make it ~1/lr weaker."""
     updates, new_opt_state = tx.update(grads, opt_state, params)
-    updates = jax.tree.map(lambda u: -lr * u, updates)
+    updates = jax.tree.map(lambda u, p: -lr * u - decoupled_wd * p,
+                           updates, params)
     return optax.apply_updates(params, updates), new_opt_state
 
 
@@ -250,8 +256,13 @@ class Optimizer:
         return self._tx
 
     def _hyper_values(self):
-        return {name: jnp.asarray(getattr(self, name), jnp.float32)
+        vals = {name: jnp.asarray(getattr(self, name), jnp.float32)
                 for name in self._dynamic_hyper}
+        # decoupled (AdamW-style, un-scaled by lr) weight decay; 0 for
+        # optimizers without the knob
+        vals["decoupled_wd"] = jnp.asarray(
+            getattr(self, "weight_decay_rate", 0.0) or 0.0, jnp.float32)
+        return vals
 
     def _next_rng_key(self):
         """Fresh per-step key (traced arg): stochastic layers get a new
@@ -279,7 +290,8 @@ class Optimizer:
             loss, new_pstate, obs, grads = loss_and_grad(
                 params, pstate, rng_key, args, kwargs)
             new_params, new_opt_state = apply_transform_update(
-                tx, grads, opt_state, params, hyper["lr"])
+                tx, grads, opt_state, params, hyper["lr"],
+                hyper.get("decoupled_wd", 0.0))
             return new_params, new_pstate, new_opt_state, loss, grads, obs
 
         # donate opt_state (optimizer-internal, replaced by the returned
@@ -340,9 +352,9 @@ class Optimizer:
 
             @jax.jit
             def apply(params, grads, opt_state, hyper):
-                updates, new_opt_state = tx.update(grads, opt_state, params)
-                updates = jax.tree.map(lambda u: -hyper["lr"] * u, updates)
-                return optax.apply_updates(params, updates), new_opt_state
+                return apply_transform_update(
+                    tx, grads, opt_state, params, hyper["lr"],
+                    hyper.get("decoupled_wd", 0.0))
 
             self._step_cache["_from_grads"] = apply
         new_params, self._opt_state = apply(params, grads, opt_state,
@@ -393,7 +405,10 @@ class Optimizer:
                 for i, leaf in enumerate(flat):
                     serializer(f"opt_state_{i}", np.asarray(leaf))
         else:
-            n = serializer("opt_state_len", None)
+            try:
+                n = serializer("opt_state_len", None)
+            except KeyError:  # snapshot saved before the first update()
+                n = None
             if n is not None and self.target is not None:
                 params = extract_state(self.target)["params"]
                 self._opt_state = self._transform().init(params)
@@ -473,13 +488,15 @@ class Adam(GradientMethod):
         self.alpha = value
 
     def _base_transform(self):
-        parts = [optax.scale_by_adam(b1=self.beta1, b2=self.beta2,
-                                     eps=self.eps, nesterov=False)
-                 if not self.amsgrad else
-                 optax.scale_by_amsgrad(b1=self.beta1, b2=self.beta2, eps=self.eps)]
-        if self.weight_decay_rate:
-            parts.append(optax.add_decayed_weights(self.weight_decay_rate))
-        return optax.chain(*parts)
+        # weight_decay_rate is NOT part of the transform: it is applied as
+        # decoupled decay in apply_transform_update (outside the -lr
+        # scaling), matching the reference's `eta * weight_decay_rate *
+        # param` term which alpha_t never multiplies.
+        return (optax.scale_by_adam(b1=self.beta1, b2=self.beta2,
+                                    eps=self.eps, nesterov=False)
+                if not self.amsgrad else
+                optax.scale_by_amsgrad(b1=self.beta1, b2=self.beta2,
+                                       eps=self.eps))
 
 
 class AdamW(Adam):

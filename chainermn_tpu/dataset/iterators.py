@@ -74,21 +74,23 @@ class SerialIterator(Iterator):
     def previous_epoch_detail(self):
         return self._previous_epoch_detail
 
-    def __next__(self):
+    def _next_indices(self):
+        """Advance position/epoch bookkeeping and return the batch's dataset
+        indices WITHOUT touching the data (lets a prefetching wrapper keep a
+        cheap consumer-side state shadow for serialization)."""
         n = len(self.dataset)
         if not self._repeat and self.current_position >= n:
             raise StopIteration
         self._previous_epoch_detail = self.epoch_detail
         i = self.current_position
         i_end = i + self.batch_size
-        batch = [self.dataset[int(idx)] for idx in self._order[i:i_end]]
+        indices = [int(idx) for idx in self._order[i:i_end]]
         if i_end >= n:
             if self._repeat:
                 rest = i_end - n
                 self._order = self._new_order()
                 if rest > 0:
-                    batch.extend(self.dataset[int(idx)]
-                                 for idx in self._order[:rest])
+                    indices.extend(int(idx) for idx in self._order[:rest])
                 self.current_position = rest
             else:
                 self.current_position = n
@@ -97,9 +99,21 @@ class SerialIterator(Iterator):
         else:
             self.is_new_epoch = False
             self.current_position = i_end
-        return batch
+        return indices
+
+    def __next__(self):
+        return [self.dataset[i] for i in self._next_indices()]
 
     next = __next__
+
+    def _copy_state_from(self, other):
+        """Clone another SerialIterator's position/order/RNG state."""
+        self.current_position = other.current_position
+        self.epoch = other.epoch
+        self.is_new_epoch = other.is_new_epoch
+        self._previous_epoch_detail = other._previous_epoch_detail
+        self._order = np.array(other._order)
+        self._rng.set_state(other._rng.get_state())
 
     def serialize(self, serializer):
         self.current_position = int(serializer("current_position",
@@ -142,34 +156,50 @@ class MultithreadIterator(Iterator):
         self._n_prefetch = max(1, n_prefetch)
         self._setup()
 
-    def _setup(self):
+    def _setup(self, from_state=None):
         self._base = SerialIterator(self.dataset, self.batch_size,
                                     repeat=self._repeat, shuffle=self._shuffle,
                                     seed=self._seed)
+        # consumer-side state shadow: tracks the position of batches the
+        # *consumer* has seen (the worker's `_base` runs ahead by up to
+        # n_prefetch batches), so `serialize` records a resumable position.
+        self._state = SerialIterator(self.dataset, self.batch_size,
+                                     repeat=self._repeat,
+                                     shuffle=self._shuffle, seed=self._seed)
+        if from_state is not None:
+            self._state._copy_state_from(from_state)
+            self._base._copy_state_from(self._state)
+        else:
+            self._state._copy_state_from(self._base)
         self._queue: queue.Queue = queue.Queue(maxsize=self._n_prefetch)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        # worker state is bound as arguments: a not-yet-stopped old worker
+        # can only ever touch its OWN (discarded) base/queue/stop, never a
+        # rebuilt pipeline's
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._base, self._queue, self._stop),
+            daemon=True)
         self._started = False
+        self.epoch = self._state.epoch
+        self.is_new_epoch = self._state.is_new_epoch
 
     def reset(self):
         """Stop the worker and restart from a fresh epoch (Evaluator reuse)."""
         self.finalize()
         self._setup()
 
-    def _worker(self):
+    @staticmethod
+    def _worker(base, q, stop):
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
-                    batch = self._base.next()
+                    batch = base.next()
                 except StopIteration:
-                    self._queue.put(StopIteration)
+                    q.put(StopIteration)
                     return
-                meta = (self._base.epoch, self._base.is_new_epoch,
-                        self._base.epoch_detail,
-                        self._base.previous_epoch_detail)
-                self._queue.put((batch, meta))
+                q.put(batch)
         except Exception as e:  # surface worker errors to the consumer
-            self._queue.put(e)
+            q.put(e)
 
     def __next__(self):
         if not self._started:
@@ -180,19 +210,38 @@ class MultithreadIterator(Iterator):
             raise StopIteration
         if isinstance(item, Exception):
             raise item
-        batch, (self.epoch, self.is_new_epoch, self._epoch_detail,
-                self._previous_epoch_detail) = item
-        return batch
+        # advance the consumer shadow in lock-step (index bookkeeping only)
+        self._state._next_indices()
+        self.epoch = self._state.epoch
+        self.is_new_epoch = self._state.is_new_epoch
+        return item
 
     next = __next__
 
     @property
     def epoch_detail(self):
-        return getattr(self, "_epoch_detail", 0.0)
+        return self._state.epoch_detail
 
     @property
     def previous_epoch_detail(self):
-        return getattr(self, "_previous_epoch_detail", -1.0)
+        return self._state.previous_epoch_detail
+
+    def serialize(self, serializer):
+        """Snapshot/restore the CONSUMER position (reference contract:
+        resume continues the stream where training saw it, regardless of
+        prefetch depth).  On load, the prefetch pipeline is rebuilt from
+        the restored position."""
+        if serializer.is_writer:
+            self._state.serialize(serializer)
+            return
+        try:
+            self._state.serialize(serializer)
+        except KeyError:
+            # snapshot from before this iterator serialized anything
+            # (the old inherited no-op): keep the fresh stream
+            return
+        self.finalize()
+        self._setup(from_state=self._state)
 
     def finalize(self):
         self._stop.set()
@@ -201,6 +250,8 @@ class MultithreadIterator(Iterator):
                 self._queue.get_nowait()
         except queue.Empty:
             pass
+        if self._started:  # drained queue unblocks a pending put → quick exit
+            self._thread.join(timeout=5.0)
 
 
 # On TPU hosts the thread-prefetch design serves both roles; keep the

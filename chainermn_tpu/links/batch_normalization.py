@@ -45,3 +45,9 @@ class MultiNodeBatchNormalization(BatchNormalization):
             sq_mean = lax.pmean(sq_mean, self.comm.axis_name)
         var = sq_mean - mean * mean
         return mean, var
+
+    def _moment_count(self, x, axis):
+        m = super()._moment_count(x, axis)
+        if isinstance(x, jax.core.Tracer) and self.comm.axis_name is not None:
+            m *= self.comm.size  # moments are pmean'd: global batch count
+        return m

@@ -168,13 +168,15 @@ class DCGANUpdater(StandardUpdater):
             if comm is not None:
                 g_dis = comm.grad_transform()(g_dis)
             new_dis_params, new_opt_dis = apply_transform_update(
-                tx_dis, g_dis, opt_dis_state, dis_params, hyper_dis["lr"])
+                tx_dis, g_dis, opt_dis_state, dis_params, hyper_dis["lr"],
+                hyper_dis.get("decoupled_wd", 0.0))
             (l_gen, new_pg), g_gen = jax.value_and_grad(
                 gen_loss, has_aux=True)(gen_params, new_dis_params)
             if comm is not None:
                 g_gen = comm.grad_transform()(g_gen)
             new_gen_params, new_opt_gen = apply_transform_update(
-                tx_gen, g_gen, opt_gen_state, gen_params, hyper_gen["lr"])
+                tx_gen, g_gen, opt_gen_state, gen_params, hyper_gen["lr"],
+                hyper_gen.get("decoupled_wd", 0.0))
             out = ((new_gen_params, new_pg), (new_dis_params, new_pd),
                    new_opt_gen, new_opt_dis, l_gen, l_dis)
             if comm is not None:

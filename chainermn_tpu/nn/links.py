@@ -211,6 +211,15 @@ class BatchNormalization(Link):
         """Batch moments; overridden by the multi-node subclass to psum."""
         return x.mean(axis=axis), x.var(axis=axis)
 
+    def _moment_count(self, x, axis):
+        """Number of elements each moment reduces over (the multi-node
+        subclass multiplies by communicator size: stats cover the global
+        batch)."""
+        m = 1
+        for a in axis:
+            m *= x.shape[a]
+        return m
+
     def forward(self, x, finetune=False):
         axis = self.axis
         if axis is None:
@@ -224,9 +233,14 @@ class BatchNormalization(Link):
                 decay = 1.0 - 1.0 / self.N
             else:
                 decay = self.decay
-            # functional EMA update — collected via bind_state
+            # functional EMA update — collected via bind_state.  Running
+            # variance accumulates the UNBIASED batch variance (× m/(m-1)),
+            # matching the reference's adjustment in
+            # `chainer/links/normalization/batch_normalization.py`.
+            m = self._moment_count(x, axis)
+            unbiased = var * (m / max(m - 1, 1))
             self.avg_mean = decay * self.avg_mean + (1 - decay) * mean
-            self.avg_var = decay * self.avg_var + (1 - decay) * var
+            self.avg_var = decay * self.avg_var + (1 - decay) * unbiased
             return y
         return F._apply_bn(x, gamma, beta, jnp.asarray(self.avg_mean),
                            jnp.asarray(self.avg_var), self.eps, axis)

@@ -183,7 +183,8 @@ class _MultiNodeOptimizer:
             apply_grads = stale[0] if double_buffering else grads
             with jax.named_scope("mn_optimizer_update"):
                 new_params, new_opt_state = apply_transform_update(
-                    tx, apply_grads, opt_state, params, hyper["lr"])
+                    tx, apply_grads, opt_state, params, hyper["lr"],
+                    hyper.get("decoupled_wd", 0.0))
             # per-rank scalars → global means for reporting / BN state
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)

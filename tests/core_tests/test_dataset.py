@@ -138,3 +138,36 @@ def test_trainer_default_stop_trigger_is_callable():
 
     t = Trainer(_FakeUpdater())
     assert t.stop_trigger(t) is False
+
+
+def test_multithread_iterator_serialize_resume(tmp_path):
+    """Prefetching iterator snapshots the CONSUMER position: resume
+    continues the stream exactly where training saw it (ADVICE r1: the
+    inherited no-op serialize restarted the stream)."""
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    it = MultithreadIterator(np.arange(12), 4, shuffle=True, seed=3)
+    seen = [sorted(it.next()) for _ in range(2)]
+    s = DictionarySerializer()
+    it.serialize(s)
+    np.savez(str(tmp_path / "mt.npz"), **s.target)
+    continuation = [sorted(it.next()) for _ in range(3)]
+    it.finalize()
+
+    it2 = MultithreadIterator(np.arange(12), 4, shuffle=True, seed=99)
+    with np.load(str(tmp_path / "mt.npz")) as npz:
+        it2.serialize(NpzDeserializer(npz))
+    resumed = [sorted(it2.next()) for _ in range(3)]
+    it2.finalize()
+    assert resumed == continuation
+    assert it2.epoch == it.epoch  # epoch bookkeeping restored
+
+
+def test_multithread_iterator_epoch_detail_tracks_consumer():
+    it = MultithreadIterator(np.arange(8), 4, shuffle=False, n_prefetch=4)
+    assert it.epoch_detail == 0.0
+    it.next()
+    assert it.epoch_detail == 0.5  # consumer view, not prefetcher's
+    it.next()
+    assert it.epoch == 1 and it.is_new_epoch
+    it.finalize()

@@ -111,3 +111,15 @@ def test_create_mnbn_model_rewrites_recursively():
     assert not isinstance(net.bn, MultiNodeBatchNormalization)
     # params enumerate under the same paths
     assert [n for n, _ in mn.namedparams()] == [n for n, _ in net.namedparams()]
+
+
+def test_bn_running_var_unbiased():
+    """Running variance accumulates the unbiased batch variance
+    (× m/(m-1)), matching the reference's adjustment (ADVICE r1)."""
+    bn = L.BatchNormalization(2, decay=0.5)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.normal(0, 2, (6, 2)).astype(np.float32))
+    bn(x)
+    m = x.shape[0]
+    expected = 0.5 * 1.0 + 0.5 * np.asarray(x).var(axis=0) * m / (m - 1)
+    np.testing.assert_allclose(np.asarray(bn.avg_var), expected, rtol=1e-5)
