@@ -1,0 +1,233 @@
+"""Cross-process host-side object channel over the coordinator KV store.
+
+Reference: the pickled-object MPI transport in
+``chainermn/communicators/mpi_communicator_base.py · send_obj/recv_obj/
+bcast_obj/allgather_obj`` (SURVEY.md §2.7 "object channel: pickle over
+MPI, chunked at ~256 MiB").  The TPU-native control plane is
+``jax.distributed``'s coordination service; its key-value store plays
+MPI's host-data role (SURVEY §2.5 N4).  Tensors never travel here — the
+data plane is XLA collectives over ICI/DCN.
+
+Design:
+
+* Values are pickled bytes, chunked (default 1 MiB — the coordination
+  service rides gRPC, whose default message cap is 4 MiB; the chunk size
+  is a knob for parity with the reference's ``max_buf_len``).
+* Point-to-point messages are sequenced per ``(src, dst, tag)`` on both
+  ends, so repeated sends match repeated recvs in order, exactly like
+  matched MPI send/recv pairs.
+* Collective-style helpers (``allgather``/``bcast``/``barrier``) are
+  epoch-counted: SPMD lock-step call order is the correctness contract,
+  the same invariant the reference inherits from MPI.
+* Keys are deleted by their *reader(s)* once consumed (last reader for
+  collectives), so the store does not grow with training time.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+
+__all__ = ["HostChannel", "get_host_channel"]
+
+_DEFAULT_CHUNK = 1 << 20  # 1 MiB
+_DEFAULT_TIMEOUT_MS = 600_000
+
+
+def _kv_client():
+    """The process's coordination-service client, or None single-process."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+class HostChannel:
+    """Pickled-object transport between controller processes.
+
+    One instance per (communicator, namespace).  All methods are
+    host-side and blocking; they must be called in SPMD lock-step where
+    documented (allgather/bcast/barrier), mirroring MPI semantics.
+    """
+
+    def __init__(self, namespace="cmn", client=None,
+                 chunk_bytes=_DEFAULT_CHUNK,
+                 timeout_ms=_DEFAULT_TIMEOUT_MS):
+        import jax
+        self._client = client if client is not None else _kv_client()
+        self._ns = namespace
+        self._chunk = int(chunk_bytes)
+        self._timeout_ms = int(timeout_ms)
+        self._send_seq = {}
+        self._recv_seq = {}
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self.process_id = jax.process_index()
+        self.num_processes = jax.process_count()
+
+    @property
+    def available(self):
+        return self._client is not None and self.num_processes > 1
+
+    # -- low-level chunked put/get ------------------------------------------
+    def _put(self, key, payload: bytes):
+        c = self._client
+        n_chunks = max(1, (len(payload) + self._chunk - 1) // self._chunk)
+        for i in range(n_chunks):
+            c.key_value_set_bytes(
+                f"{key}/c{i}", payload[i * self._chunk:(i + 1) * self._chunk])
+        # meta last: its presence means every chunk is readable
+        c.key_value_set(f"{key}/meta", f"{n_chunks}:{len(payload)}")
+
+    def _blocking_get_or_abort(self, key):
+        """Blocking get that polls the job-abort flag: when a peer's
+        except hook posts an abort (fail-stop, SURVEY §5), waiting ranks
+        raise instead of hanging until the full timeout — the KV analog
+        of MPI_Abort killing ranks blocked in a recv."""
+        import time
+        c = self._client
+        deadline = time.monotonic() + self._timeout_ms / 1000.0
+        while True:
+            reason = None
+            try:
+                reason = c.key_value_try_get(f"{self._ns}/abort")
+            except Exception:
+                pass  # no abort posted
+            if reason is not None:
+                raise RuntimeError(
+                    f"distributed job aborted by a peer: {reason}")
+            slice_ms = int(min(2000, max(1, (deadline - time.monotonic())
+                                         * 1000)))
+            try:
+                return c.blocking_key_value_get(key, slice_ms)
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+
+    def post_abort(self, reason="unknown"):
+        """Fail-stop broadcast: unblocks every peer waiting in a channel
+        get (they raise) — called by the global except hook."""
+        try:
+            self._client.key_value_set(f"{self._ns}/abort", str(reason))
+        except Exception:
+            pass
+
+    def _get(self, key, delete=True):
+        c = self._client
+        meta = self._blocking_get_or_abort(f"{key}/meta")
+        n_chunks, total = (int(v) for v in meta.split(":"))
+        parts = [c.blocking_key_value_get_bytes(f"{key}/c{i}",
+                                                self._timeout_ms)
+                 for i in range(n_chunks)]
+        payload = b"".join(parts)[:total]
+        if delete:
+            self.delete(key, n_chunks)
+        return payload
+
+    def delete(self, key, n_chunks=None):
+        c = self._client
+        try:
+            if n_chunks is None:
+                meta = c.key_value_try_get(f"{key}/meta")
+                n_chunks, _ = (int(v) for v in meta.split(":"))
+            for i in range(n_chunks):
+                c.key_value_delete(f"{key}/c{i}")
+            c.key_value_delete(f"{key}/meta")
+        except Exception:
+            pass  # best-effort GC; unread keys die with the coordinator
+
+    # -- point-to-point ------------------------------------------------------
+    def send_obj(self, obj, dest_process, tag=0):
+        """Chunked pickled send to another controller process (reference:
+        ``MpiCommunicatorBase.send_obj``).  Non-blocking wrt the receiver
+        (the store buffers), like MPI's eager protocol for small messages."""
+        if not 0 <= dest_process < self.num_processes:
+            raise ValueError(
+                f"dest={dest_process} is not a controller-process rank "
+                f"(num_processes={self.num_processes}); host-mode object "
+                f"p2p addresses controller processes")
+        with self._lock:
+            seq = self._send_seq.get((dest_process, tag), 0)
+            self._send_seq[(dest_process, tag)] = seq + 1
+        key = (f"{self._ns}/p2p/{self.process_id}-{dest_process}"
+               f"/t{tag}/s{seq}")
+        self._put(key, pickle.dumps(obj))
+
+    def recv_obj(self, source_process, tag=0):
+        """Blocking matched receive (reference: ``recv_obj``): order per
+        (source, tag) is preserved by sequence numbers.  The sequence slot
+        is consumed only on success, so a timed-out/aborted receive can be
+        retried without desynchronizing the stream."""
+        if not 0 <= source_process < self.num_processes:
+            raise ValueError(
+                f"source={source_process} is not a controller-process rank "
+                f"(num_processes={self.num_processes}); host-mode object "
+                f"p2p addresses controller processes")
+        with self._lock:
+            seq = self._recv_seq.get((source_process, tag), 0)
+        key = (f"{self._ns}/p2p/{source_process}-{self.process_id}"
+               f"/t{tag}/s{seq}")
+        obj = pickle.loads(self._get(key))
+        with self._lock:
+            self._recv_seq[(source_process, tag)] = seq + 1
+        return obj
+
+    # -- collectives (SPMD lock-step) ---------------------------------------
+    def _next_epoch(self):
+        with self._lock:
+            self._epoch += 1
+            return self._epoch
+
+    def allgather(self, obj):
+        """All processes contribute one object; everyone gets the list in
+        process order.  Must be entered by every process (lock-step)."""
+        e = self._next_epoch()
+        c = self._client
+        me = self.process_id
+        n = self.num_processes
+        prefix = f"{self._ns}/ag/{e}"
+        self._put(f"{prefix}/{me}", pickle.dumps(obj))
+        out = [pickle.loads(self._get(f"{prefix}/{i}", delete=False))
+               for i in range(n)]
+        # all processes must finish reading before anyone deletes
+        c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
+        self.delete(f"{prefix}/{me}")
+        return out
+
+    def bcast(self, obj, root=0):
+        """Root's object on every process (lock-step entry)."""
+        e = self._next_epoch()
+        prefix = f"{self._ns}/bc/{e}"
+        c = self._client
+        if self.process_id == root:
+            self._put(f"{prefix}/v", pickle.dumps(obj))
+            out = obj
+            c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
+            self.delete(f"{prefix}/v")
+        else:
+            out = pickle.loads(self._get(f"{prefix}/v", delete=False))
+            c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
+        return out
+
+    def barrier(self, name=None):
+        e = self._next_epoch()
+        self._client.wait_at_barrier(name or f"{self._ns}/bar/{e}",
+                                     self._timeout_ms)
+
+
+_channel = None
+_channel_lock = threading.Lock()
+
+
+def get_host_channel():
+    """Process-global channel (lazy; None when single-process or no
+    coordination service)."""
+    global _channel
+    with _channel_lock:
+        if _channel is None:
+            ch = HostChannel()
+            if not ch.available:
+                return None
+            _channel = ch
+        return _channel

@@ -200,32 +200,79 @@ class MeshCommunicator(CommunicatorBase):
         return xs
 
     # -- point-to-point -----------------------------------------------------------
-    def send(self, data, dest, tag=0):
-        """Eager mailbox send (host mode).  Traced point-to-point lives in
-        ``chainermn_tpu.functions`` (ppermute with static src/dst)."""
+    def send(self, data, dest, tag=0, source=None):
+        """Eager host-mode send.  Traced point-to-point lives in
+        ``chainermn_tpu.functions`` (ppermute with static src/dst).
+
+        Same controller: mailbox append.  Other controller process:
+        pickled ndarray over the coordination KV channel.  ``source`` is
+        optional sender attribution for MPI-style matched receives — the
+        single controller acts for many ranks, so identity must be
+        declared, not inferred; undeclared sends match any ``recv``.
+        """
         if _is_traced(data):
             raise RuntimeError(
                 "inside compiled steps use chainermn_tpu.functions.send "
                 "(ppermute); Communicator.send is the host-mode channel")
+        if dest != self.rank:
+            ch = self._host_channel()
+            if ch is not None:
+                # attribution travels with the payload; cross-process
+                # matching is already exact by (process, tag, seq)
+                ch.send_obj((source, np.asarray(data)), dest,
+                            tag=f"nd{tag}")
+                return
         with self._lock:
-            self._mailbox.setdefault((dest, tag), []).append(jnp.asarray(data))
+            self._mailbox.setdefault((dest, tag), []).append(
+                (source, jnp.asarray(data)))
 
     def recv(self, source, tag=0):
-        del source  # single controller: one mailbox, FIFO per tag
+        """Matched receive: only messages sent with this ``source``
+        attribution (or sent without one) are delivered — two pending
+        senders with declared sources can no longer cross wires
+        (MPI source-matching semantics)."""
+        if source != self.rank:
+            ch = self._host_channel()
+            if ch is not None:
+                _attr, data = ch.recv_obj(source, tag=f"nd{tag}")
+                return jnp.asarray(data)
         with self._lock:
             for key in list(self._mailbox):
-                if key[1] == tag and self._mailbox[key]:
-                    return self._mailbox[key].pop(0)
-        raise RuntimeError("recv with empty mailbox (host mode)")
+                if key[1] != tag:
+                    continue
+                box = self._mailbox[key]
+                for i, (src, _) in enumerate(box):
+                    if src is None or source is None or src == source:
+                        return box.pop(i)[1]
+        raise RuntimeError(
+            f"recv with no matching message (host mode, source={source}, "
+            f"tag={tag})")
 
     # -- object channel ---------------------------------------------------------
-    # Single host: loopback (the controller holds the one copy).  Multi-host:
-    # DCN via multihost_utils (reference: pickled MPI transport, SURVEY §2.7).
+    # Same-controller: loopback mailbox (the controller holds the one copy).
+    # Cross-process: chunked pickled transport over the jax.distributed
+    # coordination KV store (reference: pickled MPI channel, SURVEY §2.7;
+    # see ``_host_channel.HostChannel``).  In single-controller SPMD the
+    # host-object unit is the controller process, so ``dest``/``source``
+    # here are controller ranks (== ``inter_rank``/``jax.process_index()``).
+    def _host_channel(self):
+        from ._host_channel import get_host_channel
+        return get_host_channel()
+
     def send_obj(self, obj, dest, tag=0):
+        if dest != self.rank:
+            ch = self._host_channel()
+            if ch is not None:
+                ch.send_obj(obj, dest, tag)
+                return
         with self._lock:
             self._obj_mailbox.setdefault((dest, tag), []).append(obj)
 
     def recv_obj(self, source, tag=0):
+        if source != self.rank:
+            ch = self._host_channel()
+            if ch is not None:
+                return ch.recv_obj(source, tag)
         with self._lock:
             for key in list(self._obj_mailbox):
                 if key[1] == tag and self._obj_mailbox[key]:
@@ -234,9 +281,21 @@ class MeshCommunicator(CommunicatorBase):
 
     def bcast_obj(self, obj, root=0):
         if self.inter_size > 1:
+            ch = self._host_channel()
+            if ch is not None:
+                return ch.bcast(obj, root=self._owning_process(root))
             gathered = self._process_allgather_pickled(obj)
             return gathered[root if root < len(gathered) else 0]
         return obj
+
+    def _owning_process(self, root):
+        """Clamp an object-channel root to a valid controller rank.
+
+        Host-mode object ops consistently address CONTROLLER processes
+        (``inter_rank`` — see ``_MultiNodeIterator._is_master``,
+        ``scatter_dataset``); an out-of-range root falls back to 0, the
+        defensive behavior of the pre-KV-channel path."""
+        return root if 0 <= root < self.inter_size else 0
 
     def gather_obj(self, obj, root=0):
         return self.allgather_obj(obj)
@@ -267,11 +326,15 @@ class MeshCommunicator(CommunicatorBase):
     def _process_allgather_pickled(self, obj):
         """Allgather arbitrary Python objects across processes.
 
-        ``multihost_utils.process_allgather`` stacks array pytrees — wrong
-        shape for opaque objects — so objects go as length-padded pickled
-        byte arrays (the reference's chunked-pickle MPI channel, SURVEY
-        §2.7, re-homed onto the DCN allgather).
+        Primary path: the coordination-service KV channel (host data never
+        enters XLA — the reference's object channel was likewise pure MPI,
+        SURVEY §2.7).  Fallback (no coordination service, e.g. some
+        multi-host TPU runtimes bootstrapped externally): length-padded
+        pickled byte arrays over ``multihost_utils.process_allgather``.
         """
+        ch = self._host_channel()
+        if ch is not None:
+            return ch.allgather(obj)
         import pickle
         from jax.experimental import multihost_utils
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)

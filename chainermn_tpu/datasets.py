@@ -31,6 +31,22 @@ def scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None,
     inside the compiled step (shard_map splits the batch dimension), so
     iterate with ``batchsize = per_rank_bs * comm.size``.
     """
+    if comm.inter_size > 1:
+        # Reference §3.4: the root owns the dataset and ships it to peers
+        # over the chunked pickled object channel (peers pass None).  The
+        # broadcast only happens when some peer actually lacks the data —
+        # hosts that already loaded the dataset locally ship nothing.
+        # The ImageNet pattern — scatter file *paths*, not tensors —
+        # keeps the shipped case cheap for large corpora.
+        if comm.inter_rank == root and dataset is None:
+            raise ValueError("root must pass the dataset to scatter")
+        haves = comm.allgather_obj(dataset is not None)
+        if not all(haves):
+            dataset = comm.bcast_obj(dataset if comm.inter_rank == root
+                                     else None, root=root)
+    if dataset is None:
+        raise ValueError("non-root dataset=None requires a multi-host "
+                         "communicator (inter_size > 1)")
     n = len(dataset)
     if n == 0:
         raise ValueError("cannot scatter an empty dataset")

@@ -48,6 +48,16 @@ def add_hook():
         traceback.print_exception(exc_type, exc_value, exc_traceback)
         sys.stderr.flush()
         try:
+            # unblock peers waiting in host-channel receives (fail-stop:
+            # the KV analog of MPI_Abort) before tearing down our client
+            from .communicators._host_channel import get_host_channel
+            ch = get_host_channel()
+            if ch is not None:
+                ch.post_abort(f"host {host}: "
+                              f"{exc_type.__name__}: {exc_value}")
+        except Exception:
+            pass
+        try:
             import jax
             if jax.process_count() > 1:
                 jax.distributed.shutdown()

@@ -108,16 +108,15 @@ def create_multi_node_iterator(actual_iterator, communicator, rank_master=0):
 def create_synchronized_iterator(actual_iterator, communicator):
     """Agree on RNG state across hosts so local shuffles are identical.
 
-    The master's seed is broadcast and every host's iterator RNG is
-    re-seeded with it (reference: RNG state synchronization), then the
-    order is regenerated.
+    The master's existing RNG *state* is broadcast and installed on every
+    host (reference: RNG state synchronization) — a user's pre-seeded
+    iterator keeps its seed; the master's own stream is untouched.
     """
     rng = getattr(actual_iterator, "_rng", None)
     if rng is not None:
-        seed = int(np.random.RandomState().randint(0, 2**31 - 1)) \
-            if communicator.inter_rank == 0 else None
-        seed = communicator.bcast_obj(seed, root=0)
-        actual_iterator._rng = np.random.RandomState(seed)
+        state = rng.get_state() if communicator.inter_rank == 0 else None
+        state = communicator.bcast_obj(state, root=0)
+        actual_iterator._rng.set_state(state)
         if hasattr(actual_iterator, "reset"):
             actual_iterator.reset()
     return actual_iterator

