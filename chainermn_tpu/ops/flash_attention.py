@@ -25,8 +25,18 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["attention", "flash_attention", "xla_attention"]
+
+# Both grid dims are embarrassingly parallel (independent programs per
+# (batch*head, block) pair).  vmem_limit_bytes raises Mosaic's scoped-VMEM
+# cap from its 16 MB default: at long T, XLA can place whole kernel
+# outputs in VMEM (observed OOM on v5e at T=8192 with the default).
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel"),
+    vmem_limit_bytes=100 * 1024 * 1024,
+)
 
 
 def xla_attention(q, k, v, causal=False, scale=None):
@@ -90,7 +100,10 @@ def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-    lse_ref[:] = (m_safe + jnp.log(jnp.maximum(l, 1e-30))).reshape(bq)
+    # lse is [bq, 1]: Mosaic requires the block's trailing dims to divide
+    # (8, 128) or equal the array dims — a trailing singleton qualifies,
+    # a squeezed 1-D block does not
+    lse_ref[:] = m_safe + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
@@ -102,7 +115,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[:].astype(jnp.float32) * scale
     g = g_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].reshape(bq, 1)
+    lse = lse_ref[:].reshape(bq, 1)   # block arrives [bq, 1]
     delta = delta_ref[:].reshape(bq, 1)
     n_kblocks = tk // block_k
     q_pos = (qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0))
@@ -153,8 +166,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         q_blk = q_ref[pl.ds(qi * block_q, block_q), :] \
             .astype(jnp.float32) * scale
         g_blk = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(qi * block_q, block_q)].reshape(block_q, 1)
-        delta = delta_ref[pl.ds(qi * block_q, block_q)] \
+        lse = lse_ref[pl.ds(qi * block_q, block_q), :] \
+            .reshape(block_q, 1)
+        delta = delta_ref[pl.ds(qi * block_q, block_q), :] \
             .reshape(block_q, 1)
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
@@ -261,6 +275,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qr, kr, vr)
     return out.reshape(B, H, Tq, D)
 
@@ -288,20 +303,29 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qr, kr, vr)
     return out.reshape(B, H, Tq, D), lse.reshape(B, H, Tq)
 
 
 def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
-                        block_q=128, block_k=128, interpret=False):
-    """Backward kernels: (dq, dk, dv) with flash memory behavior."""
+                        block_q=128, block_k=128, interpret=False,
+                        g_lse=None):
+    """Backward kernels: (dq, dk, dv) with flash memory behavior.
+
+    ``g_lse``: optional cotangent of the lse output.  Since
+    ∂lse_i/∂s_ij = p_ij, its whole contribution is ``ds += g_lse_i * p``
+    — algebraically identical to replacing ``delta`` with
+    ``delta - g_lse`` in the existing kernels (``ds = p*(gv - delta)``),
+    so no kernel changes are needed.  Ring attention depends on this: the
+    cross-block merge weights are functions of each block's lse."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
@@ -311,11 +335,13 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
     gr = g.reshape(B * H, Tq, D)
-    lser = lse.reshape(B * H, Tq)
+    lser = lse.reshape(B * H, Tq, 1)  # trailing singleton: Mosaic-legal
     # delta_i = rowsum(g_i * out_i) — one fused elementwise reduce
     delta = jnp.sum(gr.astype(jnp.float32)
                     * out.reshape(B * H, Tq, D).astype(jnp.float32),
-                    axis=-1)
+                    axis=-1, keepdims=True)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(B * H, Tq, 1).astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -326,12 +352,13 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qr, kr, vr, gr, lser, delta)
 
     dk, dv = pl.pallas_call(
@@ -343,8 +370,8 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
-            pl.BlockSpec((None, Tq), lambda b, i: (b, 0)),
+            pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
@@ -355,6 +382,7 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
             jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(qr, kr, vr, gr, lser, delta)
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
@@ -397,3 +425,114 @@ def attention(q, k, v, causal=False, scale=None):
     if jax.default_backend() in ("tpu", "axon"):
         return _flash_diff(q, k, v, causal, scale, False)
     return xla_attention(q, k, v, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# (out, lse) attention — the composable block primitive for ring/Ulysses
+# ---------------------------------------------------------------------------
+
+def _blockwise_attention_lse_jnp(q, k, v, causal, scale, block_k=512):
+    """Blockwise jnp (out, lse): scans KV blocks with the online-softmax
+    recurrence — never materializes a [Tq, Tk] score matrix.  Fallback
+    for non-TPU backends and irregular shapes; differentiable through
+    the scan."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_k = min(block_k, Tk)
+    if Tk % block_k:
+        # pad KV to a block multiple; padded keys are masked out below —
+        # NEVER fall back to one full-width block (that would materialize
+        # the [Tq, Tk] scores this function exists to avoid)
+        pad = block_k - Tk % block_k
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    Tk_pad = k.shape[2]
+    nb = Tk_pad // block_k
+    q32 = q.astype(jnp.float32)
+    ks = jnp.moveaxis(k.reshape(B, H, nb, block_k, D), 2, 0)
+    vs = jnp.moveaxis(v.reshape(B, H, nb, block_k, D), 2, 0)
+    q_pos = lax.broadcasted_iota(jnp.int32, (Tq, 1), 0)
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, bi = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        k_pos = (bi * block_k
+                 + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+        valid = k_pos < Tk  # mask padded keys
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        if causal or Tk != Tk_pad:
+            s = jnp.where(valid[None, None], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0),
+                              (ks, vs, jnp.arange(nb)))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe).astype(q.dtype)
+    m_fin = jnp.where(jnp.isfinite(m), m, 0.0)
+    lse = (m_fin + jnp.log(l_safe))[..., 0]
+    lse = jnp.where(jnp.isfinite(m[..., 0]), lse, -jnp.inf)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_lse_diff(q, k, v, causal, scale, interpret):
+    out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   interpret=interpret)
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, interpret):
+    out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   interpret=interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, interpret, res, cots):
+    q, k, v, out, lse = res
+    g, g_lse = cots
+    return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                               scale=scale, interpret=interpret,
+                               g_lse=g_lse)
+
+
+_flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def attention_with_lse(q, k, v, causal=False, scale=None):
+    """Differentiable blockwise attention returning ``(out, lse)``.
+
+    ``lse`` (log-sum-exp softmax normalizer, [B, H, Tq], fp32) is what
+    lets independently-computed attention blocks be merged exactly —
+    ring attention's cross-chip recurrence (`parallel.ring_attention`)
+    and any flash-style composition build on it.  Dispatch: Pallas
+    kernels on TPU (128-aligned shapes), blockwise jnp otherwise —
+    neither path materializes a [Tq, Tk] score matrix.
+    """
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    Tq, Tk = q.shape[2], k.shape[2]
+    if (jax.default_backend() in ("tpu", "axon")
+            and Tq % min(128, Tq) == 0 and Tk % min(128, Tk) == 0):
+        return _flash_lse_diff(q, k, v, causal, scale, False)
+    return _blockwise_attention_lse_jnp(q, k, v, causal, scale)
+
+
+def blockwise_attention(q, k, v, causal=False, scale=None):
+    """Memory-bounded attention (no [Tq, Tk] materialization on any
+    backend): flash kernel on TPU, blockwise jnp scan elsewhere."""
+    return attention_with_lse(q, k, v, causal=causal, scale=scale)[0]

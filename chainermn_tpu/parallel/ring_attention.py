@@ -7,17 +7,24 @@ attention via ppermute KV rotation built on the L3 primitives.
 Design (blockwise ring attention, Liu et al.-style): the sequence is
 sharded over the communicator axis ([B, H, T/n, D] per rank).  Each rank
 keeps its query block resident and rotates K/V blocks around the ring
-with ``lax.ppermute`` (ICI neighbor exchanges); partial attention is
-accumulated with the numerically-stable online-softmax recurrence
-(running max ``m``, normalizer ``l``, weighted accumulator) so the result
-is exact — identical to full attention on the gathered sequence — while
-no rank ever materializes more than one remote KV block.  Peak memory is
-O(T/n), and XLA overlaps each step's ppermute with the previous block's
-matmuls.
+with ``lax.ppermute`` (ICI neighbor exchanges).  Each arriving block's
+contribution is computed by the fused blockwise attention primitive
+(``ops.flash_attention.attention_with_lse`` — Pallas flash kernel on
+TPU, blockwise jnp elsewhere; neither materializes [Tq, Tk] scores) and
+merged into the running result with the exact log-sum-exp combination
 
-Causal masking is chunk-aware: a KV block strictly in the future is
-skipped-by-masking, the diagonal block gets the triangular mask, past
-blocks attend fully.
+    lse' = logaddexp(lse, lse_blk)
+    out' = out·exp(lse−lse') + out_blk·exp(lse_blk−lse')
+
+so the final output is identical to full attention on the gathered
+sequence while no rank ever holds more than one remote KV block and no
+score matrix ever reaches HBM.  Peak memory is O(T/n · block); XLA
+overlaps each step's ppermute with the previous block's kernels.
+
+Causal masking is chunk-aware and static-shape: a KV block strictly in
+the future contributes nothing (skip branch), the diagonal block runs
+the causal kernel, past blocks run the dense kernel — selected by
+``lax.switch`` on the rotating chunk index.
 """
 
 from __future__ import annotations
@@ -25,27 +32,19 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.flash_attention import attention_with_lse
+
 __all__ = ["ring_self_attention", "ring_attention"]
 
 
-def _block_attention(q, k, v, m, l, acc, mask, scale):
-    """One online-softmax accumulation step for a KV block."""
-    # q: [B, H, Tq, D]; k/v: [B, H, Tk, D]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask, scores, -jnp.inf)
-    m_block = jnp.max(scores, axis=-1, keepdims=True)     # [B,H,Tq,1]
-    m_new = jnp.maximum(m, m_block)
-    # all-masked blocks produce -inf maxima; keep the recurrence finite
-    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(scores - m_safe)
-    p = jnp.where(mask, p, 0.0)
-    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-    acc_new = acc * correction + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
-    return m_new, l_new, acc_new
+def _merge_blocks(out, lse, out_b, lse_b):
+    """Exact merge of two attention partials via their lse weights."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    # fully-masked partials carry lse = -inf: their weight is exactly 0
+    w_a = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
+    w_b = jnp.where(jnp.isfinite(lse_b), jnp.exp(lse_b - lse_new), 0.0)
+    out_new = (out * w_a[..., None] + out_b * w_b[..., None])
+    return out_new, lse_new
 
 
 def ring_self_attention(comm, q, k, v, causal=False, scale=None):
@@ -59,43 +58,56 @@ def ring_self_attention(comm, q, k, v, causal=False, scale=None):
     axis = comm.axis_name
     size = comm.size
     B, H, Tq, D = q.shape
+    if causal and k.shape[2] != Tq:
+        raise ValueError(
+            "causal ring attention requires equal local q/KV lengths "
+            f"(got Tq={Tq}, Tk={k.shape[2]}); unequal lengths are "
+            "supported for causal=False (cross-attention)")
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     my_chunk = lax.axis_index(axis)
     perm = [(i, (i + 1) % size) for i in range(size)]
 
-    q32 = q.astype(jnp.float32)
-    m = jnp.full((B, H, Tq, 1), -jnp.inf, jnp.float32)
-    l = jnp.zeros((B, H, Tq, 1), jnp.float32)
-    acc = jnp.zeros((B, H, Tq, D), jnp.float32)
+    out = jnp.zeros((B, H, Tq, D), jnp.float32)
+    lse = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
 
-    q_pos = my_chunk * Tq + lax.broadcasted_iota(jnp.int32, (Tq, 1), 0)
+    def dense(q, k, v):
+        o, s = attention_with_lse(q, k, v, causal=False, scale=scale)
+        return o.astype(jnp.float32), s
+
+    def diag(q, k, v):
+        o, s = attention_with_lse(q, k, v, causal=True, scale=scale)
+        return o.astype(jnp.float32), s
+
+    def skip(q, k, v):
+        return (jnp.zeros((B, H, Tq, D), jnp.float32),
+                jnp.full((B, H, Tq), -jnp.inf, jnp.float32))
 
     def step(carry, step_idx):
-        k_cur, v_cur, m, l, acc = carry
+        k_cur, v_cur, out, lse = carry
         # KV block currently held arrived from rank (me - step) mod size
         kv_chunk = (my_chunk - step_idx) % size
-        Tk = k_cur.shape[2]
         if causal:
-            kv_pos = kv_chunk * Tk + lax.broadcasted_iota(
-                jnp.int32, (1, Tk), 1)
-            mask = (q_pos >= kv_pos)[None, None]          # [1,1,Tq,Tk]
+            # 0: past block (dense) · 1: diagonal (causal) · 2: future (skip)
+            branch = jnp.where(kv_chunk == my_chunk, 1,
+                               jnp.where(kv_chunk < my_chunk, 0, 2))
+            out_b, lse_b = lax.switch(branch, (dense, diag, skip),
+                                      q, k_cur, v_cur)
         else:
-            mask = jnp.ones((1, 1, Tq, Tk), bool)
-        m, l, acc = _block_attention(q32, k_cur.astype(jnp.float32),
-                                     v_cur, m, l, acc, mask, scale)
+            out_b, lse_b = dense(q, k_cur, v_cur)
+        out, lse = _merge_blocks(out, lse, out_b, lse_b)
         # rotate KV to the next rank (no-op effect on the last step's
         # carry, but keeps the loop uniform; XLA overlaps it with compute)
         k_next = lax.ppermute(k_cur, axis, perm)
         v_next = lax.ppermute(v_cur, axis, perm)
-        return (k_next, v_next, m, l, acc), None
+        return (k_next, v_next, out, lse), None
 
-    (k_f, v_f, m, l, acc), _ = lax.scan(
-        step, (k, v, m, l, acc), jnp.arange(size))
-    out = acc / jnp.maximum(l, 1e-30)
+    (k_f, v_f, out, lse), _ = lax.scan(
+        step, (k, v, out, lse), jnp.arange(size))
     return out.astype(q.dtype)
 
 
 def ring_attention(comm, q, k, v, causal=False, scale=None):
-    """Cross-attention variant: same rotation, ``q`` and KV may have
-    different local lengths."""
+    """Cross-attention variant: same rotation; ``q`` and KV may have
+    different local lengths (causal=False only — see
+    :func:`ring_self_attention`)."""
     return ring_self_attention(comm, q, k, v, causal=causal, scale=scale)

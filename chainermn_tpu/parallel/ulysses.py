@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.flash_attention import blockwise_attention
+
 __all__ = ["ulysses_attention", "seq_to_head_shard", "head_to_seq_shard"]
 
 
@@ -35,31 +37,20 @@ def head_to_seq_shard(comm, x):
                           tiled=True)
 
 
-def _full_attention(q, k, v, causal, scale):
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32),
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        T = scores.shape[-1]
-        qpos = lax.broadcasted_iota(jnp.int32, (T, T), 0)
-        kpos = lax.broadcasted_iota(jnp.int32, (T, T), 1)
-        scores = jnp.where((qpos >= kpos)[None, None], scores, -jnp.inf)
-    p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
-    p = p / jnp.sum(p, axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
-                      preferred_element_type=jnp.float32)
-
-
 def ulysses_attention(comm, q, k, v, causal=False, scale=None):
     """Exact attention with Ulysses sequence parallelism.
 
     Inputs rank-local [B, H, T_local, D] sequence shards; output the same.
-    Identical math to full attention on the gathered sequence.
+    Identical math to full attention on the gathered sequence.  The
+    per-head-group attention over the full sequence runs through the
+    blockwise primitive (Pallas flash kernel on TPU, blockwise jnp scan
+    elsewhere) — the [T, T] score matrix is never materialized, so
+    long-context memory is O(T · block), not O(T²).
     """
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     qh = seq_to_head_shard(comm, q)
     kh = seq_to_head_shard(comm, k)
     vh = seq_to_head_shard(comm, v)
-    out = _full_attention(qh, kh, vh, causal, scale).astype(q.dtype)
+    out = blockwise_attention(qh, kh, vh, causal=causal, scale=scale)
     return head_to_seq_shard(comm, out)

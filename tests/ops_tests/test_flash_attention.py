@@ -3,6 +3,7 @@
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from chainermn_tpu.ops import flash_attention, xla_attention
@@ -87,3 +88,78 @@ def test_flash_vjp_irregular_shape_fallback():
         xla_attention(q, k, v, causal=True) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_attention_with_lse_matches_reference():
+    """(out, lse) primitive: both dispatch paths agree with the XLA
+    reference; lse is the true softmax normalizer."""
+    from chainermn_tpu.ops.flash_attention import (
+        attention_with_lse, _blockwise_attention_lse_jnp, _flash_lse_diff,
+        xla_attention)
+    q, k, v = _data(B=1, H=2, T=128, D=32, seed=11)
+    for causal in (False, True):
+        ref = xla_attention(q, k, v, causal=causal)
+        out_j, lse_j = _blockwise_attention_lse_jnp(q, k, v, causal,
+                                                    1.0 / np.sqrt(32),
+                                                    block_k=32)
+        np.testing.assert_allclose(np.asarray(out_j), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        out_f, lse_f = _flash_lse_diff(q, k, v, causal, 1.0 / np.sqrt(32),
+                                       True)  # interpret mode
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse_f), np.asarray(lse_j),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_lse_cotangent_grads_match_jnp():
+    """The g_lse -> delta - g_lse folding in the backward kernels: grads
+    of a function of BOTH outputs (out, lse) must match the blockwise jnp
+    path (ring attention's merge weights depend on lse)."""
+    from chainermn_tpu.ops.flash_attention import (
+        _blockwise_attention_lse_jnp, _flash_lse_diff)
+    q, k, v = _data(B=1, H=2, T=128, D=32, seed=12)
+    scale = 1.0 / np.sqrt(32)
+
+    def loss_flash(q, k, v):
+        out, lse = _flash_lse_diff(q, k, v, True, scale, True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_jnp(q, k, v):
+        out, lse = _blockwise_attention_lse_jnp(q, k, v, True, scale,
+                                                block_k=32)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gj = jax.grad(loss_jnp, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gj):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_blockwise_jnp_irregular_length_stays_blockwise():
+    """Tk not divisible by the block: padding + masking, not a full-width
+    block (the full-width fallback would materialize [Tq, Tk])."""
+    from chainermn_tpu.ops.flash_attention import (
+        _blockwise_attention_lse_jnp, xla_attention)
+    q, k, v = _data(B=1, H=2, T=64, D=16, seed=13)
+    k, v = k[:, :, :56], v[:, :, :56]  # Tk=56, block 32 -> pad to 64
+    out, _ = _blockwise_attention_lse_jnp(q, k, v, False, 0.25, block_k=32)
+    ref = xla_attention(q, k, v, scale=0.25)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    # and the jaxpr contains no [Tq, Tk_pad]-wide intermediate beyond block
+    jaxpr = jax.make_jaxpr(
+        lambda q, k, v: _blockwise_attention_lse_jnp(q, k, v, False, 0.25,
+                                                     block_k=32))(q, k, v)
+    shapes = []
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shapes.append(getattr(var.aval, "shape", ()))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+    assert not any(len(s) >= 2 and s[-1] > 32 and s[-2] == 64
+                   for s in shapes), shapes
