@@ -56,10 +56,32 @@ def concat_examples(batch, device=None, padding=None):
     else:
         result = _stack(batch, padding)
     if device is not None:
-        result = to_device(result, device)
+        # the stacks above are freshly allocated and owned by the result:
+        # safe for the zero-copy bridge
+        result = _to_device_owned(result, device)
     return result
 
 
 def to_device(x, device=None):
+    """Place a pytree of host arrays on device (COPY semantics, like the
+    reference's ``to_device``: callers may freely mutate the source
+    afterwards).  Freshly-owned internal arrays take the zero-copy DLPack
+    bridge via ``_to_device_owned`` instead."""
     dev = None if device in (None, -1, "@jax") else device
     return jax.tree.map(lambda a: jax.device_put(a, dev), x)
+
+
+def _to_device_owned(x, device=None):
+    """DLPack-bridge placement for arrays whose ownership transfers to
+    the result (nothing else will mutate them) — ``concat_examples``'
+    fresh stacks and the native iterator's held ring views.  On the CPU
+    backend the ``jax.Array`` may alias the buffer (zero-copy)."""
+    from ..utils.dlpack import from_numpy
+    dev = None if device in (None, -1, "@jax") else device
+
+    def place(a):
+        if dev is None and isinstance(a, np.ndarray):
+            return from_numpy(a)
+        return jax.device_put(a, dev)
+
+    return jax.tree.map(place, x)

@@ -20,17 +20,31 @@ __all__ = ["NativeBatchIterator"]
 
 class NativeBatchIterator(Iterator):
     def __init__(self, dataset, batch_size, repeat=True, shuffle=True,
-                 seed=None, n_prefetch=2, n_threads=4):
+                 seed=None, n_prefetch=2, n_threads=4, zero_copy=False):
         arrays = self._extract_arrays(dataset)
         if arrays is None:
             raise TypeError(
                 "NativeBatchIterator needs numpy arrays or a TupleDataset "
                 "of numpy arrays; use SerialIterator for generic datasets")
         from ..utils.native import NativeLoader
+        # zero_copy holds one extra slot out of the ring for the batch
+        # currently in the consumer's hands
         self._loaders = [NativeLoader(a, batch_size,
-                                      n_buffers=n_prefetch + 1,
+                                      n_buffers=n_prefetch
+                                      + (2 if zero_copy else 1),
                                       n_threads=n_threads)
                          for a in arrays]
+        # zero_copy: hand batches out through the DLPack bridge aliasing
+        # the C++ ring slot (utils.dlpack) — no host copy on the CPU
+        # backend, single host->HBM DMA on TPU.  CONTRACT: batch t's ring
+        # slot is recycled at the next() call for batch t+1, so the step
+        # that consumed batch t must have finished reading it by then —
+        # i.e. the loop synchronizes on each step's result (fetching the
+        # loss does it) before drawing the next batch.  With JAX's async
+        # dispatch an unsynchronized loop could still be reading t when
+        # t+1 is drawn; use the default copying mode for such loops.
+        self._zero_copy = zero_copy
+        self._held = []  # (loader, buf_id) of the batch currently out
         self._n = len(arrays[0])
         self.batch_size = batch_size
         self._repeat = repeat
@@ -54,6 +68,20 @@ class NativeBatchIterator(Iterator):
 
     # -- schedule ----------------------------------------------------------
     def reset(self):
+        for loader, buf_id in getattr(self, "_held", []):
+            try:
+                loader.release(buf_id)
+            except Exception:
+                pass
+        self._held = []
+        # drain batches already submitted to the C++ FIFO: otherwise the
+        # post-reset stream would start with the OLD schedule's batches
+        # while reporting the new schedule's positions (and each reset
+        # would leak n_prefetch ring slots)
+        for _ in getattr(self, "_in_flight", []):
+            for loader in self._loaders:
+                _, buf_id = loader.next_view()
+                loader.release(buf_id)
         self.epoch = 0
         self.is_new_epoch = False
         self.current_position = 0
@@ -109,7 +137,18 @@ class NativeBatchIterator(Iterator):
             raise StopIteration
         self._previous_epoch_detail = self.epoch_detail
         epoch, new_epoch, (pos, n) = self._in_flight.pop(0)
-        batches = [loader.next() for loader in self._loaders]
+        if self._zero_copy:
+            for loader, buf_id in self._held:  # previous batch consumed
+                loader.release(buf_id)
+            self._held = []
+            from ..utils.dlpack import from_numpy
+            batches = []
+            for loader in self._loaders:
+                view, buf_id = loader.next_view()
+                self._held.append((loader, buf_id))
+                batches.append(from_numpy(view))
+        else:
+            batches = [loader.next() for loader in self._loaders]
         self._submit_next()
         self.epoch = epoch if new_epoch else self.epoch
         self.is_new_epoch = new_epoch
@@ -128,5 +167,11 @@ class NativeBatchIterator(Iterator):
         return self._previous_epoch_detail
 
     def finalize(self):
+        for loader, buf_id in getattr(self, "_held", []):
+            try:
+                loader.release(buf_id)
+            except Exception:
+                pass
+        self._held = []
         for loader in self._loaders:
             loader.close()

@@ -42,7 +42,11 @@ class DictionarySerializer(Serializer):
         elif np.isscalar(value) or isinstance(value, (bool, int, float)):
             arr = np.asarray(value)
         else:
-            arr = np.asarray(value)
+            # DLPack bridge: committed-to-CPU jax arrays serialize as
+            # aliasing views — device arrays pay exactly one device->host
+            # copy, never a second host-side one (SURVEY §2.8 north star)
+            from ..utils.dlpack import to_numpy
+            arr = to_numpy(value)
         self.target[self.path + key] = arr
         return value
 

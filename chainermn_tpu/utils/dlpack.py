@@ -1,0 +1,58 @@
+"""DLPack host bridge (north star, SURVEY.md §2.8 cupy row).
+
+The reference's ``cupy`` interop moved tensors between host numpy and
+device with explicit copies.  The TPU-native translation is the DLPack
+protocol, with an asymmetric zero-copy story dictated by JAX's
+immutability model:
+
+* **export** (``to_numpy``): a committed-to-CPU ``jax.Array`` exports as
+  a numpy *view* — zero bytes moved, stable pointer.  Serialization,
+  metrics, and checkpoint writes ride this.
+* **import** (``from_numpy``): standard DLPack semantics — the CPU
+  backend MAY alias the source buffer (zero-copy; observed on the
+  simulated-mesh configuration) or copy once; on TPU the host→HBM DMA
+  is the single copy.  Either way there is never a second host-side
+  staging duplicate.  Contract: callers must not mutate the source
+  array after importing (aliasing makes mutation visible to XLA, which
+  assumes immutability).  The native iterator's ring hand-off defers
+  slot release until the batch is consumed for exactly this reason.
+
+Both are total functions: they fall back to plain conversions for
+non-contiguous buffers or exotic platforms, so callers use them
+unconditionally.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["from_numpy", "to_numpy"]
+
+
+def from_numpy(x):
+    """numpy → ``jax.Array``; may alias the source (zero-copy) — do not
+    mutate ``x`` afterwards (see module doc)."""
+    if not isinstance(x, np.ndarray):
+        return jnp.asarray(x)
+    if x.flags.c_contiguous:
+        try:
+            return jnp.from_dlpack(x)
+        except Exception:
+            pass  # backend can't import host DLPack (e.g. TPU-only)
+    return jnp.asarray(x)
+
+
+def to_numpy(x):
+    """``jax.Array`` → numpy; zero-copy for committed-to-CPU arrays,
+    ``device_get`` copy for device arrays."""
+    if isinstance(x, np.ndarray):
+        return x
+    try:
+        if all(d.platform == "cpu" for d in x.devices()):
+            return np.from_dlpack(x)
+    except Exception:
+        pass
+    return np.asarray(jax.device_get(x))

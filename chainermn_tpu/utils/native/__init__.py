@@ -91,8 +91,7 @@ class NativeLoader:
         if rc != 0:
             raise ValueError("invalid indices or batch too large")
 
-    def next(self) -> tuple:
-        """→ (batch_copy, buffer_id is auto-released)."""
+    def _next_raw(self):
         ptr = ctypes.c_void_p()
         rows = ctypes.c_int64()
         buf_id = self._lib.loader_next(self._handle, ctypes.byref(ptr),
@@ -101,10 +100,26 @@ class NativeLoader:
             raise RuntimeError("loader stopped")
         n = rows.value
         raw = (ctypes.c_char * (n * self._row_bytes)).from_address(ptr.value)
-        batch = np.frombuffer(raw, dtype=self.dtype).reshape(
-            (n,) + self.row_shape).copy()
+        view = np.frombuffer(raw, dtype=self.dtype).reshape(
+            (n,) + self.row_shape)
+        return view, buf_id
+
+    def next(self) -> np.ndarray:
+        """Owned batch copy (ring slot released immediately)."""
+        view, buf_id = self._next_raw()
+        batch = view.copy()
         self._lib.loader_release(self._handle, buf_id)
         return batch
+
+    def next_view(self):
+        """Zero-copy ``(view, buf_id)`` of the ring slot — the DLPack
+        hand-off path.  The view aliases loader-owned memory: the caller
+        must ``release(buf_id)`` once the batch has been consumed, and
+        must not touch the view afterwards."""
+        return self._next_raw()
+
+    def release(self, buf_id):
+        self._lib.loader_release(self._handle, buf_id)
 
     def close(self):
         if getattr(self, "_handle", None):

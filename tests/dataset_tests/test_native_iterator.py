@@ -96,3 +96,24 @@ def test_native_iterator_trains_with_updater(lib):
     trainer.run()
     assert opt.t == 8
     it.finalize()
+
+
+def test_reset_drains_inflight_submissions():
+    """reset() must discard batches already queued in the C++ FIFO —
+    otherwise the post-reset stream serves the old schedule's batches
+    and leaks ring slots on every reset (Evaluator reuse pattern)."""
+    from chainermn_tpu.utils.native import load_library
+    if load_library() is None:
+        import pytest
+        pytest.skip("native loader unavailable")
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    data = np.arange(24, dtype=np.float32).reshape(12, 2)
+    it = NativeBatchIterator(data, 4, shuffle=True, seed=0, n_prefetch=2)
+    it.next()  # consume one batch from the first schedule
+    for _ in range(5):  # Evaluator-style repeated resets must not leak
+        it.reset()
+    first_epoch = [it.next() for _ in range(3)]
+    got = np.concatenate([np.asarray(b) for b in first_epoch])
+    np.testing.assert_array_equal(np.sort(got[:, 0]),
+                                  data[np.argsort(data[:, 0]), 0])
+    it.finalize()
