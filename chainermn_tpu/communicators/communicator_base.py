@@ -137,5 +137,11 @@ class CommunicatorBase:
         raise NotImplementedError
 
     # -- lifecycle ------------------------------------------------------------
+    def _axis_in_scope(self):
+        """True when this communicator's mesh axis is bound by an
+        enclosing shard_map of the current trace (mesh backends override;
+        non-mesh communicators have no axis to bind)."""
+        return False
+
     def finalize(self):
         pass

@@ -489,6 +489,12 @@ class MeshCommunicator(CommunicatorBase):
         """
         from jax import shard_map
         axis = self.axis_name
+        if self._axis_in_scope():
+            # already inside a shard_map binding this axis (e.g. the
+            # plain optimizer's SPMD step wraps the whole train step):
+            # args are rank-local; run the rank-local body directly —
+            # nesting another shard_map over the same axis is an error
+            return fn(*args)
         if in_specs is None:
             in_specs = tuple(P(axis) for _ in args)
         if out_specs is None:
@@ -496,11 +502,22 @@ class MeshCommunicator(CommunicatorBase):
         mapped = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False)
         if _is_traced(args):
-            # already inside an outer jit/grad trace — inline the
-            # shard_mapped computation (nested jit would re-enter mesh
-            # context handling and is unnecessary under a trace)
+            # inside an outer jit/grad trace — inline the shard_mapped
+            # computation.  NOTE: the outer jit must be mesh-aware for
+            # this to lower (a single-device jit cannot host an N-device
+            # shard_map); Optimizer._make_step handles that by making
+            # the whole step a shard_map when the target is SPMD.
             return mapped(*args)
         return jax.jit(mapped)(*args)
+
+    def _axis_in_scope(self):
+        """True when this communicator's mesh axis is bound by an
+        enclosing shard_map of the current trace."""
+        try:
+            lax.axis_index(self.axis_name)  # traced probe, discarded
+            return True
+        except Exception:
+            return False
 
     # -- split ------------------------------------------------------------------------
     def split(self, color, key):

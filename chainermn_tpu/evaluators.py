@@ -28,13 +28,26 @@ def create_multi_node_evaluator(actual_evaluator, communicator):
     def evaluate():
         local = actual_evaluator._mn_original_evaluate()
         comm = actual_evaluator._mn_communicator
-        gathered = comm.allgather_obj({k: float(np.asarray(v))
-                                       for k, v in local.items()})
+        # sample-weighted reduction: evaluators exposing per-key SAMPLE
+        # counts (this framework's Evaluator sets ``_mn_counts`` to the
+        # number of examples each key's metrics covered) contribute
+        # proportionally, so ragged shards don't skew the mean; foreign
+        # evaluators without counts fall back to the reference's
+        # unweighted average (weight 1 per host)
+        counts = getattr(actual_evaluator, "_mn_counts", {})
+        gathered = comm.allgather_obj(
+            {k: (float(np.asarray(v)), float(counts.get(k, 1.0)))
+             for k, v in local.items()})
         keys = set()
         for d in gathered:
             keys.update(d)
-        return {k: float(np.mean([d[k] for d in gathered if k in d]))
-                for k in keys}
+        out = {}
+        for k in keys:
+            pairs = [d[k] for d in gathered if k in d]
+            total = sum(n for _, n in pairs)
+            out[k] = (sum(v * n for v, n in pairs) / total if total
+                      else float(np.mean([v for v, _ in pairs])))
+        return out
 
     actual_evaluator.evaluate = evaluate
     return actual_evaluator

@@ -256,6 +256,14 @@ class Evaluator(Extension):
         else:
             it = copy.copy(iterator)
         summary = reporter_module.DictSummary()
+        sample_counts = {}
+
+        def record(obs_dict, batch):
+            summary.add(obs_dict)
+            n = len(batch) if hasattr(batch, "__len__") else 1
+            for k in obs_dict:
+                sample_counts[k] = sample_counts.get(k, 0) + n
+
         from ..core.link import Link, extract_state
         compiled = isinstance(eval_func, Link) and \
             not getattr(self, "_eval_compile_failed", False)
@@ -267,8 +275,8 @@ class Evaluator(Extension):
                     else (in_arrays,)
                 if compiled and not isinstance(in_arrays, dict):
                     try:
-                        summary.add(self._compiled_eval(eval_func,
-                                                        eval_state, args))
+                        record(self._compiled_eval(eval_func, eval_state,
+                                                   args), batch)
                         continue
                     except Exception:
                         # forwards that aren't jit-traceable (value-
@@ -282,7 +290,11 @@ class Evaluator(Extension):
                         eval_func(**in_arrays)
                     else:
                         eval_func(*args)
-                summary.add(observation)
+                record(observation, batch)
+        # per-key SAMPLE counts (batch sizes, not batch counts): the
+        # multi-node wrapper weights the cross-host average by these, so
+        # ragged final batches contribute proportionally to their size
+        self._mn_counts = sample_counts
         return summary.compute_mean()
 
     def _compiled_eval(self, target, state, args):

@@ -47,7 +47,18 @@ def main():
     parser.add_argument("--unit", "-u", type=int, default=100)
     parser.add_argument("--out", "-o", default="result")
     parser.add_argument("--resume", "-r", default="")
+    parser.add_argument("--platform", default=None,
+                        help="force JAX platform (e.g. 'cpu'); env-var "
+                             "pinning is unreliable on hosted TPU images")
+    parser.add_argument("--simulate-devices", type=int, default=0)
     args = parser.parse_args()
+
+    if args.simulate_devices:
+        from chainermn_tpu.utils import simulate_devices
+        simulate_devices(args.simulate_devices)
+    if args.platform:
+        from chainermn_tpu.utils import use_platform
+        use_platform(args.platform)
 
     model = Classifier(MLP(args.unit, 10))
     optimizer = Adam().setup(model)

@@ -284,3 +284,86 @@ def test_eager_recv_source_matching():
     np.testing.assert_allclose(np.asarray(c.recv(source=2, tag=4)), [7.0])
     with pytest.raises(RuntimeError, match="no matching message"):
         c.recv(source=0, tag=99)
+
+
+def test_split_subcomm_collectives_are_independent():
+    """split()-derived sub-communicators run collectives confined to
+    their group (VERDICT r1 item 10): group means must not mix."""
+    world = create_communicator("jax_ici")
+    if world.size < 4:
+        pytest.skip("needs >= 4 devices")
+    half = world.size // 2
+    colors = [0] * half + [1] * half
+    subs = world.split_all(colors, list(range(world.size)))
+    assert len(subs) == 2 and all(c.size == half for c in subs)
+    for g, sub in enumerate(subs):
+        # stacked eager allreduce within the group only
+        vals = jnp.asarray(np.stack(
+            [np.full((2,), 10.0 * g + i, np.float32) for i in range(half)]))
+        out = sub.allreduce(vals, op="mean")
+        expect = 10.0 * g + (half - 1) / 2.0
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_split_subcomm_spmd_inside_own_mesh():
+    """A split() sub-communicator's run_spmd launches over its OWN
+    sub-mesh: per-group psum totals differ per group."""
+    world = create_communicator("jax_ici")
+    if world.size < 4:
+        pytest.skip("needs >= 4 devices")
+    half = world.size // 2
+    subs = world.split_all([0] * half + [1] * half, 0)
+    totals = []
+    for g, sub in enumerate(subs):
+        x = jnp.arange(half, dtype=jnp.float32) + 100.0 * g
+
+        def body(x):
+            return jax.lax.psum(x, sub.axis_name)
+
+        out = sub.run_spmd(body, x)
+        totals.append(float(np.asarray(out)[0]))
+    base = sum(range(half))
+    np.testing.assert_allclose(totals[0], base)
+    np.testing.assert_allclose(totals[1], base + 100.0 * half)
+
+
+def test_hierarchical_two_level_reduction_matches_global():
+    """Reference 'hierarchical' structure as an explicit two-level
+    reduction over split() groups: intra-group mean → leader-level mean
+    == one global mean (the XLA torus does this internally; the
+    composition over sub-communicators must agree)."""
+    world = create_communicator("jax_ici")
+    if world.size < 4:
+        pytest.skip("needs >= 4 devices")
+    half = world.size // 2
+    subs = world.split_all([0] * half + [1] * half, 0)
+    rng = np.random.RandomState(3)
+    per_rank = rng.normal(0, 1, (world.size, 5)).astype(np.float32)
+    # level 1: mean within each group (stacked eager form)
+    g0 = subs[0].allreduce(jnp.asarray(per_rank[:half]), op="mean")
+    g1 = subs[1].allreduce(jnp.asarray(per_rank[half:]), op="mean")
+    # level 2: mean across the two group leaders
+    leaders = create_communicator("jax_ici").split_all(
+        [0 if i in (0, half) else 1 for i in range(world.size)], 0)[0]
+    assert leaders.size == 2
+    two_level = leaders.allreduce(jnp.stack([g0, g1]), op="mean")
+    np.testing.assert_allclose(np.asarray(two_level),
+                               per_rank.mean(axis=0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_from_mesh_axis_split_interaction():
+    """split() of a from_mesh_axis communicator: sub-groups of one axis
+    of an enclosing 2-D mesh keep correct device subsets."""
+    import jax as _jax
+    from jax.sharding import Mesh
+    devs = np.asarray(_jax.devices())
+    if devs.size < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(devs.reshape(2, 4), ("dp", "mp"))
+    mp_comm = MeshCommunicator.from_mesh_axis(mesh, "mp")
+    assert mp_comm.size == 4
+    subs = mp_comm.split_all([0, 0, 1, 1], 0)
+    assert [c.size for c in subs] == [2, 2]
+    got = {d.id for c in subs for d in c._devices}
+    assert got == {d.id for d in mp_comm._devices}

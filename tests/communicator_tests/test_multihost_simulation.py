@@ -126,8 +126,9 @@ def test_evaluator_averages_across_hosts():
 
     ev_a, ev_b = _Ev(1.0), _Ev(3.0)
     wrapped_a = ct.create_multi_node_evaluator(ev_a, a)
-    # host 1 contributes its metrics to the box first (lock-step)
-    a._peer_box[1] = {"validation/main/loss": 3.0}
+    # host 1 contributes its (value, count) metrics to the box first
+    # (lock-step); no counts exposed -> weight 1 per host
+    a._peer_box[1] = {"validation/main/loss": (3.0, 1.0)}
     result = wrapped_a.evaluate()
     assert result["validation/main/loss"] == pytest.approx(2.0)
 
@@ -143,3 +144,27 @@ def test_multi_node_iterator_replica_follows_master():
     batch_r = replica.next()      # replica receives the same batch
     np.testing.assert_array_equal(batch_m, batch_r)
     assert replica.epoch_detail == master.epoch_detail
+
+def test_evaluator_weighted_by_sample_counts():
+    """Cross-host metric reduction weights by per-key observation counts
+    (VERDICT r1 Weak #6: ragged shards skewed the unweighted mean)."""
+    import chainermn_tpu as ct
+
+    a, b = _host_pair()
+
+    class _Eval:
+        def __init__(self, loss, n):
+            self._loss, self._n = loss, n
+
+        def evaluate(self):
+            self._mn_counts = {"main/loss": self._n}
+            return {"main/loss": self._loss}
+
+    # host 0 evaluated 3 batches at loss 1.0; host 1 only 1 batch at 5.0
+    ev_a = ct.create_multi_node_evaluator(_Eval(1.0, 3), a)
+    ev_b = ct.create_multi_node_evaluator(_Eval(5.0, 1), b)
+    a._peer_box.clear()
+    b.allgather_obj({"main/loss": (5.0, 1.0)})  # host 1 contributes first
+    out = ev_a.evaluate()
+    # weighted: (1.0*3 + 5.0*1) / 4 = 2.0 — NOT the unweighted 3.0
+    assert abs(out["main/loss"] - 2.0) < 1e-9, out
