@@ -83,6 +83,36 @@ def measure_allreduce_bandwidth(n_devices, n_floats, iters=20):
     return iters * bytes_moved / dt / 1e9  # GB/s per chip
 
 
+def project_efficiency(step_ms, n_chips, grad_mb=51.1, ici_gbps=100.0,
+                       overlap_fraction=0.8, host_overhead_ms=0.5):
+    """Analytic DP scaling-efficiency projection for an n-chip pod
+    (BENCH_NOTES.md "Scaling-efficiency projection" — the defensible
+    basis for the v4-32 north-star claim while only one chip exists).
+
+    Model: per-step time on n chips =
+        t_compute + host_overhead + exposed_allreduce
+    where exposed_allreduce = (1 - overlap_fraction) × t_ring_allreduce
+    and t_ring_allreduce = 2(n-1)/n × grad_bytes / ici_bandwidth.
+
+    * ``grad_mb`` — ResNet-50 has 25.557M params; bf16-compressed gradient
+      payload = 51.1 MB (the flagship ``allreduce_grad_dtype="bfloat16"``
+      configuration).
+    * ``ici_gbps`` — per-chip algorithmic ring bandwidth along one torus
+      axis.  v4's ICI is ~100 GB/s bidirectional per axis; this is the
+      conservative single-axis figure (XLA can also use multiple axes).
+    * ``overlap_fraction`` — XLA overlaps the gradient all-reduce with the
+      remaining backward pass inside the single compiled step; 0.8 is
+      conservative (the last layer's gradients cannot overlap).
+    * ``host_overhead_ms`` — measured per-step host bookkeeping
+      (BENCH_NOTES round-1: 0.5 ms on ResNet-50's 320 leaves).
+    """
+    t_ar_ms = 2 * (n_chips - 1) / n_chips * grad_mb * 1e6 / (ici_gbps * 1e9) * 1e3
+    exposed = (1.0 - overlap_fraction) * t_ar_ms
+    t_n = step_ms + host_overhead_ms + exposed
+    t_1 = step_ms + host_overhead_ms
+    return t_1 / t_n
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--per-chip-bs", type=int, default=8)
@@ -93,7 +123,21 @@ def main():
     parser.add_argument("--allreduce-floats", type=int, default=1 << 22)
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
+    parser.add_argument("--project", action="store_true",
+                        help="print analytic pod projections from a "
+                             "measured single-chip step time (--step-ms)")
+    parser.add_argument("--step-ms", type=float, default=None,
+                        help="measured single-chip step time for --project")
     args = parser.parse_args()
+
+    if args.project:
+        if args.step_ms is None:
+            parser.error("--project requires --step-ms (from bench.py)")
+        for n in (2, 4, 8, 16, 32, 64):
+            eff = project_efficiency(args.step_ms, n)
+            print(json.dumps({"devices": n, "step_ms_1chip": args.step_ms,
+                              "projected_scaling_efficiency": round(eff, 4)}))
+        return
 
     if args.simulate_devices:
         from chainermn_tpu.utils import simulate_devices

@@ -1,0 +1,170 @@
+"""TPU perf probe: isolate where ResNet-50 MFU goes.
+
+Measures, on the real chip:
+  1. bf16 matmul MFU ceiling (what the chip can actually deliver here)
+  2. ResNet-50 framework train step: python-loop dispatch vs K steps
+     rolled into ONE jit via lax.scan (dispatch/relay overhead isolation)
+  3. raw conv stack NCHW vs NHWC (layout cost isolation)
+
+Prints one JSON line per experiment.  Sync discipline: device->host value
+fetch (see bench.py note — block_until_ready lies through the relay).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/chainermn_tpu_jax_cache")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/chainermn_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+
+def sync(x):
+    jax.tree.leaves(x)[0].block_until_ready()
+    # real sync: fetch one scalar
+    return float(jnp.asarray(jax.tree.leaves(x)[0]).ravel()[0])
+
+
+def timeit(fn, *args, trials=3):
+    fn(*args)  # compile
+    sync(fn(*args))
+    best = None
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def probe_matmul():
+    n = 8192
+    reps = 20
+    a = jnp.ones((n, n), jnp.bfloat16)
+    b = jnp.ones((n, n), jnp.bfloat16)
+
+    @jax.jit
+    def f(a, b):
+        def body(c, _):
+            c = (a @ c).astype(jnp.bfloat16)
+            return c, ()
+        c, _ = lax.scan(body, b, None, length=reps)
+        return c
+
+    dt = timeit(f, a, b)
+    flops = 2 * n**3 * reps
+    tf = flops / dt / 1e12
+    print(json.dumps({"probe": "matmul_bf16_8192", "tflops": round(tf, 1),
+                      "mfu": round(tf / PEAK_TFLOPS, 3)}))
+
+
+def probe_conv(layout):
+    bs, c, hw = 256, 256, 56
+    k = 256
+    reps = 30
+    if layout == "NCHW":
+        x = jnp.ones((bs, c, hw, hw), jnp.bfloat16)
+        w = jnp.ones((k, c, 3, 3), jnp.bfloat16)
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        x = jnp.ones((bs, hw, hw, c), jnp.bfloat16)
+        w = jnp.ones((3, 3, c, k), jnp.bfloat16)
+        dn = ("NHWC", "HWIO", "NHWC")
+
+    @jax.jit
+    def f(x, w):
+        def body(y, _):
+            y = lax.conv_general_dilated(y, w, (1, 1), "SAME",
+                                         dimension_numbers=dn)
+            return y.astype(jnp.bfloat16), ()
+        y, _ = lax.scan(body, x, None, length=reps)
+        return y
+
+    dt = timeit(f, x, w)
+    flops = 2 * bs * hw * hw * k * c * 9 * reps
+    tf = flops / dt / 1e12
+    print(json.dumps({"probe": f"conv3x3_{layout}", "tflops": round(tf, 1),
+                      "mfu": round(tf / PEAK_TFLOPS, 3)}))
+
+
+def probe_resnet(scan_steps):
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.link import extract_state
+    from chainermn_tpu.core.optimizer import (MomentumSGD,
+                                              apply_transform_update,
+                                              make_loss_and_grad)
+    from chainermn_tpu.models import Classifier, ResNet50
+
+    bs = int(os.environ.get("PROBE_BS", "256"))
+    model = Classifier(ResNet50(n_classes=1000, compute_dtype=jnp.bfloat16,
+                                seed=0))
+    opt = MomentumSGD(lr=0.1, momentum=0.9).setup(model)
+    state = extract_state(model)
+    params, pstate = state["params"], state["state"]
+    opt_state = opt._ensure_opt_state(params)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (bs, 3, 224, 224)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 1000, bs).astype(np.int32))
+    tx = opt._transform()
+    loss_and_grad = make_loss_and_grad(model, model)
+    key = jax.random.PRNGKey(0)
+
+    def one_step(carry, _):
+        params, pstate, opt_state = carry
+        loss, new_pstate, obs, grads = loss_and_grad(
+            params, pstate, key, (x, t), {})
+        new_params, new_opt_state = apply_transform_update(
+            tx, grads, opt_state, params, jnp.float32(0.1), 0.0)
+        return (new_params, new_pstate, new_opt_state), loss
+
+    @jax.jit
+    def k_steps(params, pstate, opt_state):
+        (p, s, o), losses = lax.scan(one_step, (params, pstate, opt_state),
+                                     None, length=scan_steps)
+        return losses[-1]
+
+    t0 = time.perf_counter()
+    out = k_steps(params, pstate, opt_state)
+    sync(out)
+    compile_s = time.perf_counter() - t0
+
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = k_steps(params, pstate, opt_state)
+        sync(out)
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    step_t = best / scan_steps
+    ips = bs / step_t
+    mfu = ips * 12.3e9 / (PEAK_TFLOPS * 1e12)
+    print(json.dumps({"probe": f"resnet50_scan{scan_steps}", "bs": bs,
+                      "images_per_sec": round(ips, 1),
+                      "step_ms": round(step_t * 1e3, 1),
+                      "mfu": round(mfu, 3),
+                      "compile_s": round(compile_s, 1)}))
+
+
+if __name__ == "__main__":
+    which = os.environ.get("PROBE", "all")
+    if which in ("all", "matmul"):
+        probe_matmul()
+    if which in ("all", "conv"):
+        probe_conv("NCHW")
+        probe_conv("NHWC")
+    if which in ("all", "resnet"):
+        probe_resnet(int(os.environ.get("PROBE_SCAN", "8")))
