@@ -94,8 +94,9 @@ def _run_bench():
         model = Classifier(ResNet50(n_classes=1000, remat=remat,
                                     compute_dtype=jnp.bfloat16, seed=0))
         comm.bcast_data(model)
-        opt = ct.create_multi_node_optimizer(
-            MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+        inner = MomentumSGD(lr=0.1, momentum=0.9)
+        inner.donate_params = True  # in-place param update (bench owns the model)
+        opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
 
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.normal(

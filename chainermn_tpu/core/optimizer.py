@@ -210,6 +210,14 @@ class Optimizer:
     # names of hyperparameters passed as traced args (mutable between steps)
     _dynamic_hyper = ("lr",)
 
+    #: opt-in: donate parameter buffers to the compiled step (in-place
+    #: update; saves one params-sized HBM allocation — see _make_step).
+    #: Caveat: if a donated step fails at runtime (e.g. HBM OOM), the
+    #: Link's old param buffers are already invalidated — recovery
+    #: requires rebuilding/reloading the model, not retrying update()
+    #: on the same instance.  Leave False for anything interactive.
+    donate_params = False
+
     def __init__(self):
         self.target: Link | None = None
         self.t = 0
@@ -296,15 +304,21 @@ class Optimizer:
 
         # donate opt_state (optimizer-internal, replaced by the returned
         # value) so XLA updates it in place; params/persistent state stay
-        # un-donated — Link arrays are user-visible and may be aliased
-        # (copyparams shares array objects)
-        return jax.jit(step, donate_argnums=(2,))
+        # un-donated by default — Link arrays are user-visible and may be
+        # aliased (copyparams shares array objects).  Setting
+        # ``opt.donate_params = True`` opts in to donating the parameter
+        # buffers as well (in-place update, one less params-sized HBM
+        # allocation — worth it for big models; the old ``p.array`` objects
+        # become invalid, which only matters to code that kept references)
+        donate = (0, 2) if getattr(self, "donate_params", False) else (2,)
+        return jax.jit(step, donate_argnums=donate)
 
     def _cache_key(self, lossfun, args, kwargs):
         shapes = tuple(
             (np.shape(a), str(getattr(a, "dtype", type(a).__name__)))
             for a in jax.tree.leaves((args, kwargs)))
-        return (id(lossfun), shapes, bool(config.train))
+        return (id(lossfun), shapes, bool(config.train),
+                bool(getattr(self, "donate_params", False)))
 
     def update(self, lossfun=None, *args, **kwargs):
         if self.target is None:

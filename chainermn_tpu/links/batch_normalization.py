@@ -15,6 +15,7 @@ so plain moments are global moments and the op degrades to the base BN.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from ..nn.links import BatchNormalization
@@ -37,6 +38,7 @@ class MultiNodeBatchNormalization(BatchNormalization):
         self.communication_backend = communication_backend
 
     def _moments(self, x, axis):
+        x = x.astype(jnp.float32)  # fp32 statistics for bf16 activations
         mean = x.mean(axis=axis)
         sq_mean = (x * x).mean(axis=axis)
         if isinstance(x, jax.core.Tracer) and self.comm.axis_name is not None:

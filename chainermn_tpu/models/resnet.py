@@ -38,10 +38,13 @@ class ConvBN(Chain):
 
     def forward(self, x, activate=True):
         # conv compute in the activation dtype (bf16 on the MXU when the
-        # model casts), BN statistics in fp32, result back in x.dtype
+        # model casts); BN keeps the activation dtype end-to-end while its
+        # statistics accumulate in fp32 internally (links.py _moments /
+        # functions.py _apply_bn) — the elementwise chain conv→BN→relu
+        # never round-trips the full tensor through fp32
         W = self.conv.W.array.astype(x.dtype)
         h = F.convolution_2d(x, W, None, self.stride, self.pad)
-        h = self.bn(h.astype(jnp.float32))
+        h = self.bn(h)
         if activate:
             h = F.relu(h)
         return h.astype(x.dtype)

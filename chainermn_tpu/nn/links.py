@@ -208,7 +208,10 @@ class BatchNormalization(Link):
         return gamma, beta
 
     def _moments(self, x, axis):
-        """Batch moments; overridden by the multi-node subclass to psum."""
+        """Batch moments, accumulated in fp32 regardless of activation
+        dtype (bf16 inputs keep fp32 running statistics); overridden by
+        the multi-node subclass to psum."""
+        x = x.astype(jnp.float32)
         return x.mean(axis=axis), x.var(axis=axis)
 
     def _moment_count(self, x, axis):

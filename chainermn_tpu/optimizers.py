@@ -201,9 +201,11 @@ class _MultiNodeOptimizer:
                       kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        # donate opt_state only (see core/optimizer.py note: Link arrays
-        # may be user-aliased)
-        return jax.jit(mapped, donate_argnums=(2,))
+        # donate opt_state; params too when the wrapped optimizer opts in
+        # via ``donate_params`` (see core/optimizer.py note: Link arrays
+        # may be user-aliased, so this is off by default)
+        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        return jax.jit(mapped, donate_argnums=donate)
 
     # -- misc reference API -----------------------------------------------------
     def new_epoch(self):

@@ -214,3 +214,41 @@ def test_optimizer_serialize_before_first_update(tmp_path):
     opt2 = MomentumSGD(lr=0.1).setup(m2)
     load_npz(path, opt2)  # must not raise KeyError
     assert opt2.t == 0
+
+
+def test_donate_params_same_results():
+    """donate_params=True must not change the math (in-place is an XLA
+    aliasing hint; CPU ignores it, TPU updates params in place)."""
+    m1, m2 = _Quad(), _Quad()
+    o1 = SGD(lr=0.1).setup(m1)
+    o2 = SGD(lr=0.1).setup(m2)
+    o2.donate_params = True
+    for _ in range(3):
+        o1.update(m1)
+        o2.update(m2)
+    np.testing.assert_allclose(np.asarray(m1.w.array),
+                               np.asarray(m2.w.array), rtol=1e-7)
+
+
+def test_donate_params_multi_node_same_results():
+    comm = ct.create_communicator("jax_ici")
+    m1, m2 = _Quad(), _Quad()
+    o1 = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(m1)
+    inner = SGD(lr=0.1)
+    inner.donate_params = True
+    o2 = ct.create_multi_node_optimizer(inner, comm).setup(m2)
+
+    import jax.numpy as jnp
+
+    def lossfun1(x):
+        return 0.5 * jnp.sum((m1.w.array - 3.0) ** 2) + 0.0 * jnp.sum(x)
+
+    def lossfun2(x):
+        return 0.5 * jnp.sum((m2.w.array - 3.0) ** 2) + 0.0 * jnp.sum(x)
+
+    x = jnp.zeros((comm.size * 2, 1))
+    for _ in range(3):
+        o1.update(lossfun1, x)
+        o2.update(lossfun2, x)
+    np.testing.assert_allclose(np.asarray(m1.w.array),
+                               np.asarray(m2.w.array), rtol=1e-7)
