@@ -81,6 +81,10 @@ def _run_bench():
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     image_size = int(os.environ.get("BENCH_SIZE", "224"))
     n_steps = int(os.environ.get("BENCH_STEPS", "40"))
+    # BENCH_SCAN=K fuses K steps per dispatch via update_scan (one jit
+    # containing a lax.scan) — isolates device throughput from host/relay
+    # dispatch latency; 0 = plain per-step update() dispatch
+    scan_k = int(os.environ.get("BENCH_SCAN", "0"))
 
     devices = jax.devices()  # raises if the backend is unavailable
     n_devices = len(devices)
@@ -108,24 +112,33 @@ def _run_bench():
         # box, block_until_ready returns before execution completes, which
         # inflated round-1-style numbers past physical peak flops.  A value
         # fetch cannot be faked.
+        if scan_k:
+            xs = jnp.broadcast_to(x, (scan_k,) + x.shape)
+            ts = jnp.broadcast_to(t, (scan_k,) + t.shape)
+            do_steps = lambda: opt.update_scan(model, xs, ts)[-1]
+            steps_per_call, calls = scan_k, max(1, n_steps // scan_k)
+        else:
+            do_steps = lambda: opt.update(model, x, t)
+            steps_per_call, calls = 1, n_steps
+
         t0 = time.perf_counter()
-        loss = opt.update(model, x, t)  # first call: trace + XLA compile
+        loss = do_steps()  # first call: trace + XLA compile
         float(loss)
         compile_s = time.perf_counter() - t0
 
         for _ in range(2):  # steady-state warmup
-            loss = opt.update(model, x, t)
+            loss = do_steps()
         float(loss)
 
         best = None
         for _ in range(3):  # best-of-3 trials; one sync per trial
             start = time.perf_counter()
-            for _ in range(n_steps):
-                loss = opt.update(model, x, t)
+            for _ in range(calls):
+                loss = do_steps()
             float(loss)
             elapsed = time.perf_counter() - start
             best = elapsed if best is None else min(best, elapsed)
-        return n_steps * global_bs / best, compile_s
+        return calls * steps_per_call * global_bs / best, compile_s
 
     images_per_sec = None
     last_err = None
@@ -154,6 +167,7 @@ def _run_bench():
         "per_chip_batch": used_bs,
         "image_size": image_size,
         "compile_s": round(compile_s, 1),
+        "fused_steps_per_dispatch": scan_k or 1,
     }
     peak = _peak_tflops(devices)
     if peak:

@@ -207,6 +207,124 @@ class _MultiNodeOptimizer:
         donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
+    # -- multi-step fused dispatch ----------------------------------------------
+    def update_scan(self, lossfun, *args, **kwargs):
+        """Run K training steps in ONE compiled dispatch.
+
+        Every array leaf in ``args``/``kwargs`` carries a leading *step*
+        axis of length K stacked on top of the usual global-batch axis:
+        shape ``(K, global_bs, ...)``.  The compiled program lax.scans
+        over the step axis inside the shard_mapped body — K full
+        forward/backward/allreduce/update iterations per host dispatch,
+        so per-step host and dispatch latency is amortized K-fold (the
+        TPU-idiomatic equivalent of the reference's tight C-level update
+        loop; measured in BENCH_NOTES "fused multi-step").
+
+        Returns the per-step loss array of shape ``(K,)``.  Hyperparams
+        (lr, ...) are read once per dispatch — a schedule that must
+        change *within* the K steps needs plain ``update`` calls.
+        Double buffering is not supported here (one-step staleness
+        inside a fused scan would reorder its observable semantics).
+        """
+        if self._double_buffering:
+            raise RuntimeError("update_scan does not support double "
+                               "buffering; use update()")
+        actual = self.actual_optimizer
+        if actual.target is None:
+            raise RuntimeError("setup(link) was not called")
+        if self.communicator.axis_name is None:
+            raise RuntimeError("update_scan requires a mesh communicator")
+        leaves = jax.tree.leaves((args, kwargs))
+        if not leaves or any(not hasattr(l, "shape") or l.ndim < 2
+                             for l in leaves):
+            raise ValueError("update_scan arguments must be arrays with a "
+                             "leading (n_steps, global_batch, ...) axis")
+        n_steps = leaves[0].shape[0]
+        if any(l.shape[0] != n_steps for l in leaves):
+            raise ValueError("all update_scan leaves must share the same "
+                             "leading step-axis length")
+
+        if any(p.array is None for p in actual.target.params()):
+            with bind_state(actual.target, extract_state(actual.target)):
+                first = jax.tree.map(lambda a: a[0], (args, kwargs))
+                lossfun(*first[0], **first[1])
+        if hasattr(self.communicator, "verify_step_signature"):
+            # debug communicator: agree on shapes/dtypes across hosts
+            # before launching (fail fast instead of collective deadlock)
+            self.communicator.verify_step_signature((args, kwargs))
+        state = extract_state(actual.target)
+        params, pstate = state["params"], state["state"]
+        opt_state = actual._ensure_opt_state(params)
+        key = ("scan", n_steps) + actual._cache_key(lossfun, args, kwargs)
+        step = self._mn_step_cache.get(key)
+        if step is None:
+            step = self._make_scan_step(lossfun, args, kwargs, n_steps)
+            self._mn_step_cache[key] = step
+        new_params, new_pstate, new_opt_state, losses, grads, obs = step(
+            params, pstate, opt_state, actual._hyper_values(),
+            actual._next_rng_key(), args, kwargs)
+        actual._write_back(new_params, new_pstate, grads)
+        actual._opt_state = new_opt_state
+        actual.t += n_steps
+        reporter_module.report(obs)
+        return losses
+
+    def _make_scan_step(self, lossfun, ex_args, ex_kwargs, n_steps):
+        from jax import shard_map
+        from .core.optimizer import (apply_transform_update,
+                                     make_loss_and_grad)
+        comm = self.communicator
+        actual = self.actual_optimizer
+        tx = actual._transform()
+        grad_transform = comm.grad_transform()
+        axis = comm.axis_name
+        size = comm.size
+        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
+
+        def rank_scan(params, pstate, opt_state, hyper, rng_key, args,
+                      kwargs):
+            rng_rank = jax.random.fold_in(rng_key, lax.axis_index(axis))
+
+            def one_step(carry, xs):
+                params, pstate, opt_state, i = carry
+                s_args, s_kwargs = xs
+                rng_i = jax.random.fold_in(rng_rank, i)
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_i, s_args, s_kwargs)
+                grads = grad_transform(grads)
+                new_params, new_opt_state = apply_transform_update(
+                    tx, grads, opt_state, params, hyper["lr"],
+                    hyper.get("decoupled_wd", 0.0))
+                return ((new_params, new_pstate, new_opt_state, i + 1),
+                        (loss, grads, obs))
+
+            (params, pstate, opt_state, _), (losses, all_grads, all_obs) = \
+                lax.scan(one_step, (params, pstate, opt_state,
+                                    jnp.int32(0)), (args, kwargs))
+            losses = lax.pmean(losses, axis)
+            pstate = jax.tree.map(lambda s: lax.pmean(s, axis), pstate)
+            last_grads = jax.tree.map(lambda g: g[-1], all_grads)
+            obs = jax.tree.map(lambda o: lax.pmean(o[-1], axis), all_obs)
+            return params, pstate, opt_state, losses, last_grads, obs
+
+        def batch_spec(leaf):
+            # leading axis = step axis (replicated); axis 1 = global batch
+            if leaf.shape[1] % size == 0 and leaf.shape[1] > 0:
+                return P(None, axis)
+            raise ValueError(
+                f"update_scan leaf with batch dim {leaf.shape[1]} is not "
+                f"divisible by communicator size {size}")
+
+        args_specs = jax.tree.map(batch_spec, ex_args)
+        kwargs_specs = jax.tree.map(batch_spec, ex_kwargs)
+        mapped = shard_map(
+            rank_scan, mesh=comm.mesh,
+            in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
+            out_specs=(P(), P(), P(), P(), P(), P()),
+            check_vma=False)
+        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        return jax.jit(mapped, donate_argnums=donate)
+
     # -- misc reference API -----------------------------------------------------
     def new_epoch(self):
         self.actual_optimizer.new_epoch()

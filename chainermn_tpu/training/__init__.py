@@ -1,5 +1,5 @@
 from .trainer import (Trainer, Extension, make_extension, PRIORITY_WRITER,
                       PRIORITY_EDITOR, PRIORITY_READER)
-from .updaters import Updater, StandardUpdater
+from .updaters import Updater, StandardUpdater, FusedUpdater
 from . import triggers
 from . import extensions

@@ -38,6 +38,9 @@ def main():
     parser.add_argument("--out", "-o", default="result_imagenet")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
+    parser.add_argument("--fused", type=int, default=0,
+                        help="fuse K optimizer steps per dispatch "
+                             "(FusedUpdater/update_scan; 0 = per-step)")
     args = parser.parse_args()
 
     if args.simulate_devices:
@@ -63,7 +66,11 @@ def main():
     train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
     train_iter = MultithreadIterator(train, args.batchsize * comm.size)
 
-    updater = StandardUpdater(train_iter, optimizer)
+    if args.fused:
+        from chainermn_tpu.training import FusedUpdater
+        updater = FusedUpdater(train_iter, optimizer, n_fused=args.fused)
+    else:
+        updater = StandardUpdater(train_iter, optimizer)
     stop = (args.iterations, "iteration") if args.iterations \
         else (args.epoch, "epoch")
     trainer = Trainer(updater, stop, out=args.out)

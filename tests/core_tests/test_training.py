@@ -256,3 +256,51 @@ def test_logreport_log_survives_snapshot(tmp_path, mnist_small):
     t2 = build()
     load_npz(os.path.join(str(tmp_path / "lr"), "s"), t2)
     assert len(t2.get_extension("LogReport").log) == 2
+
+
+def test_fused_updater_equals_standard(tmp_path, mnist_small):
+    """FusedUpdater (K steps per dispatch) produces the same weights as
+    StandardUpdater over the same batch stream (deterministic model)."""
+    from chainermn_tpu.training import FusedUpdater
+    train, _ = mnist_small
+    comm = ct.create_communicator("jax_ici")
+
+    def run(fused):
+        model = Classifier(MLP())
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+        it = SerialIterator(train, 64, seed=0)
+        if fused:
+            upd = FusedUpdater(it, opt, n_fused=2)
+            trainer = Trainer(upd, (4, "iteration"), out=str(tmp_path / "f"))
+        else:
+            upd = StandardUpdater(it, opt)
+            trainer = Trainer(upd, (4, "iteration"), out=str(tmp_path / "s"))
+        trainer.run()
+        assert upd.iteration == 4
+        return model
+
+    m_std = run(False)
+    m_fused = run(True)
+    for (_, p1), (_, p2) in zip(m_fused.namedparams(), m_std.namedparams()):
+        np.testing.assert_allclose(np.asarray(p1.array), np.asarray(p2.array),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_updater_epoch_boundary_mid_block(mnist_small):
+    """new_epoch() fires even when the epoch boundary lands on a
+    non-final pull of the fused block."""
+    from chainermn_tpu.training import FusedUpdater
+    train, _ = mnist_small  # 512 samples
+    comm = ct.create_communicator("jax_ici")
+    model = Classifier(MLP())
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+    # 512/128 = 4 iterations per epoch; n_fused=3 puts the first epoch
+    # boundary on pull 1 of the second dispatch (iteration 4)
+    it = SerialIterator(train, 128, seed=0)
+    upd = FusedUpdater(it, opt, n_fused=3)
+    upd.update()          # iterations 1-3, no boundary
+    assert opt.epoch == 0
+    upd.update()          # iterations 4-6: boundary at 4 (mid-block)
+    assert opt.epoch == 1

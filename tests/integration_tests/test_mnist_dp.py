@@ -226,3 +226,50 @@ def test_multi_node_evaluator_sharded_eval_matches_plain():
     for k, v in r_plain.items():
         np.testing.assert_allclose(r_sharded[k], float(np.asarray(v)),
                                    rtol=1e-4)
+
+
+def test_update_scan_equals_sequential_updates():
+    """K fused steps (one dispatch) == K sequential update() calls on the
+    same per-step batches (deterministic model)."""
+    K = 3
+    batches = [_batch(64, seed=i) for i in range(K)]
+
+    model_seq = Classifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    comm.bcast_data(model_seq)
+    opt_seq = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model_seq)
+    seq_losses = [float(opt_seq.update(model_seq, x, t)) for x, t in batches]
+
+    model_scan = Classifier(MLP())
+    comm.bcast_data(model_scan)
+    opt_scan = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model_scan)
+    xs = jnp.stack([x for x, _ in batches])
+    ts = jnp.stack([t for _, t in batches])
+    scan_losses = opt_scan.update_scan(model_scan, xs, ts)
+
+    assert scan_losses.shape == (K,)
+    np.testing.assert_allclose(np.asarray(scan_losses), seq_losses, rtol=1e-5)
+    assert opt_scan.t == opt_seq.t == K
+    for (_, p1), (_, p2) in zip(model_scan.namedparams(),
+                                model_seq.namedparams()):
+        np.testing.assert_allclose(np.asarray(p1.array), np.asarray(p2.array),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_update_scan_rejects_double_buffering():
+    model = Classifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm,
+                                         double_buffering=True).setup(model)
+    x, t = _batch(64)
+    with pytest.raises(RuntimeError, match="double"):
+        opt.update_scan(model, jnp.stack([x]), jnp.stack([t]))
+
+
+def test_update_scan_bad_batch_axis_raises():
+    model = Classifier(MLP())
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm).setup(model)
+    x, t = _batch(30)  # 30 % 8 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        opt.update_scan(model, jnp.stack([x]), jnp.stack([t]))
