@@ -62,14 +62,141 @@ def _peak_tflops(devices):
     return None
 
 
-def _run_bench():
-    import jax
+def _transformer_flops_per_token(d_model, n_layers, n_vocab, seq_len):
+    """Analytic train-step flops per token for the causal LM: matmul
+    fwd = 2·(12·L·d² + d·V), attention fwd = 4·T·d·L (scores + values,
+    causal halving ignored ≈ upper bound), train ≈ 3× fwd."""
+    matmul = 2.0 * (12.0 * n_layers * d_model ** 2 + d_model * n_vocab)
+    attn = 4.0 * seq_len * d_model * n_layers
+    return 3.0 * (matmul + attn)
+
+
+def _enable_compile_cache(jax):
     try:  # persistent compile cache: repeat runs skip the ~30s XLA compile
         jax.config.update("jax_compilation_cache_dir",
                           "/tmp/chainermn_tpu_jax_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+
+def _timed_steps(do_steps, calls, trials=3):
+    """Shared timing discipline for every bench mode: one trace+compile
+    call, 2 warmup calls, then best-of-``trials`` over ``calls``
+    dispatches per trial — each trial synced by a real device->host
+    value fetch (float(loss)); through the remote-tunnel backend on this
+    box jax.block_until_ready returns before execution completes, which
+    once inflated numbers past physical peak flops.  A value fetch
+    cannot be faked.  Returns (best_elapsed_seconds, compile_seconds)."""
+    t0 = time.perf_counter()
+    loss = do_steps()  # first call: trace + XLA compile
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    for _ in range(2):
+        loss = do_steps()
+    float(loss)
+    best = None
+    for _ in range(trials):
+        start = time.perf_counter()
+        for _ in range(calls):
+            loss = do_steps()
+        float(loss)
+        elapsed = time.perf_counter() - start
+        best = elapsed if best is None else min(best, elapsed)
+    return best, compile_s
+
+
+def _run_bench_transformer():
+    """Auxiliary bench mode (BENCH_MODEL=transformer): GPT-2-small-class
+    causal LM, tokens/sec/chip + MFU.  No reference-era baseline exists
+    for this vertical (vs_baseline=null); recorded for the long-context
+    story alongside the headline ResNet number."""
+    import jax
+    _enable_compile_cache(jax)
+    import jax.numpy as jnp
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import Adam
+    from chainermn_tpu.models import TransformerLM
+
+    per_chip_bs = int(os.environ.get("BENCH_BS", "8"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    d_model = int(os.environ.get("BENCH_D_MODEL", "768"))
+    n_layers = int(os.environ.get("BENCH_LAYERS", "12"))
+    n_vocab = int(os.environ.get("BENCH_VOCAB", "32768"))
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    n_heads = int(os.environ.get("BENCH_HEADS", "0")) or max(1, d_model // 64)
+    if d_model % n_heads:
+        raise ValueError(f"BENCH_D_MODEL={d_model} is not divisible by "
+                         f"n_heads={n_heads}; set BENCH_HEADS explicitly")
+
+    devices = jax.devices()
+    n_devices = len(devices)
+    platform = devices[0].platform
+
+    def run(per_chip_bs):
+        comm = ct.create_communicator("jax_ici",
+                                      allreduce_grad_dtype="bfloat16")
+        model = TransformerLM(n_vocab=n_vocab, d_model=d_model,
+                              n_heads=n_heads, n_layers=n_layers,
+                              max_len=seq_len, seed=0, remat=remat,
+                              compute_dtype=jnp.bfloat16)
+        comm.bcast_data(model)
+        inner = Adam(alpha=3e-4)
+        inner.donate_params = True
+        opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
+
+        global_bs = per_chip_bs * n_devices
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(0, n_vocab, (global_bs, seq_len))
+                        .astype(np.int32))
+        t = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+        best, compile_s = _timed_steps(lambda: opt.update(model, x, t),
+                                       n_steps)
+        return n_steps * global_bs * seq_len / best, compile_s
+
+    tokens_per_sec = None
+    last_err = None
+    used_bs = None
+    for bs in (per_chip_bs, per_chip_bs // 2, per_chip_bs // 4):
+        if bs < 1:
+            break
+        try:
+            tokens_per_sec, compile_s = run(bs)
+            used_bs = bs
+            break
+        except Exception as e:  # e.g. HBM OOM at the largest batch
+            last_err = e
+    if tokens_per_sec is None:
+        raise last_err
+    per_chip = tokens_per_sec / n_devices
+    result = {
+        "metric": "transformer_lm_train_throughput",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", platform),
+        "n_devices": n_devices,
+        "per_chip_batch": used_bs,
+        "seq_len": seq_len,
+        "d_model": d_model,
+        "n_layers": n_layers,
+        "compile_s": round(compile_s, 1),
+    }
+    peak = _peak_tflops(devices)
+    if peak:
+        fpt = _transformer_flops_per_token(d_model, n_layers, n_vocab,
+                                           seq_len)
+        result["mfu"] = round(per_chip * fpt / (peak * 1e12), 4)
+        result["peak_tflops_bf16"] = peak
+    return result
+
+
+def _run_bench():
+    import jax
+    _enable_compile_cache(jax)
     import jax.numpy as jnp
 
     import chainermn_tpu as ct
@@ -107,11 +234,6 @@ def _run_bench():
             0, 1, (global_bs, 3, image_size, image_size)).astype(np.float32))
         t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
 
-        # NOTE: timing uses a real device->host value fetch, not
-        # jax.block_until_ready — through the remote-tunnel backend on this
-        # box, block_until_ready returns before execution completes, which
-        # inflated round-1-style numbers past physical peak flops.  A value
-        # fetch cannot be faked.
         if scan_k:
             xs = jnp.broadcast_to(x, (scan_k,) + x.shape)
             ts = jnp.broadcast_to(t, (scan_k,) + t.shape)
@@ -120,24 +242,7 @@ def _run_bench():
         else:
             do_steps = lambda: opt.update(model, x, t)
             steps_per_call, calls = 1, n_steps
-
-        t0 = time.perf_counter()
-        loss = do_steps()  # first call: trace + XLA compile
-        float(loss)
-        compile_s = time.perf_counter() - t0
-
-        for _ in range(2):  # steady-state warmup
-            loss = do_steps()
-        float(loss)
-
-        best = None
-        for _ in range(3):  # best-of-3 trials; one sync per trial
-            start = time.perf_counter()
-            for _ in range(calls):
-                loss = do_steps()
-            float(loss)
-            elapsed = time.perf_counter() - start
-            best = elapsed if best is None else min(best, elapsed)
+        best, compile_s = _timed_steps(do_steps, calls)
         return calls * steps_per_call * global_bs / best, compile_s
 
     images_per_sec = None
@@ -178,8 +283,16 @@ def _run_bench():
 
 
 def main():
+    transformer_mode = \
+        os.environ.get("BENCH_MODEL", "resnet50") == "transformer"
+    if transformer_mode:
+        err_metric = ("transformer_lm_train_throughput", "tokens/sec/chip")
+    else:
+        err_metric = ("resnet50_imagenet_train_throughput",
+                      "images/sec/chip")
     try:
-        result = _run_bench()
+        result = _run_bench_transformer() if transformer_mode \
+            else _run_bench()
     except Exception as e:
         err = f"{type(e).__name__}: {e}"
         if (os.environ.get("JAX_PLATFORMS", "") != "cpu"
@@ -206,15 +319,15 @@ def main():
                     result["fallback_error"] = child_err
             except Exception as fb:
                 result = {
-                    "metric": "resnet50_imagenet_train_throughput",
-                    "value": None, "unit": "images/sec/chip",
+                    "metric": err_metric[0],
+                    "value": None, "unit": err_metric[1],
                     "vs_baseline": None, "error": err,
                     "fallback_error": f"{type(fb).__name__}: {fb}"[:500],
                 }
         else:
             result = {
-                "metric": "resnet50_imagenet_train_throughput",
-                "value": None, "unit": "images/sec/chip",
+                "metric": err_metric[0],
+                "value": None, "unit": err_metric[1],
                 "vs_baseline": None, "error": err,
             }
     print(json.dumps(result))

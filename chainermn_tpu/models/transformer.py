@@ -93,10 +93,11 @@ class TransformerLM(Chain):
 
     def __init__(self, n_vocab, d_model=128, n_heads=4, n_layers=2,
                  max_len=2048, seed=0, sp_comm=None, sp_mode="ring",
-                 remat=False):
+                 remat=False, compute_dtype=None):
         super().__init__()
         self.sp_comm = sp_comm
         self.remat = remat
+        self.compute_dtype = compute_dtype
         with self.init_scope():
             self.embed = L.EmbedID(n_vocab, d_model, seed=seed)
             self.pos_embed = L.EmbedID(max_len, d_model, seed=seed + 1)
@@ -115,6 +116,11 @@ class TransformerLM(Chain):
             offset = jax.lax.axis_index(self.sp_comm.axis_name) * T
         pos = offset + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
+        if self.compute_dtype is not None:
+            # params stay fp32; all block compute (matmuls, attention,
+            # residual stream) runs in the compute dtype — LN/softmax
+            # statistics are fp32 internally (nn.functions discipline)
+            h = h.astype(self.compute_dtype)
         for block in self.blocks:
             if self.remat:
                 # per-block rematerialization: backward recomputes the

@@ -92,6 +92,7 @@ def softmax_cross_entropy(x, t, ignore_label=-1, reduce="mean",
     are excluded from the normalizer; ``class_weight`` ([n_classes]) scales
     each example's loss by its target class's weight.
     """
+    x = x.astype(jnp.float32)  # fp32 log-softmax even for bf16 logits
     logp = jax.nn.log_softmax(x, axis=1)
     t_safe = jnp.where(t == ignore_label, 0, t)
     # gather the log-prob of the target class along axis 1
@@ -383,9 +384,14 @@ def _apply_bn(x, gamma, beta, mean, var, eps, axis):
 
 
 def layer_normalization(x, gamma, beta, eps=1e-5):
-    mean = x.mean(axis=-1, keepdims=True)
-    var = x.var(axis=-1, keepdims=True)
-    return (x - mean) * lax.rsqrt(var + eps) * gamma + beta
+    # statistics in fp32 (bf16 mean/var of wide rows loses precision),
+    # output in the activation dtype — same discipline as _apply_bn
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=-1, keepdims=True)
+    var = x32.var(axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps) * gamma.astype(jnp.float32) \
+        + beta.astype(jnp.float32)
+    return y.astype(x.dtype)
 
 
 # -- shape / array ops (thin jnp aliases, reference names) ------------------

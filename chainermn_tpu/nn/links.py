@@ -69,8 +69,13 @@ class Linear(Link):
         if self.W.array is None:
             in_size = int(np.prod(x.shape[n_batch_axes:]))
             self._init_params(in_size)
-        return F.linear(x, self.W.array, None if self.nobias else self.b.array,
-                        n_batch_axes=n_batch_axes)
+        W, b = self.W.array, None if self.nobias else self.b.array
+        if x.dtype in (jnp.bfloat16, jnp.float16) and W.dtype != x.dtype:
+            # mixed precision convention: parameters stored fp32, compute
+            # follows the activation dtype (bf16 matmuls on the MXU)
+            W = W.astype(x.dtype)
+            b = None if b is None else b.astype(x.dtype)
+        return F.linear(x, W, b, n_batch_axes=n_batch_axes)
 
 
 class Convolution2D(Link):

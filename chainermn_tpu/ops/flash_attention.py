@@ -40,11 +40,16 @@ _COMPILER_PARAMS = pltpu.CompilerParams(
 
 
 def xla_attention(q, k, v, causal=False, scale=None):
-    """jnp reference implementation (and non-TPU fallback)."""
+    """jnp reference implementation (and non-TPU fallback).
+
+    Dtype discipline: q/k/v keep their storage dtype INTO the matmuls
+    (bf16 inputs ride the MXU's native bf16 path) while
+    ``preferred_element_type=float32`` makes the accumulator fp32; the
+    softmax itself runs in fp32 and its probabilities are cast back to
+    the value dtype for the second matmul."""
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32),
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
@@ -52,7 +57,7 @@ def xla_attention(q, k, v, causal=False, scale=None):
         kpos = lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
         s = jnp.where((qpos >= kpos)[None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 

@@ -195,3 +195,25 @@ def test_generate_sampling_reproducible():
     a = m.generate(prompt, 5, temperature=1.0, key=jax.random.PRNGKey(7))
     b = m.generate(prompt, 5, temperature=1.0, key=jax.random.PRNGKey(7))
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_transformer_lm_bf16_compute():
+    """compute_dtype=bfloat16: params stay fp32, loss tracks the fp32
+    model's (fp32 statistics inside LN/softmax keep numerics sane)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from chainermn_tpu.models import TransformerLM
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, 64, (2, 16)).astype(np.int32))
+    t = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+
+    m32 = TransformerLM(n_vocab=64, d_model=32, n_heads=2, n_layers=2,
+                        max_len=32, seed=0)
+    m16 = TransformerLM(n_vocab=64, d_model=32, n_heads=2, n_layers=2,
+                        max_len=32, seed=0, compute_dtype=jnp.bfloat16)
+    l32 = float(m32(x, t))
+    l16 = float(m16(x, t))
+    assert abs(l32 - l16) / abs(l32) < 0.02
+    for _, p in m16.namedparams():
+        assert p.array.dtype == jnp.float32
