@@ -69,7 +69,11 @@ def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
     tk = k_ref.shape[0]
     qi = pl.program_id(1)
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    # dtype discipline: blocks go into the dots in their STORAGE dtype
+    # (bf16 rides the MXU's native path; an f32 upcast would force the
+    # 3-pass f32 matmul emulation) with fp32 accumulators via
+    # preferred_element_type; the online-softmax state stays fp32.
+    q = q_ref[:]
     m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
@@ -78,10 +82,10 @@ def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
 
     def body(ki, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = (ki * block_k
                      + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
@@ -93,7 +97,7 @@ def _flash_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -118,8 +122,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     bq, d = q_ref.shape
     tk = k_ref.shape[0]
     qi = pl.program_id(1)
-    q = q_ref[:].astype(jnp.float32) * scale
-    g = g_ref[:].astype(jnp.float32)
+    q = q_ref[:]          # storage dtype into the dots (see fwd kernel)
+    g = g_ref[:]
     lse = lse_ref[:].reshape(bq, 1)   # block arrives [bq, 1]
     delta = delta_ref[:].reshape(bq, 1)
     n_kblocks = tk // block_k
@@ -127,10 +131,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dq = jnp.zeros((bq, d), jnp.float32)
 
     def body(ki, dq):
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = (ki * block_k
                      + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
@@ -140,7 +144,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (gv - delta)
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -159,8 +163,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     bk, d = k_ref.shape
     tq = q_ref.shape[0]
     ki = pl.program_id(1)
-    k = k_ref[:].astype(jnp.float32)
-    v = v_ref[:].astype(jnp.float32)
+    k = k_ref[:]          # storage dtype into the dots (see fwd kernel)
+    v = v_ref[:]
     n_qblocks = tq // block_q
     k_pos = (ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1))
     dk = jnp.zeros((bk, d), jnp.float32)
@@ -168,28 +172,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q_blk = q_ref[pl.ds(qi * block_q, block_q), :] \
-            .astype(jnp.float32) * scale
-        g_blk = g_ref[pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
+        g_blk = g_ref[pl.ds(qi * block_q, block_q), :]
         lse = lse_ref[pl.ds(qi * block_q, block_q), :] \
             .reshape(block_q, 1)
         delta = delta_ref[pl.ds(qi * block_q, block_q), :] \
             .reshape(block_q, 1)
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = (qi * block_q
                      + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)
         dv = dv + jax.lax.dot_general(
-            p, g_blk, (((0,), (0,)), ((), ())),
+            p.astype(g_blk.dtype), g_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         gv = jax.lax.dot_general(g_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (gv - delta)
         dk = dk + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -199,7 +202,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     else:
         first = 0
     dk, dv = jax.lax.fori_loop(first, n_qblocks, body, (dk, dv))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
+    # ds was computed from UNSCALED q·k products with scale folded into s,
+    # so dk = scale · Σ ds·q (the fwd scale that s carries)
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
@@ -211,7 +216,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     tk = k_ref.shape[0]
     qi = pl.program_id(1)
 
-    q = q_ref[:].astype(jnp.float32) * scale
+    q = q_ref[:]          # storage dtype into the dots (see _flash_kernel_lse)
     m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
@@ -221,11 +226,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
 
     def body(ki, carry):
         m, l, acc = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [bq, block_k]
+            preferred_element_type=jnp.float32) * scale  # [bq, block_k]
         if causal:
             k_pos = (ki * block_k
                      + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
@@ -237,7 +242,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -453,7 +458,6 @@ def _blockwise_attention_lse_jnp(q, k, v, causal, scale, block_k=512):
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
     Tk_pad = k.shape[2]
     nb = Tk_pad // block_k
-    q32 = q.astype(jnp.float32)
     ks = jnp.moveaxis(k.reshape(B, H, nb, block_k, D), 2, 0)
     vs = jnp.moveaxis(v.reshape(B, H, nb, block_k, D), 2, 0)
     q_pos = lax.broadcasted_iota(jnp.int32, (Tq, 1), 0)
@@ -461,7 +465,8 @@ def _blockwise_attention_lse_jnp(q, k, v, causal, scale, block_k=512):
     def step(carry, blk):
         m, l, acc = carry
         k_blk, v_blk, bi = blk
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
+        # storage dtype into the matmul (bf16 MXU path), fp32 accumulator
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                        preferred_element_type=jnp.float32) * scale
         k_pos = (bi * block_k
                  + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
@@ -477,7 +482,7 @@ def _blockwise_attention_lse_jnp(q, k, v, causal, scale, block_k=512):
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32)
         return (m_new, l_new, acc_new), None
 

@@ -163,3 +163,32 @@ def test_blockwise_jnp_irregular_length_stays_blockwise():
     walk(jaxpr.jaxpr)
     assert not any(len(s) >= 2 and s[-1] > 32 and s[-2] == 64
                    for s in shapes), shapes
+
+
+def test_flash_bf16_matches_fp32_reference():
+    """bf16 storage dtype: kernel keeps bf16 into the MXU dots with fp32
+    accumulators/softmax — output must track the fp32 reference within
+    bf16 rounding, and gradients must flow."""
+    q, k, v = _data(T=128, D=32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = xla_attention(q, k, v, causal=True)
+    out = flash_attention(qb, kb, vb, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.02)
+
+    from chainermn_tpu.ops.flash_attention import _flash_diff
+
+    def loss(q, k, v):
+        return _flash_diff(q, k, v, True, None, True).astype(
+            jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return xla_attention(q, k, v, causal=True).sum()
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(qb, kb, vb)
+    rq, rk, rv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, r in ((gq, rq), (gk, rk), (gv, rv)):
+        np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
+                                   np.asarray(r), rtol=0.1, atol=0.05)
