@@ -72,6 +72,23 @@ class MeshCommunicator(CommunicatorBase):
         self._obj_mailbox = {}
         self._lock = threading.Lock()
         self._jit_cache = {}
+        # host topology (reference: init_ranks' hostname allgather at
+        # communicator construction, SURVEY §2.1): with multiple
+        # controller processes, intra_rank = this process's index among
+        # the processes on the same host.  Computed eagerly here because
+        # construction is already a collective point in multi-process
+        # SPMD discipline (lazy computation could deadlock if only one
+        # rank touched it).
+        self._intra = None
+        if jax.process_count() > 1:
+            try:
+                import socket
+                me = (socket.gethostname(), jax.process_index())
+                peers = self._process_allgather_pickled(me)
+                same = sorted(pi for host, pi in peers if host == me[0])
+                self._intra = (same.index(me[1]), len(same))
+            except Exception:
+                self._intra = None  # no object channel: single-host default
 
     def __deepcopy__(self, memo):
         # communicators are process-global transport handles (mesh, device
@@ -99,11 +116,17 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def intra_rank(self):
-        return 0  # one controller per host drives all local devices
+        """This controller process's index among the processes on the
+        same host (0 when one controller drives all local devices —
+        the common single-controller-per-host layout)."""
+        return self._intra[0] if self._intra is not None else 0
 
     @property
     def intra_size(self):
-        return jax.local_device_count()
+        """Device slots this host contributes: local device count ×
+        co-located controller processes (reference: ranks per node)."""
+        n_local_procs = self._intra[1] if self._intra is not None else 1
+        return jax.local_device_count() * n_local_procs
 
     @property
     def inter_rank(self):

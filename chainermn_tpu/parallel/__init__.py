@@ -14,7 +14,8 @@ from .ulysses import (ulysses_attention, seq_to_head_shard,
 from .pipeline import gpipe_apply, split_microbatches, merge_microbatches
 from .moe import (switch_moe, moe_dispatch_combine,
                   moe_dispatch_combine_topk)
-from .one_f_one_b import one_f_one_b, make_pipeline_train_step
+from .one_f_one_b import (one_f_one_b, make_pipeline_train_step,
+                          heterogeneous_stage_fn)
 
 __all__ = ["make_mesh", "axis_communicators", "shard_batch", "replicate",
            "ring_self_attention", "ring_attention", "ulysses_attention",

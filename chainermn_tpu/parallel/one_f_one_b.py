@@ -29,7 +29,28 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["one_f_one_b", "make_pipeline_train_step"]
+__all__ = ["one_f_one_b", "make_pipeline_train_step",
+           "heterogeneous_stage_fn"]
+
+
+def heterogeneous_stage_fn(stage_fns, axis_name):
+    """Combine per-stage callables into one SPMD ``stage_fn``.
+
+    The 1F1B schedule is one compiled SPMD program, so every rank runs
+    the same code; per-stage *computation* differences are expressed as
+    a ``lax.switch`` over the stage index (all branches trace, the
+    device executes its own).  Constraints that remain (and are checked
+    at trace time by JAX itself): every stage shares one parameter-tree
+    structure and the activation shape is uniform across stage
+    boundaries (``ppermute`` requires it).  Truly heterogeneous
+    graphs — different shapes or parameter structures per stage —
+    belong to ``MultiNodeChainList`` (reference semantics, SURVEY §3.3).
+    """
+    def stage_fn(params, h):
+        branches = [lambda p, hh, f=f: f(p, hh) for f in stage_fns]
+        s = lax.axis_index(axis_name)
+        return lax.switch(s, branches, params, h)
+    return stage_fn
 
 
 def one_f_one_b(comm, stage_fn, loss_fn, stage_params, x_microbatches,

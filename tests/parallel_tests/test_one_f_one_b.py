@@ -113,3 +113,56 @@ def test_pipeline_train_step_converges():
         params, opt_state, loss = step(params, opt_state, x, y)
         losses.append(float(np.asarray(loss)))
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_1f1b_heterogeneous_stages_match_sequential():
+    """Different per-stage computation (relu/gelu/tanh/identity mix via
+    heterogeneous_stage_fn's lax.switch) still reproduces the sequential
+    stack's loss and gradients exactly."""
+    from chainermn_tpu.parallel import heterogeneous_stage_fn
+
+    acts = [jax.nn.relu, jax.nn.gelu, jnp.tanh, lambda h: h]
+
+    def make_stage(act):
+        return lambda params, h: act(h @ params[0] + params[1])
+
+    S = COMM.size
+    stage_fns = [make_stage(acts[s % len(acts)]) for s in range(S)]
+    het_fn = heterogeneous_stage_fn(stage_fns, "fb")
+
+    W, b = _params(7)
+    rng = np.random.RandomState(8)
+    M = 4
+    x = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    xm = split_microbatches(x, M)
+    ym = split_microbatches(y, M)
+
+    def body(Wl, bl, xm, ym):
+        loss, (gW, gb) = one_f_one_b(COMM, het_fn, _loss_fn,
+                                     (Wl[0], bl[0]), xm, ym)
+        return loss.reshape(1), gW[None], gb[None]
+
+    loss, gW, gb = jax.jit(jax.shard_map(
+        body, mesh=COMM.mesh,
+        in_specs=(P("fb"), P("fb"), P(), P()),
+        out_specs=(P("fb"), P("fb"), P("fb")),
+        check_vma=False))(W, b, xm, ym)
+
+    def ref_loss(params):
+        W, b = params
+        total = 0.0
+        for i in range(M):
+            h = xm[i]
+            for s in range(S):
+                h = stage_fns[s]((W[s], b[s]), h)
+            total = total + _loss_fn(h, ym[i])
+        return total / M
+
+    l_ref, (gW_ref, gb_ref) = jax.value_and_grad(ref_loss)((W, b))
+    np.testing.assert_allclose(float(np.asarray(loss)[0]), float(l_ref),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(gW_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
+                               rtol=1e-4, atol=1e-5)
