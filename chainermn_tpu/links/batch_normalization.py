@@ -26,14 +26,15 @@ __all__ = ["MultiNodeBatchNormalization"]
 class MultiNodeBatchNormalization(BatchNormalization):
     def __init__(self, size, comm, decay=0.9, eps=2e-5, dtype=None,
                  use_gamma=True, use_beta=True, initial_gamma=None,
-                 initial_beta=None, communication_backend="auto"):
+                 initial_beta=None, communication_backend="auto",
+                 axis=None):
         # communication_backend kept for reference-signature parity
         # (mpi/nccl/auto selectable there; one XLA backend here)
         import numpy as np
         super().__init__(size, decay=decay, eps=eps,
                          dtype=dtype or np.float32, use_gamma=use_gamma,
                          use_beta=use_beta, initial_gamma=initial_gamma,
-                         initial_beta=initial_beta)
+                         initial_beta=initial_beta, axis=axis)
         self.comm = comm
         self.communication_backend = communication_backend
 

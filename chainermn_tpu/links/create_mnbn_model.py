@@ -29,7 +29,8 @@ def _replace(link, comm):
                 not isinstance(child, MultiNodeBatchNormalization):
             mnbn = MultiNodeBatchNormalization(
                 child.size, comm, decay=child.decay, eps=child.eps,
-                use_gamma=child.use_gamma, use_beta=child.use_beta)
+                use_gamma=child.use_gamma, use_beta=child.use_beta,
+                axis=child.axis)
             if child.use_gamma:
                 mnbn.gamma.array = child.gamma.array
             if child.use_beta:

@@ -5,8 +5,11 @@ ResNet50Layers`` and ChainerMN's ``examples/imagenet/train_imagenet.py``
 (SURVEY.md §6: ResNet-50/ImageNet is the reference's headline benchmark).
 Freshly designed for TPU rather than transcribed:
 
-* NCHW activations feed ``lax.conv_general_dilated`` — XLA re-layouts
-  onto the MXU; all convs are large static-shape GEMM-like ops.
+* Activations run in a selectable layout: ``layout="NHWC"`` (the TPU
+  native channels-last layout — channels map onto the MXU lane dimension,
+  so XLA inserts no relayout transposes between conv/BN/relu) or
+  ``"NCHW"`` (the reference layout, kept as the compatibility default).
+  Kernels are stored OIHW either way, so checkpoints are layout-portable.
 * ``compute_dtype=bfloat16`` runs conv/matmul compute in bf16 (MXU-native)
   with fp32 parameters and fp32 BN statistics — the TPU translation of the
   reference era's fp16 training recipe.
@@ -27,14 +30,18 @@ __all__ = ["ResNet50", "ResNet18", "ResNet101", "BottleneckBlock",
 
 
 class ConvBN(Chain):
-    def __init__(self, in_ch, out_ch, ksize, stride=1, pad=0, seed=None):
+    def __init__(self, in_ch, out_ch, ksize, stride=1, pad=0, seed=None,
+                 layout="NCHW"):
         super().__init__()
         self.stride = stride
         self.pad = pad
+        self.layout = layout
+        bn_axis = (0, 1, 2) if layout == "NHWC" else None  # None → (0,2,3)
         with self.init_scope():
             self.conv = L.Convolution2D(in_ch, out_ch, ksize, stride=stride,
-                                        pad=pad, nobias=True, seed=seed)
-            self.bn = L.BatchNormalization(out_ch)
+                                        pad=pad, nobias=True, seed=seed,
+                                        layout=layout)
+            self.bn = L.BatchNormalization(out_ch, axis=bn_axis)
 
     def forward(self, x, activate=True):
         # conv compute in the activation dtype (bf16 on the MXU when the
@@ -43,7 +50,8 @@ class ConvBN(Chain):
         # functions.py _apply_bn) — the elementwise chain conv→BN→relu
         # never round-trips the full tensor through fp32
         W = self.conv.W.array.astype(x.dtype)
-        h = F.convolution_2d(x, W, None, self.stride, self.pad)
+        h = F.convolution_2d(x, W, None, self.stride, self.pad,
+                             layout=self.layout)
         h = self.bn(h)
         if activate:
             h = F.relu(h)
@@ -54,17 +62,17 @@ class BottleneckBlock(Chain):
     """1x1 → 3x3 → 1x1 bottleneck with optional projection shortcut."""
 
     def __init__(self, in_ch, mid_ch, out_ch, stride=1, project=False,
-                 seed=0):
+                 seed=0, layout="NCHW"):
         super().__init__()
         self.project = project or in_ch != out_ch or stride != 1
         with self.init_scope():
-            self.a = ConvBN(in_ch, mid_ch, 1, seed=seed)
+            self.a = ConvBN(in_ch, mid_ch, 1, seed=seed, layout=layout)
             self.b = ConvBN(mid_ch, mid_ch, 3, stride=stride, pad=1,
-                            seed=seed + 1)
-            self.c = ConvBN(mid_ch, out_ch, 1, seed=seed + 2)
+                            seed=seed + 1, layout=layout)
+            self.c = ConvBN(mid_ch, out_ch, 1, seed=seed + 2, layout=layout)
             if self.project:
                 self.shortcut = ConvBN(in_ch, out_ch, 1, stride=stride,
-                                       seed=seed + 3)
+                                       seed=seed + 3, layout=layout)
 
     def forward(self, x):
         h = self.a(x)
@@ -77,15 +85,17 @@ class BottleneckBlock(Chain):
 class BasicBlock(Chain):
     """3x3 → 3x3 block (ResNet-18/34)."""
 
-    def __init__(self, in_ch, out_ch, stride=1, seed=0):
+    def __init__(self, in_ch, out_ch, stride=1, seed=0, layout="NCHW"):
         super().__init__()
         self.project = in_ch != out_ch or stride != 1
         with self.init_scope():
-            self.a = ConvBN(in_ch, out_ch, 3, stride=stride, pad=1, seed=seed)
-            self.b = ConvBN(out_ch, out_ch, 3, pad=1, seed=seed + 1)
+            self.a = ConvBN(in_ch, out_ch, 3, stride=stride, pad=1, seed=seed,
+                            layout=layout)
+            self.b = ConvBN(out_ch, out_ch, 3, pad=1, seed=seed + 1,
+                            layout=layout)
             if self.project:
                 self.shortcut = ConvBN(in_ch, out_ch, 1, stride=stride,
-                                       seed=seed + 2)
+                                       seed=seed + 2, layout=layout)
 
     def forward(self, x):
         h = self.a(x)
@@ -95,12 +105,13 @@ class BasicBlock(Chain):
 
 
 class _Stage(ChainList):
-    def __init__(self, n_blocks, in_ch, mid_ch, out_ch, stride, seed):
+    def __init__(self, n_blocks, in_ch, mid_ch, out_ch, stride, seed,
+                 layout="NCHW"):
         blocks = [BottleneckBlock(in_ch, mid_ch, out_ch, stride=stride,
-                                  project=True, seed=seed)]
+                                  project=True, seed=seed, layout=layout)]
         for i in range(1, n_blocks):
             blocks.append(BottleneckBlock(out_ch, mid_ch, out_ch,
-                                          seed=seed + 10 * i))
+                                          seed=seed + 10 * i, layout=layout))
         super().__init__(*blocks)
 
     def forward(self, x):
@@ -111,16 +122,22 @@ class _Stage(ChainList):
 
 class ResNet(Chain):
     def __init__(self, block_counts, n_classes=1000, compute_dtype=None,
-                 seed=42, remat=False):
+                 seed=42, remat=False, layout="NCHW"):
         super().__init__()
         self.compute_dtype = compute_dtype
         self.remat = remat
+        self.layout = layout
         with self.init_scope():
-            self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
-            self.res2 = _Stage(block_counts[0], 64, 64, 256, 1, seed + 100)
-            self.res3 = _Stage(block_counts[1], 256, 128, 512, 2, seed + 200)
-            self.res4 = _Stage(block_counts[2], 512, 256, 1024, 2, seed + 300)
-            self.res5 = _Stage(block_counts[3], 1024, 512, 2048, 2, seed + 400)
+            self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed,
+                                layout=layout)
+            self.res2 = _Stage(block_counts[0], 64, 64, 256, 1, seed + 100,
+                               layout=layout)
+            self.res3 = _Stage(block_counts[1], 256, 128, 512, 2, seed + 200,
+                               layout=layout)
+            self.res4 = _Stage(block_counts[2], 512, 256, 1024, 2, seed + 300,
+                               layout=layout)
+            self.res5 = _Stage(block_counts[3], 1024, 512, 2048, 2, seed + 400,
+                               layout=layout)
             self.fc = L.Linear(2048, n_classes, seed=seed + 500)
 
     def _apply_stage(self, stage, h):
@@ -155,27 +172,28 @@ class ResNet(Chain):
         if self.compute_dtype is not None:
             x = x.astype(self.compute_dtype)
         h = self.conv1(x)
-        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
+        h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False,
+                             layout=self.layout)
         h = self._apply_stage(self.res2, h)
         h = self._apply_stage(self.res3, h)
         h = self._apply_stage(self.res4, h)
         h = self._apply_stage(self.res5, h)
-        h = F.global_average_pooling_2d(h)
+        h = F.global_average_pooling_2d(h, layout=self.layout)
         return self.fc(h.astype(jnp.float32))
 
 
 class ResNet50(ResNet):
     def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
-                 remat=False):
+                 remat=False, layout="NCHW"):
         super().__init__([3, 4, 6, 3], n_classes, compute_dtype, seed,
-                         remat=remat)
+                         remat=remat, layout=layout)
 
 
 class ResNet101(ResNet):
     def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
-                 remat=False):
+                 remat=False, layout="NCHW"):
         super().__init__([3, 4, 23, 3], n_classes, compute_dtype, seed,
-                         remat=remat)
+                         remat=remat, layout=layout)
 
 
 class ResNet18(Chain):

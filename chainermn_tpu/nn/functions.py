@@ -194,13 +194,29 @@ def embed_id(x, W, ignore_label=None):
     return W[x]
 
 
-# -- convolutions (NCHW, kernel OIHW — reference layout) --------------------
+# -- convolutions -----------------------------------------------------------
+#
+# Kernel storage is always OIHW (the reference layout — checkpoints stay
+# portable); the ACTIVATION layout is a per-call choice.  "NCHW" is the
+# reference's layout; "NHWC" is the TPU-native layout (channels-last maps
+# directly onto the MXU's lane dimension, so XLA inserts no relayout
+# transposes between conv, BN, and elementwise ops).
 
 def _pair(v):
     return (v, v) if np.isscalar(v) else tuple(v)
 
 
-def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1):
+def _spatial_dims(layout):
+    """(h_dim, w_dim, channel_dim) for a 4-D activation layout string."""
+    if layout == "NCHW":
+        return 2, 3, 1
+    if layout == "NHWC":
+        return 1, 2, 3
+    raise ValueError(f"unsupported activation layout {layout!r}")
+
+
+def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1,
+                   layout="NCHW"):
     sy, sx = _pair(stride)
     ph, pw = _pair(pad)
     dy, dx = _pair(dilate)
@@ -209,11 +225,12 @@ def convolution_2d(x, W, b=None, stride=1, pad=0, dilate=1, groups=1):
         window_strides=(sy, sx),
         padding=((ph, ph), (pw, pw)),
         rhs_dilation=(dy, dx),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=(layout, "OIHW", layout),
         feature_group_count=groups,
     )
     if b is not None:
-        y = y + b[None, :, None, None]
+        y = y + (b[None, :, None, None] if layout == "NCHW"
+                 else b[None, None, None, :])
     return y
 
 
@@ -266,13 +283,26 @@ def depthwise_convolution_2d(x, W, b=None, stride=1, pad=0):
 
 # -- pooling ---------------------------------------------------------------
 
-def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
+def _pool_geometry(kh, kw, sy, sx, pads, layout):
+    """(window_dims, window_strides, padding) for a 4-D pooling op in
+    either activation layout; ``pads`` is ((ph_lo, ph_hi), (pw_lo, pw_hi))."""
+    hd, wd, _ = _spatial_dims(layout)
+    dims, strides, padding = [1] * 4, [1] * 4, [(0, 0)] * 4
+    dims[hd], dims[wd] = kh, kw
+    strides[hd], strides[wd] = sy, sx
+    padding[hd], padding[wd] = pads
+    return tuple(dims), tuple(strides), tuple(padding)
+
+
+def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True,
+                   layout="NCHW"):
     kh, kw = _pair(ksize)
     sy, sx = _pair(stride if stride is not None else ksize)
     ph, pw = _pair(pad)
+    hd, wd, _ = _spatial_dims(layout)
     if cover_all:
         # reference semantics: pad enough that every element is covered
-        h, w = x.shape[2], x.shape[3]
+        h, w = x.shape[hd], x.shape[wd]
         # NB: this module shadows builtin max with the F.max alias
         eh = builtins.max(0, (-(h + 2 * ph - kh) % sy)) if sy > 1 else 0
         ew = builtins.max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
@@ -280,24 +310,18 @@ def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True):
         eh = ew = 0
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.iinfo(x.dtype).min
-    return lax.reduce_window(
-        x, neg, lax.max,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sy, sx),
-        padding=((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew)),
-    )
+    dims, strides, padding = _pool_geometry(
+        kh, kw, sy, sx, ((ph, ph + eh), (pw, pw + ew)), layout)
+    return lax.reduce_window(x, neg, lax.max, dims, strides, padding)
 
 
-def average_pooling_2d(x, ksize, stride=None, pad=0):
+def average_pooling_2d(x, ksize, stride=None, pad=0, layout="NCHW"):
     kh, kw = _pair(ksize)
     sy, sx = _pair(stride if stride is not None else ksize)
     ph, pw = _pair(pad)
-    summed = lax.reduce_window(
-        x, 0.0, lax.add,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sy, sx),
-        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
-    )
+    dims, strides, padding = _pool_geometry(
+        kh, kw, sy, sx, ((ph, ph), (pw, pw)), layout)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
     # reference divides by the full window size (count_include_pad=True)
     return summed / (kh * kw)
 
@@ -338,8 +362,9 @@ def unpooling_2d(x, ksize, stride=None, pad=0, outsize=None, cover_all=True):
     return y
 
 
-def global_average_pooling_2d(x):
-    return x.mean(axis=(2, 3))
+def global_average_pooling_2d(x, layout="NCHW"):
+    hd, wd, _ = _spatial_dims(layout)
+    return x.mean(axis=(hd, wd))
 
 
 def resize_images(x, output_shape):
@@ -373,11 +398,20 @@ def _apply_bn(x, gamma, beta, mean, var, eps, axis):
     f32 = jnp.float32
     inv = lax.rsqrt(var.astype(f32) + eps)
     a = gamma.astype(f32) * inv
-    b = beta.astype(f32) - mean.astype(f32) * a
     shape = [1] * x.ndim
     kept = [d for d in range(x.ndim) if d not in axis]
     for d in kept:
         shape[d] = x.shape[d]
+    if x.dtype == f32:
+        # fp32 activations keep the unfolded (x - mean) * a + beta form:
+        # when |mean| >> std the folded ``x*a + (beta - mean*a)`` loses
+        # precision to cancellation, and fp32 gains nothing from folding
+        # (the fusion win is bf16 HBM traffic only).
+        m = mean.astype(f32).reshape(shape)
+        a = a.reshape(shape)
+        b = beta.astype(f32).reshape(shape)
+        return (x - m) * a + b
+    b = beta.astype(f32) - mean.astype(f32) * a
     a = a.reshape(shape).astype(x.dtype)
     b = b.reshape(shape).astype(x.dtype)
     return x * a + b

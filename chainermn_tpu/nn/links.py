@@ -79,16 +79,22 @@ class Linear(Link):
 
 
 class Convolution2D(Link):
-    """2-D convolution, kernel (out, in, kh, kw), NCHW activations."""
+    """2-D convolution, kernel (out, in, kh, kw) regardless of layout.
+
+    ``layout`` selects the ACTIVATION layout: "NCHW" (reference default)
+    or "NHWC" (TPU-native channels-last — see F.convolution_2d).  Kernel
+    storage stays OIHW either way, so checkpoints are layout-portable.
+    """
 
     def __init__(self, in_channels, out_channels=None, ksize=None, stride=1,
                  pad=0, nobias=False, initialW=None, initial_bias=None,
-                 dilate=1, groups=1, seed=None):
+                 dilate=1, groups=1, seed=None, layout="NCHW"):
         super().__init__()
         if ksize is None:
             # Chainer-style remap: Convolution2D(out_channels, ksize)
             in_channels, out_channels, ksize = None, in_channels, out_channels
         self.in_channels = in_channels
+        self.layout = layout
         self.out_channels = out_channels
         self.ksize = ksize
         self.stride = stride
@@ -117,10 +123,12 @@ class Convolution2D(Link):
 
     def forward(self, x):
         if self.W.array is None:
-            self._init_params(x.shape[1])
+            self._init_params(x.shape[3] if self.layout == "NHWC"
+                              else x.shape[1])
         return F.convolution_2d(x, self.W.array,
                                 None if self.nobias else self.b.array,
-                                self.stride, self.pad, self.dilate, self.groups)
+                                self.stride, self.pad, self.dilate,
+                                self.groups, layout=self.layout)
 
 
 class Deconvolution2D(Link):

