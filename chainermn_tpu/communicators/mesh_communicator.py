@@ -75,10 +75,16 @@ class MeshCommunicator(CommunicatorBase):
         # host topology (reference: init_ranks' hostname allgather at
         # communicator construction, SURVEY §2.1): with multiple
         # controller processes, intra_rank = this process's index among
-        # the processes on the same host.  Computed eagerly here because
-        # construction is already a collective point in multi-process
-        # SPMD discipline (lazy computation could deadlock if only one
-        # rank touched it).
+        # the processes on the same host.  NOTE: under process_count > 1
+        # communicator construction is a COLLECTIVE point — every process
+        # must construct communicators (including from_mesh_axis /
+        # split_all sub-communicators) in the same order, or peers block
+        # in this allgather until the KV channel's timeout_ms expires
+        # (the channel bounds every get/barrier, so a one-sided failure
+        # surfaces as a timeout error on the peers, not a silent hang).
+        # The except below only rescues THIS process (e.g. no object
+        # channel at all); it cannot unblock peers already inside the
+        # collective — they recover via the same timeout.
         self._intra = None
         if jax.process_count() > 1:
             try:
@@ -116,15 +122,21 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def intra_rank(self):
-        """This controller process's index among the processes on the
-        same host (0 when one controller drives all local devices —
-        the common single-controller-per-host layout)."""
-        return self._intra[0] if self._intra is not None else 0
+        """First device slot this controller drives on its host, in
+        DEVICE-SLOT units — the same units as ``intra_size``, so the
+        reference idiom ``intra_rank in range(0, intra_size)`` and
+        slot arithmetic hold on every host layout.  0 for the common
+        single-controller-per-host layout; ``local_proc_idx ×
+        local_device_count`` when several controller processes share a
+        host."""
+        local_proc_idx = self._intra[0] if self._intra is not None else 0
+        return local_proc_idx * jax.local_device_count()
 
     @property
     def intra_size(self):
-        """Device slots this host contributes: local device count ×
-        co-located controller processes (reference: ranks per node)."""
+        """Device slots this host contributes (DEVICE-SLOT units, like
+        ``intra_rank``): local device count × co-located controller
+        processes (reference: ranks per node)."""
         n_local_procs = self._intra[1] if self._intra is not None else 1
         return jax.local_device_count() * n_local_procs
 
@@ -303,22 +315,32 @@ class MeshCommunicator(CommunicatorBase):
         raise RuntimeError("recv_obj with empty mailbox (host mode)")
 
     def bcast_obj(self, obj, root=0):
+        # root is a CONTROLLER rank (inter_rank) in every mode — the
+        # single-controller collapse validates identically so a root that
+        # would be rejected at scale fails in development too
+        root = self._owning_process(root)
         if self.inter_size > 1:
             ch = self._host_channel()
             if ch is not None:
-                return ch.bcast(obj, root=self._owning_process(root))
+                return ch.bcast(obj, root=root)
             gathered = self._process_allgather_pickled(obj)
-            return gathered[root if root < len(gathered) else 0]
+            return gathered[root]
         return obj
 
     def _owning_process(self, root):
-        """Clamp an object-channel root to a valid controller rank.
+        """Validate an object-channel root as a controller rank.
 
         Host-mode object ops consistently address CONTROLLER processes
         (``inter_rank`` — see ``_MultiNodeIterator._is_master``,
-        ``scatter_dataset``); an out-of-range root falls back to 0, the
-        defensive behavior of the pre-KV-channel path."""
-        return root if 0 <= root < self.inter_size else 0
+        ``scatter_dataset``).  A mis-addressed root raises instead of
+        silently re-rooting to 0 (every process computes the same root
+        from the same arguments, so the error is raised symmetrically —
+        no one-sided collective hang)."""
+        if not 0 <= root < self.inter_size:
+            raise ValueError(
+                f"object-channel root {root} out of range for "
+                f"{self.inter_size} controller processes")
+        return root
 
     def gather_obj(self, obj, root=0):
         return self.allgather_obj(obj)
@@ -550,13 +572,30 @@ class MeshCommunicator(CommunicatorBase):
         ``color``/``key`` follow the per-rank convention: sequences of
         length ``size`` (device rank i gets color[i]); scalars apply the
         same value to every rank (the common "all same group" case).
-        Returns the sub-communicator containing *this controller's* view —
-        since one controller drives all devices, the full set of
-        sub-communicators is available as ``.split_all(color, key)``.
+        Returns the sub-communicator containing the CALLING controller's
+        devices (MPI semantics: rank r's ``MPI_Comm_Split`` returns r's
+        group).  All of this controller's local devices must share one
+        color — a straddling split has no single "my sub-communicator"
+        under single-controller SPMD.  The full set is available as
+        ``.split_all(color, key)``.
         """
-        return self.split_all(color, key)[0]
+        size = self.size
+        colors = [color] * size if np.isscalar(color) else list(color)
+        if len(colors) != size:
+            raise ValueError("color/key must be scalars or length-size")
+        local = [i for i, d in enumerate(self._devices)
+                 if getattr(d, "process_index", 0) == jax.process_index()]
+        my_colors = {colors[i] for i in (local or [0])}
+        if len(my_colors) > 1:
+            raise ValueError(
+                f"this controller's devices straddle split colors "
+                f"{sorted(my_colors)}; use split_all() for the full set")
+        my_color = my_colors.pop()
+        comms = self.split_all(color, key)
+        return comms[sorted(set(colors)).index(my_color)]
 
     def split_all(self, color, key):
+        """All sub-communicators of the split, ordered by sorted color."""
         size = self.size
         colors = [color] * size if np.isscalar(color) else list(color)
         keys = [key] * size if np.isscalar(key) else list(key)

@@ -51,10 +51,11 @@ class MultiHeadAttention(Chain):
                                                     self.d_head)
         q, k, v = [jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)]
         if _axis_bound(self.sp_comm):
-            if self.sp_mode == "ring":
+            if self.sp_mode in ("ring", "zigzag"):
                 from ..parallel import ring_self_attention
+                schedule = "zigzag" if self.sp_mode == "zigzag" else "naive"
                 out = ring_self_attention(self.sp_comm, q, k, v,
-                                          causal=causal)
+                                          causal=causal, schedule=schedule)
             else:
                 from ..parallel import ulysses_attention
                 out = ulysses_attention(self.sp_comm, q, k, v,
@@ -87,15 +88,18 @@ class TransformerBlock(Chain):
 
 class TransformerLM(Chain):
     """Causal LM.  ``sequence_parallel``: pass ``sp_comm`` and call inside
-    a program sharding the T dimension over its axis (positions must be
-    offset-consistent: ``pos_offset`` = rank * T_local, supplied
-    automatically when the axis is bound)."""
+    a program sharding the T dimension over its axis.  Position ids are
+    supplied automatically when the axis is bound: contiguous offsets for
+    ``sp_mode="ring"``/``"ulysses"`` (rank · T_local), the two-half-chunk
+    layout for ``sp_mode="zigzag"`` (the balanced causal ring — shard
+    inputs/targets with ``parallel.zigzag_shard`` along T)."""
 
     def __init__(self, n_vocab, d_model=128, n_heads=4, n_layers=2,
                  max_len=2048, seed=0, sp_comm=None, sp_mode="ring",
                  remat=False, compute_dtype=None):
         super().__init__()
         self.sp_comm = sp_comm
+        self.sp_mode = sp_mode
         self.remat = remat
         self.compute_dtype = compute_dtype
         with self.init_scope():
@@ -111,10 +115,21 @@ class TransformerLM(Chain):
 
     def hidden(self, x):
         B, T = x.shape
-        offset = 0
-        if _axis_bound(self.sp_comm):
-            offset = jax.lax.axis_index(self.sp_comm.axis_name) * T
-        pos = offset + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+        if _axis_bound(self.sp_comm) and self.sp_mode == "zigzag":
+            # zigzag layout: rank i holds global half-chunks i and
+            # 2n−1−i, so its positions are two disjoint ranges
+            n = self.sp_comm.size
+            i = jax.lax.axis_index(self.sp_comm.axis_name)
+            h = T // 2
+            local = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+            pos = jnp.where(local < h,
+                            i * h + local,
+                            (2 * n - 1 - i) * h + (local - h))
+        else:
+            offset = 0
+            if _axis_bound(self.sp_comm):
+                offset = jax.lax.axis_index(self.sp_comm.axis_name) * T
+            pos = offset + jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
         if self.compute_dtype is not None:
             # params stay fp32; all block compute (matmuls, attention,

