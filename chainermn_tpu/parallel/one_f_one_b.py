@@ -86,6 +86,11 @@ def one_f_one_b(comm, stage_fn, loss_fn, stage_params, x_microbatches,
         feed = lax.dynamic_index_in_dim(x_microbatches,
                                         jnp.clip(f, 0, M - 1), 0, False)
         act_in = jnp.where(stage == 0, feed, fwd_msg)
+        # invalid ticks run stage_fn anyway; give them real microbatch
+        # data, not the rotating zeros, so a stage singular at 0 (|h|,
+        # sqrt, 1/h) never evaluates at the singular point — keeps
+        # jax_debug_nans clean (same hardening as gpipe_apply)
+        act_in = jnp.where(f_valid, act_in, feed)
         out = stage_fn(stage_params, act_in)
         # store the stage input for backward-time recomputation
         ring = jnp.where(
@@ -99,6 +104,10 @@ def one_f_one_b(comm, stage_fn, loss_fn, stage_params, x_microbatches,
         b_valid = (b >= 0) & (b < M)
         act_saved = lax.dynamic_index_in_dim(
             ring, jnp.clip(b, 0, M - 1) % RING, 0, False)
+        # same hardening for the recompute-VJP: never evaluate pullback
+        # on an all-zeros ring slot (warmup) where the stage may be
+        # singular — a NaN there would survive the 0-gate (0 × NaN = NaN)
+        act_saved = jnp.where(b_valid, act_saved, feed)
         out_b, pullback = jax.vjp(lambda p, a: stage_fn(p, a),
                                   stage_params, act_saved)
         y_b = lax.dynamic_index_in_dim(y_microbatches,

