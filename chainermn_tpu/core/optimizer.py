@@ -85,6 +85,24 @@ class GradientClipping(_Hook):
     def to_optax(self):
         return optax.clip_by_global_norm(self.threshold)
 
+    def to_optax_sharded(self, axis):
+        """ZeRO variant: the transform sees only this rank's 1/n chunk of
+        the flat gradient, so the GLOBAL norm is the psum of per-chunk
+        squared norms — numerically identical to clipping the full
+        gradient (padding zeros contribute nothing)."""
+        threshold = self.threshold
+
+        def update_fn(updates, state, params=None):
+            del params
+            sq = sum(jnp.sum(jnp.square(u))
+                     for u in jax.tree.leaves(updates))
+            gnorm = jnp.sqrt(jax.lax.psum(sq, axis))
+            scale = jnp.minimum(1.0, threshold / jnp.maximum(gnorm, 1e-16))
+            return jax.tree.map(lambda u: u * scale, updates), state
+
+        return optax.GradientTransformation(lambda p: optax.EmptyState(),
+                                            update_fn)
+
 
 class GradientHardClipping(_Hook):
     name = "GradientHardClipping"
@@ -424,8 +442,12 @@ class Optimizer:
             except KeyError:  # snapshot saved before the first update()
                 n = None
             if n is not None and self.target is not None:
-                params = extract_state(self.target)["params"]
-                self._opt_state = self._transform().init(params)
+                # template for leaf placement: an existing state (e.g. the
+                # ZeRO wrapper pre-seeds its flat-sharded template before
+                # delegating here) wins over the default per-param tree
+                if self._opt_state is None:
+                    params = extract_state(self.target)["params"]
+                    self._opt_state = self._transform().init(params)
                 flat, treedef = jax.tree.flatten(self._opt_state)
                 new_flat = []
                 for i, leaf in enumerate(flat[: int(n)]):

@@ -45,13 +45,30 @@ __all__ = ["create_multi_node_optimizer", "_MultiNodeOptimizer",
 
 
 def create_multi_node_optimizer(actual_optimizer, communicator,
-                                double_buffering=False, zero_fill=True):
+                                double_buffering=False, zero_fill=True,
+                                zero_sharding=False):
     """Wrap an optimizer so updates average gradients over the communicator.
 
     Reference signature and delegation semantics preserved: the returned
     object forwards attribute access to ``actual_optimizer``.
+
+    ``zero_sharding=True`` (beyond the reference — ZeRO-1 over the DP
+    axis, TPU-idiomatic): the gradient mean becomes a ``psum_scatter``
+    (reduce-scatter riding ICI), each rank updates only its 1/n shard of
+    the flat parameter/optimizer-state vector, and an ``all_gather``
+    rebuilds the replicated parameters — optimizer state and the reduced
+    gradient buffer shrink by the communicator size (Adam: 2×params →
+    2×params/n).  Observable differences, documented: ``Parameter.grad``
+    is not populated (the full mean gradient never materializes) and the
+    serialized optimizer state is the flat sharded vector, not the
+    per-parameter tree.
     """
     if double_buffering:
+        if zero_sharding:
+            raise ValueError(
+                "zero_sharding is incompatible with double buffering "
+                "(a one-step-stale FULL gradient buffer would defeat "
+                "the sharded-state memory contract)")
         if communicator.name not in ("pure_nccl", "jax_ici", "hierarchical",
                                      "two_dimensional", "single_node", "flat",
                                      "dummy"):
@@ -61,14 +78,18 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
                 f"(reference: pure_nccl); got {communicator.name!r}")
         return _DoubleBufferingOptimizer(actual_optimizer, communicator,
                                          zero_fill)
-    return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill)
+    return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill,
+                               zero_sharding=zero_sharding)
 
 
 class _MultiNodeOptimizer:
-    def __init__(self, actual_optimizer, communicator, zero_fill=True):
+    def __init__(self, actual_optimizer, communicator, zero_fill=True,
+                 zero_sharding=False):
         super().__setattr__("communicator", communicator)
         super().__setattr__("actual_optimizer", actual_optimizer)
         super().__setattr__("zero_fill", zero_fill)
+        super().__setattr__("zero_sharding", zero_sharding)
+        super().__setattr__("_zero_layout", None)  # (spec, n, n_pad)
         from .core.optimizer import _LRUCache
         super().__setattr__("_mn_step_cache", _LRUCache())
         super().__setattr__("_stale_grads", None)  # double-buffer slot
@@ -113,11 +134,17 @@ class _MultiNodeOptimizer:
             self.communicator.verify_step_signature((args, kwargs))
         state = extract_state(actual.target)
         params, pstate = state["params"], state["state"]
-        opt_state = actual._ensure_opt_state(params)
-        key = actual._cache_key(lossfun, args, kwargs) + (self._double_buffering,)
+        if self.zero_sharding:
+            opt_state = self._ensure_zero_opt_state(params)
+        else:
+            opt_state = actual._ensure_opt_state(params)
+        key = actual._cache_key(lossfun, args, kwargs) \
+            + (self._double_buffering, self.zero_sharding)
         step = self._mn_step_cache.get(key)
         if step is None:
-            step = self._make_step(lossfun, args, kwargs)
+            step = (self._make_zero_step(lossfun, args, kwargs)
+                    if self.zero_sharding
+                    else self._make_step(lossfun, args, kwargs))
             self._mn_step_cache[key] = step
 
         if self._double_buffering:
@@ -137,6 +164,101 @@ class _MultiNodeOptimizer:
         actual.t += 1
         reporter_module.report(obs)
         return loss
+
+    # -- ZeRO-1 sharded optimizer state (beyond reference) -----------------
+    def _ensure_zero_opt_state(self, params):
+        """Optimizer state over the PADDED FLAT parameter vector.
+
+        Initialized on the full flat view so the compiled step can split
+        it with an in_spec of ``P(axis)`` — each rank then holds (and
+        updates) exactly its 1/n chunk; the returned state stays sharded
+        across steps.
+        """
+        actual = self.actual_optimizer
+        if actual._opt_state is None:
+            from .communicators._memory_utility import tree_pack
+            flat, spec = tree_pack(params)
+            n = flat.shape[0]
+            size = self.communicator.size
+            n_pad = -(-n // size) * size
+            flat = jnp.pad(flat, (0, n_pad - n))
+            super().__setattr__("_zero_layout", (spec, n, n_pad))
+            actual._opt_state = actual._transform().init(flat)
+        return actual._opt_state
+
+    def _zero_state_spec(self, opt_state, axis):
+        """P(axis) for flat param-length leaves, replicated otherwise
+        (e.g. Adam's step count)."""
+        _, n, n_pad = self._zero_layout
+        return jax.tree.map(
+            lambda leaf: P(axis) if getattr(leaf, "ndim", 0) == 1
+            and leaf.shape[0] == n_pad else P(), opt_state)
+
+    def _make_zero_step(self, lossfun, ex_args, ex_kwargs):
+        from jax import shard_map
+        from .communicators._memory_utility import tree_pack, tree_unpack
+        from .core.optimizer import (apply_transform_update,
+                                     make_loss_and_grad)
+        comm = self.communicator
+        actual = self.actual_optimizer
+        tx = actual._transform()
+        axis = comm.axis_name
+        size = comm.size
+        spec, n, n_pad = self._zero_layout
+        chunk = n_pad // size
+        grad_dtype = comm.allreduce_grad_dtype
+        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
+
+        def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
+                      args, kwargs):
+            del stale  # double buffering is rejected for ZeRO at creation
+            rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
+            with jax.named_scope("zero_forward_backward"):
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_local, args, kwargs)
+            with jax.named_scope("zero_reduce_scatter_grad"):
+                gflat, _ = tree_pack(grads)
+                gflat = jnp.pad(gflat, (0, n_pad - n))
+                if grad_dtype is not None:
+                    gflat = gflat.astype(grad_dtype)
+                # reduce-scatter: each rank receives the SUM of its own
+                # 1/n segment (the reference's allreduce splits into
+                # allreduce = reduce_scatter + all_gather; ZeRO stops
+                # halfway and updates in the scattered domain)
+                gchunk = lax.psum_scatter(gflat, axis, scatter_dimension=0,
+                                          tiled=True)
+                gchunk = gchunk.astype(jnp.float32) / size
+            with jax.named_scope("zero_shard_update"):
+                pflat, _ = tree_pack(params)
+                pflat = jnp.pad(pflat, (0, n_pad - n))
+                pchunk = lax.dynamic_slice_in_dim(
+                    pflat, lax.axis_index(axis) * chunk, chunk)
+                new_pchunk, new_opt_state = apply_transform_update(
+                    tx, gchunk, opt_state, pchunk, hyper["lr"],
+                    hyper.get("decoupled_wd", 0.0))
+            with jax.named_scope("zero_all_gather_params"):
+                new_flat = lax.all_gather(new_pchunk, axis, tiled=True)
+                new_params = tree_unpack(new_flat, spec)
+            loss = lax.pmean(loss, axis)
+            obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
+            new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis),
+                                      new_pstate)
+            # None grads: the full mean gradient never exists under ZeRO
+            return new_params, new_pstate, new_opt_state, loss, None, obs
+
+        args_specs = jax.tree.map(
+            lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
+        kwargs_specs = jax.tree.map(
+            lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
+        opt_specs = self._zero_state_spec(actual._opt_state, axis)
+        mapped = shard_map(
+            rank_step, mesh=comm.mesh,
+            in_specs=(P(), P(), opt_specs, P(), P(), P(), args_specs,
+                      kwargs_specs),
+            out_specs=(P(), P(), opt_specs, P(), P(), P()),
+            check_vma=False)
+        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        return jax.jit(mapped, donate_argnums=donate)
 
     # -- compiled DP step ------------------------------------------------------
     def _batch_spec(self, leaf, axis, size):
@@ -235,6 +357,9 @@ class _MultiNodeOptimizer:
         if self._double_buffering:
             raise RuntimeError("update_scan does not support double "
                                "buffering; use update()")
+        if self.zero_sharding:
+            raise RuntimeError("update_scan does not support zero_sharding "
+                               "yet; use update()")
         actual = self.actual_optimizer
         if actual.target is None:
             raise RuntimeError("setup(link) was not called")

@@ -35,6 +35,13 @@ def main():
     parser.add_argument("--grad-dtype", default="bfloat16")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize ResNet stages (larger batches)")
+    parser.add_argument("--layout", default="NHWC",
+                        choices=["NHWC", "NCHW"],
+                        help="activation layout (NHWC = TPU-native "
+                             "channels-last; resnet50 only)")
+    parser.add_argument("--device-prefetch", type=int, default=2,
+                        help="batches kept resident in HBM ahead of the "
+                             "step (0 disables the device-feed stage)")
     parser.add_argument("--out", "-o", default="result_imagenet")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
@@ -53,9 +60,11 @@ def main():
     comm = ct.create_communicator(args.communicator,
                                   allreduce_grad_dtype=args.grad_dtype)
     archs = {"resnet50": lambda: ResNet50(compute_dtype=jnp.bfloat16,
-                                          remat=args.remat),
+                                          remat=args.remat,
+                                          layout=args.layout),
              "alex": AlexNet, "nin": NIN, "vgg16": VGG16,
              "googlenet": GoogLeNet}
+    nhwc = args.arch == "resnet50" and args.layout == "NHWC"
     model = Classifier(archs[args.arch]())
     comm.bcast_data(model)
     optimizer = ct.create_multi_node_optimizer(
@@ -63,12 +72,32 @@ def main():
     optimizer.add_hook(ct.core.WeightDecay(1e-4))
 
     train = get_synthetic_imagenet(n=args.n_train, size=args.size)
+    if nhwc:
+        from chainermn_tpu.dataset import TransformDataset
+        train = TransformDataset(
+            train, lambda ex: (ex[0].transpose(1, 2, 0), ex[1]))
     train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
     train_iter = MultithreadIterator(train, args.batchsize * comm.size)
+
+    converter = None
+    if args.device_prefetch and not args.fused:
+        # device-feed stage: the next batch's host->device DMA overlaps
+        # this step's compute (FusedUpdater stacks K batches itself, so
+        # per-batch prefetch placement doesn't apply there)
+        from chainermn_tpu.dataset import (DevicePrefetchIterator,
+                                           concat_examples,
+                                           identity_converter)
+        train_iter = DevicePrefetchIterator(
+            train_iter, size=args.device_prefetch,
+            converter=concat_examples)
+        converter = identity_converter
 
     if args.fused:
         from chainermn_tpu.training import FusedUpdater
         updater = FusedUpdater(train_iter, optimizer, n_fused=args.fused)
+    elif converter is not None:
+        updater = StandardUpdater(train_iter, optimizer,
+                                  converter=converter)
     else:
         updater = StandardUpdater(train_iter, optimizer)
     stop = (args.iterations, "iteration") if args.iterations \
