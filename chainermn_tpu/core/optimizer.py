@@ -42,6 +42,14 @@ class _Hook:
     name = "Hook"
     timing = "pre"
 
+    #: Element-wise hooks (each output element depends only on the same
+    #: element of grad/param) may run unchanged on a 1/n chunk of the flat
+    #: gradient under ZeRO.  Hooks computing GLOBAL gradient statistics
+    #: must instead provide ``to_optax_sharded(axis)`` (see
+    #: GradientClipping).  Unmarked hooks are rejected under ZeRO rather
+    #: than silently applied chunk-locally.
+    chunk_local = False
+
     def to_optax(self) -> optax.GradientTransformation:
         raise NotImplementedError
 
@@ -50,6 +58,7 @@ class WeightDecay(_Hook):
     """L2 decay added to gradients (reference: ``optimizer_hooks.WeightDecay``)."""
 
     name = "WeightDecay"
+    chunk_local = True
 
     def __init__(self, rate):
         self.rate = rate
@@ -60,6 +69,7 @@ class WeightDecay(_Hook):
 
 class Lasso(_Hook):
     name = "Lasso"
+    chunk_local = True
 
     def __init__(self, rate):
         self.rate = rate
@@ -106,6 +116,7 @@ class GradientClipping(_Hook):
 
 class GradientHardClipping(_Hook):
     name = "GradientHardClipping"
+    chunk_local = True
 
     def __init__(self, lower_bound, upper_bound):
         self.lower_bound = lower_bound
@@ -122,6 +133,7 @@ class GradientHardClipping(_Hook):
 
 class GradientScaling(_Hook):
     name = "GradientScaling"
+    chunk_local = True
 
     def __init__(self, rate):
         self.rate = rate
@@ -274,12 +286,41 @@ class Optimizer:
         """Subclass: the update rule *excluding* the -lr scaling."""
         raise NotImplementedError
 
-    def _transform(self):
-        if self._tx is None:
-            parts = [h.to_optax() for h in self._hooks.values()]
-            parts.append(self._base_transform())
-            self._tx = optax.chain(*parts)
-        return self._tx
+    def _transform(self, sharded_axis=None):
+        """Hook chain ahead of the base rule (single assembly point).
+
+        ``sharded_axis``: mesh axis name when the transform will run on a
+        1/n chunk of the flat gradient inside shard_map (ZeRO) — hooks
+        needing GLOBAL gradient statistics then use their
+        ``to_optax_sharded(axis)`` variant (element-wise hooks are
+        chunk-local by construction and keep plain ``to_optax``).
+        Sharded chains are not cached: they are built once per compiled
+        step by the multi-node wrapper.
+        """
+        if sharded_axis is None and self._tx is not None:
+            return self._tx
+        parts = [self._hook_transform(h, sharded_axis)
+                 for h in self._hooks.values()]
+        parts.append(self._base_transform())
+        tx = optax.chain(*parts)
+        if sharded_axis is None:
+            self._tx = tx
+        return tx
+
+    @staticmethod
+    def _hook_transform(hook, sharded_axis):
+        if sharded_axis is None:
+            return hook.to_optax()
+        if hasattr(hook, "to_optax_sharded"):
+            return hook.to_optax_sharded(sharded_axis)
+        if getattr(hook, "chunk_local", False):
+            return hook.to_optax()
+        raise ValueError(
+            f"hook {getattr(hook, 'name', hook)!r} cannot run under "
+            f"zero_sharding: it is not marked chunk_local (element-wise) "
+            f"and provides no to_optax_sharded(axis) variant — applying "
+            f"it to a 1/n gradient chunk would silently change semantics "
+            f"if it computes global gradient statistics")
 
     def _hyper_values(self):
         vals = {name: jnp.asarray(getattr(self, name), jnp.float32)

@@ -166,6 +166,14 @@ class _MultiNodeOptimizer:
         return loss
 
     # -- ZeRO-1 sharded optimizer state (beyond reference) -----------------
+    def _zero_transform(self):
+        """Hook chain for the ZeRO step: each rank's transform sees only
+        its 1/n chunk of the flat gradient, so hooks whose semantics need
+        GLOBAL gradient statistics (e.g. ``GradientClipping``'s global L2
+        norm) psum across the axis — see ``Optimizer._transform``."""
+        return self.actual_optimizer._transform(
+            sharded_axis=self.communicator.axis_name)
+
     def _ensure_zero_opt_state(self, params):
         """Optimizer state over the PADDED FLAT parameter vector.
 
@@ -183,7 +191,7 @@ class _MultiNodeOptimizer:
             n_pad = -(-n // size) * size
             flat = jnp.pad(flat, (0, n_pad - n))
             super().__setattr__("_zero_layout", (spec, n, n_pad))
-            actual._opt_state = actual._transform().init(flat)
+            actual._opt_state = self._zero_transform().init(flat)
         return actual._opt_state
 
     def _zero_state_spec(self, opt_state, axis):
@@ -201,7 +209,7 @@ class _MultiNodeOptimizer:
                                      make_loss_and_grad)
         comm = self.communicator
         actual = self.actual_optimizer
-        tx = actual._transform()
+        tx = self._zero_transform()
         axis = comm.axis_name
         size = comm.size
         spec, n, n_pad = self._zero_layout
@@ -471,10 +479,42 @@ class _MultiNodeOptimizer:
 
     def add_hook(self, hook, name=None, timing="pre"):
         self.actual_optimizer.add_hook(hook, name, timing)
+        # _zero_layout's lifetime tracks _opt_state's (which add_hook just
+        # reset): a stale layout would make the serialize pre-seed guard
+        # skip rebuilding the flat template
+        super().__setattr__("_zero_layout", None)
+        self._mn_step_cache.clear()
+
+    def remove_hook(self, name):
+        self.actual_optimizer.remove_hook(name)
+        super().__setattr__("_zero_layout", None)
         self._mn_step_cache.clear()
 
     def serialize(self, serializer):
-        self.actual_optimizer.serialize(serializer)
+        actual = self.actual_optimizer
+        if self.zero_sharding and not serializer.is_writer \
+                and actual.target is not None and self._zero_layout is None:
+            # The saved opt_state leaves are flat (n_pad,) vectors.  The
+            # base reader builds its template from the CURRENT _opt_state
+            # — or, when None, from the default per-param tree, whose leaf
+            # count/shapes mismatch the flat save.  Pre-seed the flat
+            # sharded template + _zero_layout before delegating.  Guarded
+            # on _zero_layout is None: a warm ZeRO process already holds a
+            # valid flat template (and must NOT be reset — a snapshot
+            # without opt_state keys would otherwise silently zero trained
+            # state); a layout-less process either has no state or a
+            # per-param tree from pre-wrapper use, both safely rebuilt.
+            params = extract_state(actual.target)["params"]
+            if not params or any(v is None for v in params.values()):
+                # lazily-initialized model: take shapes from the snapshot
+                # (idempotent — the delegated serialize re-reads this
+                # section)
+                actual.target.serialize(serializer["target"])
+                params = extract_state(actual.target)["params"]
+            if params and all(v is not None for v in params.values()):
+                actual._opt_state = None
+                self._ensure_zero_opt_state(params)
+        actual.serialize(serializer)
 
 
 class _DoubleBufferingOptimizer(_MultiNodeOptimizer):

@@ -25,12 +25,14 @@ def _data(seed=0, n=16, d=12, k=3):
     return jnp.asarray(x), jnp.asarray(t)
 
 
-def _run(zero, opt_cls, steps=4, **opt_kw):
+def _run(zero, opt_cls, steps=4, hooks=(), **opt_kw):
     comm = ct.create_communicator("jax_ici")
     model = Classifier(MLP(n_units=16, n_out=3, seed=0))
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(
         opt_cls(**opt_kw), comm, zero_sharding=zero).setup(model)
+    for hook in hooks:
+        opt.add_hook(hook)
     x, t = _data()
     losses = [float(opt.update(model, x, t)) for _ in range(steps)]
     params = [np.asarray(p.array) for p in model.params()]
@@ -44,6 +46,23 @@ def _run(zero, opt_cls, steps=4, **opt_kw):
 def test_zero_matches_plain_dp(opt_cls, kw):
     losses_z, params_z, _ = _run(True, opt_cls, **kw)
     losses_p, params_p, _ = _run(False, opt_cls, **kw)
+    np.testing.assert_allclose(losses_z, losses_p, rtol=1e-5, atol=1e-7)
+    for a, b in zip(params_z, params_p):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_zero_matches_plain_dp_with_gradient_clipping():
+    """GradientClipping under ZeRO must clip by the GLOBAL norm (psum of
+    per-chunk squared norms), not this rank's 1/n chunk norm — a
+    chunk-local clip is off by up to sqrt(n) and silently diverges the
+    trajectory.  Threshold chosen low enough that the clip engages from
+    step one (MLP grads at init here have norm ~O(1))."""
+    from chainermn_tpu.core.optimizer import GradientClipping
+    hooks = (GradientClipping(0.05),)
+    losses_z, params_z, _ = _run(True, MomentumSGD, hooks=hooks, lr=0.1,
+                                 momentum=0.9)
+    losses_p, params_p, _ = _run(False, MomentumSGD, hooks=hooks, lr=0.1,
+                                 momentum=0.9)
     np.testing.assert_allclose(losses_z, losses_p, rtol=1e-5, atol=1e-7)
     for a, b in zip(params_z, params_p):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
@@ -91,6 +110,106 @@ def test_zero_rejects_double_buffering_and_scan():
     ts = jnp.broadcast_to(t, (2,) + t.shape)
     with pytest.raises(RuntimeError, match="zero_sharding"):
         opt.update_scan(model, xs, ts)
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (MomentumSGD, dict(lr=0.1, momentum=0.9)),
+    (Adam, dict(alpha=1e-2)),
+])
+def test_zero_serialize_resume_roundtrip(tmp_path, opt_cls, kw):
+    """Save mid-training, resume in a FRESH optimizer/model, continue:
+    the resumed run must bit-exactly track the uninterrupted one.  The
+    saved opt_state is the flat sharded vector — the resume path must
+    rebuild the flat template + _zero_layout before leaf placement (a
+    per-param template would silently mis-restore via leaf mismatch)."""
+    from chainermn_tpu.serializers import save_npz, load_npz
+
+    def fresh():
+        comm = ct.create_communicator("jax_ici")
+        model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            opt_cls(**kw), comm, zero_sharding=True).setup(model)
+        opt.seed = 7
+        return model, opt
+
+    x, t = _data(seed=5)
+    model_a, opt_a = fresh()
+    for _ in range(3):
+        opt_a.update(model_a, x, t)
+    path = str(tmp_path / "zero_opt.npz")
+    save_npz(path, opt_a)
+
+    # uninterrupted continuation
+    for _ in range(2):
+        opt_a.update(model_a, x, t)
+
+    # fresh-process resume: no prior update() — _zero_layout is None and
+    # params come from the snapshot
+    model_b, opt_b = fresh()
+    load_npz(path, opt_b)
+    assert opt_b.t == 3
+    for _ in range(2):
+        opt_b.update(model_b, x, t)
+
+    for (na, pa), (nb, pb) in zip(model_a.namedparams(),
+                                  model_b.namedparams()):
+        assert na == nb
+        np.testing.assert_array_equal(np.asarray(pa.array),
+                                      np.asarray(pb.array),
+                                      err_msg=f"param {na} diverged after "
+                                              f"ZeRO resume")
+
+
+def test_zero_warm_load_without_saved_state_keeps_state(tmp_path):
+    """Loading a snapshot that carries NO opt_state keys (saved before
+    the first update) into a WARM ZeRO optimizer must preserve the
+    trained flat state — matching the non-ZeRO reader's semantics — not
+    reset it to fresh init."""
+    from chainermn_tpu.serializers import save_npz, load_npz
+    comm = ct.create_communicator("jax_ici")
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm,
+        zero_sharding=True).setup(model)
+    path = str(tmp_path / "pre_update.npz")
+    save_npz(path, opt)  # t=0: no opt_state_* keys in the file
+    x, t = _data()
+    for _ in range(3):
+        opt.update(model, x, t)
+    before = [np.asarray(l) for l in
+              jax.tree.leaves(opt.actual_optimizer._opt_state)]
+    load_npz(path, opt)
+    after = [np.asarray(l) for l in
+             jax.tree.leaves(opt.actual_optimizer._opt_state)]
+    assert len(before) == len(after)
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_rejects_unmarked_global_hook():
+    """A hook that neither declares chunk_local nor provides
+    to_optax_sharded must be rejected under ZeRO — applying a
+    global-statistic hook to a 1/n chunk silently changes semantics."""
+    import optax
+    from chainermn_tpu.core.optimizer import _Hook
+
+    class CustomGlobalHook(_Hook):
+        name = "CustomGlobalHook"
+
+        def to_optax(self):
+            return optax.identity()
+
+    comm = ct.create_communicator("jax_ici")
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1), comm, zero_sharding=True).setup(model)
+    opt.add_hook(CustomGlobalHook())
+    x, t = _data()
+    with pytest.raises(ValueError, match="chunk_local"):
+        opt.update(model, x, t)
 
 
 def test_zero_grad_not_populated_documented_contract():
