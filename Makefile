@@ -13,7 +13,12 @@ bench:            ## real-hardware benchmark (one JSON line)
 	$(PY) bench.py
 
 bench-smoke:      ## CPU smoke of the bench mechanics
-	BENCH_BS=2 BENCH_SIZE=64 BENCH_STEPS=2 $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import bench; bench.main()"
+	# JAX_PLATFORMS reaches the child via bench.py's own config.update
+	# (the env var alone is ignored by the axon sitecustomize);
+	# BENCH_NO_SUPERVISE skips the re-exec so no un-pinned child ever
+	# dials the wedge-prone relay.  CPU results are never persisted to
+	# the last-good cache (bench.py `_cacheable`).
+	JAX_PLATFORMS=cpu BENCH_NO_SUPERVISE=1 BENCH_BS=2 BENCH_SIZE=64 BENCH_STEPS=2 $(PY) bench.py
 
 # Populates the persistent XLA compile cache + last-good result cache on
 # the real chip so the driver's end-of-round bench hits a warm cache.

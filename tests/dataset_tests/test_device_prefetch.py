@@ -75,6 +75,37 @@ def test_resume_is_bit_exact_despite_prefetch_depth():
         np.testing.assert_array_equal(a, b)
 
 
+def test_multithread_base_stream_and_resume():
+    """DevicePrefetchIterator stacked over a MultithreadIterator base
+    (prefetch-thread + device-feed, the full input pipeline): stream
+    matches the serial order and mid-stream resume stays exact."""
+    from chainermn_tpu.dataset import MultithreadIterator
+    data = _dataset(24)
+
+    def build():
+        return DevicePrefetchIterator(
+            MultithreadIterator(data, 4, shuffle=True, seed=3), size=2,
+            converter=concat_examples)
+
+    it = build()
+    ref = SerialIterator(data, 4, shuffle=True, seed=3)
+    for _ in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(it.next()[1]),
+            np.asarray(concat_examples(ref.next())[1]))
+    s = DictionarySerializer()
+    it.serialize(s)
+    cont = [np.asarray(it.next()[1]) for _ in range(4)]
+    it.finalize()
+
+    it2 = build()
+    it2.serialize(NpzDeserializer(s.target))
+    resumed = [np.asarray(it2.next()[1]) for _ in range(4)]
+    it2.finalize()
+    for a, b in zip(cont, resumed):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_non_repeating_drains():
     data = _dataset(8)
     pref = DevicePrefetchIterator(

@@ -1,0 +1,119 @@
+"""Artifact-integrity tests for the bench harness's last-good cache.
+
+Round-3 postmortem (VERDICT r3 Missing #1): a 32×32/bs-2 CPU smoke run
+persisted by a harness test was re-emitted under the headline
+``resnet50_imagenet_train_throughput`` metric when the TPU relay wedged.
+The cache is now gated by a config fingerprint on BOTH ends: persistence
+(``_emit``) and stale re-emission (``_emit_stale_or_error``).
+
+Pure host-side logic — no jax import, no device touch.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+TPU_RESULT = {
+    "metric": "resnet50_imagenet_train_throughput",
+    "value": 2022.0, "unit": "images/sec/chip", "vs_baseline": 8.99,
+    "platform": "axon", "device_kind": "TPU v5 lite", "n_devices": 1,
+    "per_chip_batch": 256, "image_size": 224, "layout": "NHWC",
+    "compile_s": 109.0,
+}
+
+CPU_SMOKE = {
+    "metric": "resnet50_imagenet_train_throughput",
+    "value": 3.33, "unit": "images/sec/chip", "vs_baseline": 0.015,
+    "platform": "cpu", "device_kind": "cpu", "n_devices": 1,
+    "per_chip_batch": 2, "image_size": 32, "layout": "NHWC",
+    "compile_s": 5.9,
+}
+
+
+@pytest.fixture
+def cache_path(tmp_path, monkeypatch):
+    path = str(tmp_path / "last_bench.json")
+    monkeypatch.setattr(bench, "_CACHE_PATH", path)
+    return path
+
+
+def _last_line(capsys):
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_cacheable_accepts_only_default_config_accelerator_runs():
+    assert bench._cacheable(TPU_RESULT)
+    assert not bench._cacheable(CPU_SMOKE)
+    assert not bench._cacheable({**TPU_RESULT, "platform": "cpu"})
+    assert not bench._cacheable({**TPU_RESULT, "platform": "cpu_fallback"})
+    assert not bench._cacheable({**TPU_RESULT, "image_size": 32})
+    assert not bench._cacheable({**TPU_RESULT, "per_chip_batch": 2})
+    assert not bench._cacheable({**TPU_RESULT, "value": None})
+    assert not bench._cacheable({**TPU_RESULT, "stale": True})
+    assert not bench._cacheable({**TPU_RESULT, "error": "boom"})
+
+
+def test_cacheable_transformer_needs_real_seq_len():
+    base = {"metric": "transformer_lm_train_throughput", "value": 1e5,
+            "platform": "axon", "seq_len": 1024}
+    assert bench._cacheable(base)
+    assert not bench._cacheable({**base, "seq_len": 64})
+    assert not bench._cacheable({**base, "platform": "cpu"})
+
+
+def test_emit_persists_only_cacheable(cache_path, capsys):
+    bench._emit(CPU_SMOKE)
+    with pytest.raises(FileNotFoundError):
+        open(cache_path)
+    bench._emit(TPU_RESULT)
+    with open(cache_path) as f:
+        saved = json.load(f)
+    assert saved["result"]["value"] == TPU_RESULT["value"]
+    capsys.readouterr()
+
+
+def test_stale_reemit_refuses_poisoned_cache(cache_path, capsys,
+                                             monkeypatch):
+    """A cpu-smoke payload planted in the cache file (the round-3
+    failure) must NOT be re-served — value:null + the error instead."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    with open(cache_path, "w") as f:
+        json.dump({"run_id": "old", "saved_at": 0.0,
+                   "result": CPU_SMOKE}, f)
+    bench._emit_stale_or_error("deadline exceeded before first result")
+    out = _last_line(capsys)
+    assert out["value"] is None
+    assert "deadline" in out["error"]
+    assert out["metric"] == "resnet50_imagenet_train_throughput"
+
+
+def test_stale_reemit_serves_real_tpu_datum(cache_path, capsys,
+                                            monkeypatch):
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    with open(cache_path, "w") as f:
+        json.dump({"run_id": "earlier-run", "saved_at": 0.0,
+                   "result": TPU_RESULT}, f)
+    bench._emit_stale_or_error("relay wedged")
+    out = _last_line(capsys)
+    assert out["value"] == TPU_RESULT["value"]
+    assert out["stale"] is True
+    assert out["platform"] == "axon"
+    assert out["error"] == "relay wedged"
+
+
+def test_stale_reemit_never_repersists(cache_path, capsys, monkeypatch):
+    """Re-emission must not refresh the cache file (stale results would
+    otherwise look newer on every failure)."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    with open(cache_path, "w") as f:
+        json.dump({"run_id": "earlier-run", "saved_at": 123.0,
+                   "result": TPU_RESULT}, f)
+    bench._emit_stale_or_error("still wedged")
+    with open(cache_path) as f:
+        assert json.load(f)["saved_at"] == 123.0
+    capsys.readouterr()

@@ -1,0 +1,46 @@
+"""The xplane.pb walker in tools/profile_tpu_step.py must be known-good
+BEFORE chip time depends on it (VERDICT r3 Weak #2): capture a real
+2-step CPU trace in-suite and assert the summary yields nonempty op
+rows.  Exercises jax.profiler.trace output end-to-end through the
+hand-rolled protobuf varint walker — parser bitrot fails here, not on
+the one chance at the chip.
+"""
+
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import profile_tpu_step  # noqa: E402
+
+
+def test_summarize_parses_real_trace(tmp_path, capsys):
+    @jax.jit
+    def step(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((256, 256), jnp.float32)
+    float(step(x))  # compile outside the trace window
+    out_dir = str(tmp_path / "trace")
+    with jax.profiler.trace(out_dir):
+        for _ in range(2):
+            loss = step(x)
+        float(loss)
+
+    profile_tpu_step.summarize(out_dir)
+    out = capsys.readouterr().out
+    assert "plane:" in out, f"no plane found in summary output:\n{out}"
+    # at least one per-op row:  "<ms> ms  <pct>%  <op name>"
+    rows = re.findall(r"^\s+[\d.]+ ms\s+[\d.]+%\s+\S+", out, re.M)
+    assert rows, f"no op rows parsed from trace:\n{out}"
+
+
+def test_summarize_empty_dir_reports_cleanly(tmp_path, capsys):
+    profile_tpu_step.summarize(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "no xplane.pb" in out
