@@ -13,6 +13,7 @@ Output: one JSON line per device count + a summary line.
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -119,6 +120,103 @@ def project_efficiency(step_ms, n_chips, grad_mb=51.1, ici_gbps=100.0,
     return t_1 / t_n
 
 
+def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps):
+    """One process of the REAL cross-process compiled DP step (the same
+    path as ``tests/multiprocess_tests/_worker.py · run_dp_step``): gloo
+    CPU backend, 1 device per process, the whole DP step one shard_mapped
+    jit whose gradient pmean crosses actual process boundaries.  Times
+    the steady-state step; rank 0 prints the row."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from chainermn_tpu.communicators._communication_utility import (
+        initialize_distributed)
+    assert initialize_distributed(f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+    import jax.numpy as jnp
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.models import MLP, Classifier
+
+    comm = ct.create_communicator("jax_ici")
+    assert comm.size == nprocs == jax.device_count()
+    model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.01, momentum=0.9), comm).setup(model)
+
+    gbs = per_rank_bs * nprocs
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.normal(0, 1, (gbs, 64)).astype(np.float32))
+    t = np.asarray(rng.randint(0, 10, gbs).astype(np.int32))
+
+    for _ in range(3):  # trace+compile, then steady-state warmup
+        loss = opt.update(model, x, t)
+    float(loss)
+    if nprocs > 1:
+        comm._host_channel().barrier()
+    start = time.perf_counter()
+    for _ in range(steps):
+        loss = opt.update(model, x, t)
+    float(loss)  # the collective step is lock-step across processes
+    dt = time.perf_counter() - start
+    if pid == 0:
+        n_params = sum(int(np.prod(p.array.shape))
+                       for p in model.params())
+        print(json.dumps({
+            "processes": nprocs, "per_rank_bs": per_rank_bs,
+            "grad_payload_mb": round(n_params * 4 / 1e6, 2),
+            "step_ms": round(dt / steps * 1e3, 3),
+            "examples_per_sec": round(steps * gbs / dt, 1)}), flush=True)
+
+
+def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps):
+    """Launch each P-process measurement and report per-hop overhead:
+    step_ms(P) - step_ms(1) is the cost the framework adds per step when
+    the SAME compiled program's gradient mean must cross P real process
+    boundaries (gloo over localhost — an upper bound on framework
+    overhead; ICI on a pod is faster than loopback gloo)."""
+    import socket
+    import subprocess
+    import sys
+    rows = []
+    for nprocs in proc_counts:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--gloo-worker", str(pid), str(nprocs), str(port),
+             str(per_rank_bs), str(hidden), str(steps)],
+            stdout=subprocess.PIPE, text=True) for pid in range(nprocs)]
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), \
+            [(p.returncode, o) for p, o in zip(procs, outs)]
+        row = json.loads([ln for ln in outs[0].splitlines()
+                          if ln.startswith("{")][-1])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base = rows[0]["step_ms"]
+    n_cores = os.cpu_count() or 1
+    for row in rows[1:]:
+        p = row["processes"]
+        # With fewer cores than processes the P workers' compute
+        # time-slices one core, so the raw delta over the 1-proc step is
+        # mostly contention; the serialized-compute baseline
+        # (ceil(P/cores) × 1-proc step) isolates the transport/dispatch
+        # overhead the framework actually adds per process boundary.
+        serial_ms = -(-p // n_cores) * base
+        print(json.dumps({
+            "processes": p, "n_cores": n_cores,
+            "per_hop_overhead_raw_ms": round(row["step_ms"] - base, 3),
+            "overhead_vs_serialized_compute_ms": round(
+                row["step_ms"] - serial_ms, 3),
+            "scaling_efficiency_vs_1proc": round(
+                base / row["step_ms"], 4)}), flush=True)
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--per-chip-bs", type=int, default=8)
@@ -134,7 +232,25 @@ def main():
                              "measured single-chip step time (--step-ms)")
     parser.add_argument("--step-ms", type=float, default=None,
                         help="measured single-chip step time for --project")
+    parser.add_argument("--gloo-procs", default=None,
+                        help="comma list, e.g. 1,2,4: measure the REAL "
+                             "cross-process compiled DP step at each "
+                             "process count (gloo CPU backend)")
+    parser.add_argument("--gloo-worker", nargs=6, default=None,
+                        help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--gloo-hidden", type=int, default=512,
+                        help="MLP hidden width for --gloo-procs")
     args = parser.parse_args()
+
+    if args.gloo_worker:
+        pid, nprocs, port, bs, hidden, steps = map(int, args.gloo_worker)
+        _gloo_worker(pid, nprocs, port, bs, hidden, steps)
+        return
+    if args.gloo_procs:
+        counts = [int(c) for c in args.gloo_procs.split(",")]
+        _run_gloo_curve(counts, args.per_chip_bs, args.gloo_hidden,
+                        args.steps)
+        return
 
     if args.project:
         if args.step_ms is None:
