@@ -1,0 +1,32 @@
+#!/bin/bash
+# On-chip measurement queue, run ONCE when the relay recovers.
+# Every bench.py invocation has its own no-jax supervisor + deadline and
+# emits stale/error lines instead of hanging; profile runs go last so a
+# wedge there cannot block the benches. Nothing here kills a TPU process.
+cd /root/repo
+LOG=/root/repo/tpu_recovery_run.log
+exec >> "$LOG" 2>&1
+echo "=== TPU recovery queue started $(date -u) ==="
+export PYTHONPATH=/root/repo:$PYTHONPATH
+
+echo "--- prewarm (warms XLA cache + seeds last-good cache) ---"
+BENCH_STEPS=4 BENCH_DEADLINE_S=900 python bench.py
+echo "--- resnet bs64 NHWC ---"
+BENCH_DEADLINE_S=600 BENCH_TRIALS=3 python bench.py
+echo "--- resnet bs256 NHWC ---"
+BENCH_BS=256 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
+echo "--- resnet bs256 NCHW (layout comparison) ---"
+BENCH_BS=256 BENCH_LAYOUT=NCHW BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
+echo "--- resnet bs256 NHWC scan8 (fused dispatch) ---"
+BENCH_BS=256 BENCH_SCAN=8 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
+echo "--- transformer bs8 seq1024 ---"
+BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
+echo "--- transformer bs2 seq8192 remat ---"
+BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
+echo "--- flash vs xla attention T=2048/8192 ---"
+PROBE=flashcmp python tools/probe_perf.py || true
+echo "--- profile resnet NHWC bs64 (unsupervised: may wedge; keep last) ---"
+python tools/profile_tpu_step.py --layout NHWC --bs 64 --steps 8
+echo "--- profile resnet NCHW bs64 ---"
+python tools/profile_tpu_step.py --layout NCHW --bs 64 --steps 8
+echo "=== TPU recovery queue done $(date -u) ==="
