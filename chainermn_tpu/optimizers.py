@@ -202,37 +202,28 @@ class _MultiNodeOptimizer:
             lambda leaf: P(axis) if getattr(leaf, "ndim", 0) == 1
             and leaf.shape[0] == n_pad else P(), opt_state)
 
-    def _make_zero_step(self, lossfun, ex_args, ex_kwargs):
-        from jax import shard_map
+    def _make_zero_update(self):
+        """Shared ZeRO core (per-step AND scan step makers): flat-pack
+        grads → reduce-scatter (each rank receives the SUM of its own
+        1/n segment — the reference's allreduce splits into
+        reduce_scatter + all_gather; ZeRO stops halfway and updates in
+        the scattered domain) → chunk update → all-gather → unpack."""
         from .communicators._memory_utility import tree_pack, tree_unpack
-        from .core.optimizer import (apply_transform_update,
-                                     make_loss_and_grad)
+        from .core.optimizer import apply_transform_update
         comm = self.communicator
-        actual = self.actual_optimizer
         tx = self._zero_transform()
         axis = comm.axis_name
         size = comm.size
         spec, n, n_pad = self._zero_layout
         chunk = n_pad // size
         grad_dtype = comm.allreduce_grad_dtype
-        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
-        def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
-                      args, kwargs):
-            del stale  # double buffering is rejected for ZeRO at creation
-            rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
-            with jax.named_scope("zero_forward_backward"):
-                loss, new_pstate, obs, grads = loss_and_grad(
-                    params, pstate, rng_local, args, kwargs)
+        def zero_update(params, grads, opt_state, hyper):
             with jax.named_scope("zero_reduce_scatter_grad"):
                 gflat, _ = tree_pack(grads)
                 gflat = jnp.pad(gflat, (0, n_pad - n))
                 if grad_dtype is not None:
                     gflat = gflat.astype(grad_dtype)
-                # reduce-scatter: each rank receives the SUM of its own
-                # 1/n segment (the reference's allreduce splits into
-                # allreduce = reduce_scatter + all_gather; ZeRO stops
-                # halfway and updates in the scattered domain)
                 gchunk = lax.psum_scatter(gflat, axis, scatter_dimension=0,
                                           tiled=True)
                 gchunk = gchunk.astype(jnp.float32) / size
@@ -247,6 +238,29 @@ class _MultiNodeOptimizer:
             with jax.named_scope("zero_all_gather_params"):
                 new_flat = lax.all_gather(new_pchunk, axis, tiled=True)
                 new_params = tree_unpack(new_flat, spec)
+            return new_params, new_opt_state
+
+        return zero_update
+
+    def _make_zero_step(self, lossfun, ex_args, ex_kwargs):
+        from jax import shard_map
+        from .core.optimizer import make_loss_and_grad
+        comm = self.communicator
+        actual = self.actual_optimizer
+        axis = comm.axis_name
+        size = comm.size
+        zero_update = self._make_zero_update()
+        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
+
+        def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
+                      args, kwargs):
+            del stale  # double buffering is rejected for ZeRO at creation
+            rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
+            with jax.named_scope("zero_forward_backward"):
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_local, args, kwargs)
+            new_params, new_opt_state = zero_update(params, grads,
+                                                    opt_state, hyper)
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
             new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis),
@@ -269,6 +283,16 @@ class _MultiNodeOptimizer:
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- compiled DP step ------------------------------------------------------
+    @staticmethod
+    def _scan_batch_spec(leaf, axis, size):
+        """update_scan leaves: leading axis = step axis (replicated),
+        axis 1 = global batch (split across ranks)."""
+        if leaf.shape[1] % size == 0 and leaf.shape[1] > 0:
+            return P(None, axis)
+        raise ValueError(
+            f"update_scan leaf with batch dim {leaf.shape[1]} is not "
+            f"divisible by communicator size {size}")
+
     def _batch_spec(self, leaf, axis, size):
         """Batch-sharding heuristic: leaves with a leading dim divisible by
         ``size`` are split across ranks; scalars are replicated; anything
@@ -357,6 +381,10 @@ class _MultiNodeOptimizer:
         change *within* the K steps needs plain ``update`` calls.
         Double buffering is not supported here (one-step staleness
         inside a fused scan would reorder its observable semantics).
+        ``zero_sharding`` composes: the scan carries one gathered
+        params buffer plus the sharded flat optimizer state, each
+        iteration running the full reduce-scatter → chunk update →
+        all-gather step (``_make_zero_scan_step``).
         RNG streams differ from the per-step ``update()`` path (one
         dispatch key with the step index folded in, vs a fresh host key
         per step), so stochastic layers (dropout) are numerically equal
@@ -365,9 +393,6 @@ class _MultiNodeOptimizer:
         if self._double_buffering:
             raise RuntimeError("update_scan does not support double "
                                "buffering; use update()")
-        if self.zero_sharding:
-            raise RuntimeError("update_scan does not support zero_sharding "
-                               "yet; use update()")
         actual = self.actual_optimizer
         if actual.target is None:
             raise RuntimeError("setup(link) was not called")
@@ -393,11 +418,17 @@ class _MultiNodeOptimizer:
             self.communicator.verify_step_signature((args, kwargs))
         state = extract_state(actual.target)
         params, pstate = state["params"], state["state"]
-        opt_state = actual._ensure_opt_state(params)
-        key = ("scan", n_steps) + actual._cache_key(lossfun, args, kwargs)
+        if self.zero_sharding:
+            opt_state = self._ensure_zero_opt_state(params)
+        else:
+            opt_state = actual._ensure_opt_state(params)
+        key = ("scan", n_steps, self.zero_sharding) \
+            + actual._cache_key(lossfun, args, kwargs)
         step = self._mn_step_cache.get(key)
         if step is None:
-            step = self._make_scan_step(lossfun, args, kwargs, n_steps)
+            step = (self._make_zero_scan_step(lossfun, args, kwargs, n_steps)
+                    if self.zero_sharding
+                    else self._make_scan_step(lossfun, args, kwargs, n_steps))
             self._mn_step_cache[key] = step
         new_params, new_pstate, new_opt_state, losses, grads, obs = step(
             params, pstate, opt_state, actual._hyper_values(),
@@ -455,20 +486,67 @@ class _MultiNodeOptimizer:
                 lambda o: lax.pmean(jnp.mean(o, axis=0), axis), all_obs)
             return params, pstate, opt_state, losses, last_grads, obs
 
-        def batch_spec(leaf):
-            # leading axis = step axis (replicated); axis 1 = global batch
-            if leaf.shape[1] % size == 0 and leaf.shape[1] > 0:
-                return P(None, axis)
-            raise ValueError(
-                f"update_scan leaf with batch dim {leaf.shape[1]} is not "
-                f"divisible by communicator size {size}")
-
-        args_specs = jax.tree.map(batch_spec, ex_args)
-        kwargs_specs = jax.tree.map(batch_spec, ex_kwargs)
+        args_specs = jax.tree.map(
+            lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_args)
+        kwargs_specs = jax.tree.map(
+            lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_kwargs)
         mapped = shard_map(
             rank_scan, mesh=comm.mesh,
             in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
+            check_vma=False)
+        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        return jax.jit(mapped, donate_argnums=donate)
+
+    def _make_zero_scan_step(self, lossfun, ex_args, ex_kwargs, n_steps):
+        """ZeRO-1 × fused K-step dispatch: the scan carries the gathered
+        params (ONE buffer, exactly as per-step ZeRO keeps one gathered
+        copy live) plus the sharded flat opt state; each scan iteration
+        is the full reduce-scatter → chunk update → all-gather step."""
+        from jax import shard_map
+        from .core.optimizer import make_loss_and_grad
+        comm = self.communicator
+        actual = self.actual_optimizer
+        axis = comm.axis_name
+        size = comm.size
+        zero_update = self._make_zero_update()
+        loss_and_grad = make_loss_and_grad(actual.target, lossfun)
+
+        def rank_scan(params, pstate, opt_state, hyper, rng_key, args,
+                      kwargs):
+            rng_rank = jax.random.fold_in(rng_key, lax.axis_index(axis))
+
+            def one_step(carry, xs):
+                params, pstate, opt_state, i = carry
+                s_args, s_kwargs = xs
+                rng_i = jax.random.fold_in(rng_rank, i)
+                loss, new_pstate, obs, grads = loss_and_grad(
+                    params, pstate, rng_i, s_args, s_kwargs)
+                new_params, new_opt_state = zero_update(params, grads,
+                                                        opt_state, hyper)
+                return ((new_params, new_pstate, new_opt_state, i + 1),
+                        (loss, obs))
+
+            (params, pstate, opt_state, _), (losses, all_obs) = lax.scan(
+                one_step, (params, pstate, opt_state, jnp.int32(0)),
+                (args, kwargs))
+            losses = lax.pmean(losses, axis)
+            pstate = jax.tree.map(lambda s: lax.pmean(s, axis), pstate)
+            obs = jax.tree.map(
+                lambda o: lax.pmean(jnp.mean(o, axis=0), axis), all_obs)
+            # None grads: the full mean gradient never exists under ZeRO
+            return params, pstate, opt_state, losses, None, obs
+
+        args_specs = jax.tree.map(
+            lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_args)
+        kwargs_specs = jax.tree.map(
+            lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_kwargs)
+        opt_specs = self._zero_state_spec(actual._opt_state, axis)
+        mapped = shard_map(
+            rank_scan, mesh=comm.mesh,
+            in_specs=(P(), P(), opt_specs, P(), P(), args_specs,
+                      kwargs_specs),
+            out_specs=(P(), P(), opt_specs, P(), P(), P()),
             check_vma=False)
         donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
         return jax.jit(mapped, donate_argnums=donate)

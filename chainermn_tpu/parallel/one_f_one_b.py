@@ -45,6 +45,13 @@ def heterogeneous_stage_fn(stage_fns, axis_name):
     boundaries (``ppermute`` requires it).  Truly heterogeneous
     graphs — different shapes or parameter structures per stage —
     belong to ``MultiNodeChainList`` (reference semantics, SURVEY §3.3).
+
+    Trace cost: the tick loop is a ``lax.scan``, so the ``lax.switch``
+    body — and with it all ``S`` branches — is traced ONCE (plus once
+    for its VJP), independent of tick count: O(S) traced stage bodies
+    total.  Run time executes one branch per tick per device.  The cost
+    of heterogeneity is therefore program SIZE linear in S, not a
+    quadratic compile blow-up.
     """
     def stage_fn(params, h):
         branches = [lambda p, hh, f=f: f(p, hh) for f in stage_fns]

@@ -96,20 +96,44 @@ def test_zero_with_bf16_grad_compression():
     assert np.isfinite(l) and l < l0
 
 
-def test_zero_rejects_double_buffering_and_scan():
+def test_zero_rejects_double_buffering():
     comm = ct.create_communicator("jax_ici")
     with pytest.raises(ValueError, match="zero_sharding"):
         ct.create_multi_node_optimizer(MomentumSGD(lr=0.1), comm,
                                        double_buffering=True,
                                        zero_sharding=True)
-    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
-    opt = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.1), comm, zero_sharding=True).setup(model)
-    x, t = _data()
-    xs = jnp.broadcast_to(x, (2,) + x.shape)
-    ts = jnp.broadcast_to(t, (2,) + t.shape)
-    with pytest.raises(RuntimeError, match="zero_sharding"):
-        opt.update_scan(model, xs, ts)
+
+
+def test_zero_update_scan_matches_plain_scan():
+    """ZeRO × fused K-step dispatch: the zero scan computes the same
+    trajectory as the plain-DP scan (deterministic model), and the
+    carried opt state stays the sharded flat vector."""
+    K = 3
+
+    def run(zero):
+        comm = ct.create_communicator("jax_ici")
+        model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1, momentum=0.9), comm,
+            zero_sharding=zero).setup(model)
+        rng = np.random.RandomState(4)
+        xs = jnp.asarray(rng.normal(0, 1, (K, 16, 12)).astype(np.float32))
+        ts = jnp.asarray(rng.randint(0, 3, (K, 16)).astype(np.int32))
+        losses = np.asarray(opt.update_scan(model, xs, ts))
+        params = [np.asarray(p.array) for p in model.params()]
+        return losses, params, opt
+
+    losses_z, params_z, opt_z = run(True)
+    losses_p, params_p, _ = run(False)
+    np.testing.assert_allclose(losses_z, losses_p, rtol=1e-5, atol=1e-7)
+    for a, b in zip(params_z, params_p):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    n_devices = len(jax.devices())
+    flat = [l for l in jax.tree.leaves(opt_z.actual_optimizer._opt_state)
+            if getattr(l, "ndim", 0) == 1 and l.shape[0] > 1]
+    assert flat and all(len(l.addressable_shards) == n_devices
+                        for l in flat)
 
 
 @pytest.mark.parametrize("opt_cls,kw", [
