@@ -287,6 +287,35 @@ def test_fused_updater_equals_standard(tmp_path, mnist_small):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_fused_updater_with_zero_sharding(tmp_path, mnist_small):
+    """ZeRO-1 under the FusedUpdater (update_scan path): same weights as
+    the plain-DP FusedUpdater over the same batch stream."""
+    from chainermn_tpu.training import FusedUpdater
+    train, _ = mnist_small
+    comm = ct.create_communicator("jax_ici")
+
+    def run(zero):
+        model = Classifier(MLP())
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            SGD(lr=0.05), comm, zero_sharding=zero).setup(model)
+        it = SerialIterator(train, 64, seed=0)
+        upd = FusedUpdater(it, opt, n_fused=2)
+        trainer = Trainer(upd, (4, "iteration"),
+                          out=str(tmp_path / ("z" if zero else "p")))
+        trainer.run()
+        assert upd.iteration == 4
+        return model
+
+    m_zero = run(True)
+    m_plain = run(False)
+    for (_, p1), (_, p2) in zip(m_zero.namedparams(),
+                                m_plain.namedparams()):
+        np.testing.assert_allclose(np.asarray(p1.array),
+                                   np.asarray(p2.array),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_fused_updater_logreport_matches_unfused(tmp_path, mnist_small):
     """Observation parity (VERDICT r2 Weak #7): update_scan reports the
     MEAN observation over its K fused steps, so a LogReport window

@@ -94,6 +94,20 @@ def test_two_process_compiled_dp_step(tmp_path):
             assert f"PASS {name}" in out, (name, out[-4000:])
 
 
+def test_two_process_zero_step(tmp_path):
+    """ZeRO-1 across real process boundaries: psum_scatter/all_gather
+    over the 2-process gloo mesh, per-process 1/n optimizer-state
+    chunks, sharded global-norm clipping, golden-equal trajectory."""
+    outs = _launch("zero_step", 2, tmp_path)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
+        assert "ALL_OK" in out, out[-4000:]
+    for name in ("zero_step_runs", "zero_state_sharded_across_processes",
+                 "zero_loss_matches_golden", "zero_params_consistent"):
+        for rc, out in outs:
+            assert f"PASS {name}" in out, (name, out[-4000:])
+
+
 @pytest.mark.slow
 def test_two_process_multidevice_topology(tmp_path):
     """2 controllers × 4 devices each: intra/inter topology and
