@@ -202,6 +202,40 @@ def apply_transform_update(tx, grads, opt_state, params, lr, decoupled_wd=0.0):
     return optax.apply_updates(params, updates), new_opt_state
 
 
+def serialize_flat_tree(serializer, tree, count_key, leaf_prefix):
+    """Write a pytree as ``count_key`` + one array per flattened leaf."""
+    flat, _ = jax.tree.flatten(tree)
+    serializer(count_key, len(flat))
+    for i, leaf in enumerate(flat):
+        serializer(f"{leaf_prefix}{i}", np.asarray(leaf))
+
+
+def deserialize_flat_tree(serializer, template, count_key, leaf_prefix):
+    """Read a pytree written by :func:`serialize_flat_tree` onto
+    ``template``'s structure.  Returns ``None`` when the snapshot has no
+    ``count_key`` (pre-feature or partial snapshot).  Leaves beyond the
+    saved count — or missing under a non-strict reader — keep the
+    template's value, so a leaf-count mismatch degrades to a partial
+    restore instead of a ``tree.unflatten`` crash."""
+    try:
+        n = serializer(count_key, None)
+    except KeyError:
+        return None
+    if n is None:
+        return None
+    flat, treedef = jax.tree.flatten(template)
+    new = []
+    for i, leaf in enumerate(flat):
+        data = None
+        if i < int(n):
+            try:
+                data = serializer(f"{leaf_prefix}{i}", None)
+            except KeyError:
+                data = None
+        new.append(jnp.asarray(data) if data is not None else leaf)
+    return jax.tree.unflatten(treedef, new)
+
+
 class _LRUCache(OrderedDict):
     """Bounded compiled-step cache.
 
@@ -473,28 +507,27 @@ class Optimizer:
                                                        dtype=np.uint32))
         if serializer.is_writer:
             if self._opt_state is not None:
-                flat, treedef = jax.tree.flatten(self._opt_state)
-                serializer("opt_state_len", len(flat))
-                for i, leaf in enumerate(flat):
-                    serializer(f"opt_state_{i}", np.asarray(leaf))
-        else:
-            try:
-                n = serializer("opt_state_len", None)
-            except KeyError:  # snapshot saved before the first update()
-                n = None
-            if n is not None and self.target is not None:
-                # template for leaf placement: an existing state (e.g. the
-                # ZeRO wrapper pre-seeds its flat-sharded template before
-                # delegating here) wins over the default per-param tree
-                if self._opt_state is None:
+                serialize_flat_tree(serializer, self._opt_state,
+                                    "opt_state_len", "opt_state_")
+        elif self.target is not None:
+            # template for leaf placement: an existing state (e.g. the
+            # ZeRO wrapper pre-seeds its flat-sharded template before
+            # delegating here) wins over the default per-param tree,
+            # which is built only if the snapshot actually carries state
+            template = self._opt_state
+            if template is None:
+                try:
+                    has_state = serializer("opt_state_len", None) is not None
+                except KeyError:  # snapshot saved before the first update()
+                    has_state = False
+                if has_state:
                     params = extract_state(self.target)["params"]
-                    self._opt_state = self._transform().init(params)
-                flat, treedef = jax.tree.flatten(self._opt_state)
-                new_flat = []
-                for i, leaf in enumerate(flat[: int(n)]):
-                    data = serializer(f"opt_state_{i}", None)
-                    new_flat.append(jnp.asarray(data) if data is not None else leaf)
-                self._opt_state = jax.tree.unflatten(treedef, new_flat)
+                    template = self._transform().init(params)
+            if template is not None:
+                restored = deserialize_flat_tree(
+                    serializer, template, "opt_state_len", "opt_state_")
+                if restored is not None:
+                    self._opt_state = restored
 
 
 class GradientMethod(Optimizer):

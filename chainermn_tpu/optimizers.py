@@ -593,6 +593,29 @@ class _MultiNodeOptimizer:
                 actual._opt_state = None
                 self._ensure_zero_opt_state(params)
         actual.serialize(serializer)
+        if self._double_buffering:
+            # the one-step-stale gradient buffer is OBSERVABLE state:
+            # without it a resumed run applies zeros on its first update
+            # (fresh-start semantics) instead of the saved step's grads,
+            # breaking bit-exact resume
+            from .core.optimizer import (deserialize_flat_tree,
+                                         serialize_flat_tree)
+            sub = serializer["stale_grads"]
+            if serializer.is_writer:
+                if self._stale_grads is not None:
+                    serialize_flat_tree(sub, self._stale_grads, "n", "g")
+                return
+            if actual.target is None:
+                return  # target-less load: base serialize skipped too
+            params = extract_state(actual.target)["params"]
+            if not params or any(v is None for v in params.values()):
+                super().__setattr__("_stale_grads", None)
+                return
+            template = jax.tree.map(jnp.zeros_like, params)
+            restored = deserialize_flat_tree(sub, template, "n", "g")
+            # None restored = snapshot predates stale-grad saving (or was
+            # taken before the first update): fresh zero-seed semantics
+            super().__setattr__("_stale_grads", restored)
 
 
 class _DoubleBufferingOptimizer(_MultiNodeOptimizer):

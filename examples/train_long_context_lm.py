@@ -23,8 +23,10 @@ def main():
     parser.add_argument("--n-layers", type=int, default=2)
     parser.add_argument("--vocab", type=int, default=256)
     parser.add_argument("--steps", type=int, default=10)
-    parser.add_argument("--sp-mode", choices=["ring", "ulysses"],
-                        default="ring")
+    parser.add_argument("--sp-mode", choices=["ring", "zigzag", "ulysses"],
+                        default="ring",
+                        help="'zigzag' = causally balanced ring schedule "
+                             "(inputs are zigzag-sharded along T)")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
     args = parser.parse_args()
@@ -58,6 +60,15 @@ def main():
                                 (args.batchsize, args.seq_len))
                     .astype(np.int32))
     t = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+    if args.sp_mode == "zigzag":
+        # the balanced schedule works on the two-half-chunk layout; the
+        # model supplies matching position ids (TransformerLM docstring)
+        from chainermn_tpu.parallel import zigzag_shard
+        if args.seq_len % (2 * comm.size):
+            raise SystemExit(f"--seq-len must be divisible by "
+                             f"{2 * comm.size} for zigzag")
+        x = zigzag_shard(x, comm.size, axis=1)
+        t = zigzag_shard(t, comm.size, axis=1)
 
     def step(params, pstate, x, t):
         def loss_fn(p):

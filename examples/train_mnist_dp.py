@@ -57,6 +57,10 @@ def main():
                         help="force JAX platform (e.g. 'cpu' to use the "
                              "simulated multi-device mesh)")
     parser.add_argument("--simulate-devices", type=int, default=0)
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1: shard optimizer state over the DP "
+                             "axis (reduce-scatter grads, 1/n-chunk "
+                             "update, all-gather params)")
     args = parser.parse_args()
 
     if args.simulate_devices:
@@ -70,7 +74,8 @@ def main():
     model = Classifier(MLP(args.unit, 10))
     comm.bcast_data(model)
 
-    optimizer = ct.create_multi_node_optimizer(Adam(), comm).setup(model)
+    optimizer = ct.create_multi_node_optimizer(
+        Adam(), comm, zero_sharding=args.zero).setup(model)
 
     train, test = get_mnist()
     train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)

@@ -122,6 +122,44 @@ def test_double_buffering_converges():
     assert losses[-1] < losses[0]
 
 
+def test_double_buffering_resume_bit_exact(tmp_path):
+    """The one-step-stale gradient buffer is part of the observable
+    state: save mid-training, resume in a fresh process, continue — the
+    resumed trajectory bit-matches the uninterrupted one (without
+    serializing _stale_grads the first post-resume update would apply
+    zeros, i.e. silently restart the staleness pipeline)."""
+    from chainermn_tpu.serializers import save_npz, load_npz
+    x, t = _batch(64)
+
+    def fresh():
+        model = Classifier(MLP())
+        comm = ct.create_communicator("pure_nccl")
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            SGD(lr=0.1), comm, double_buffering=True).setup(model)
+        return model, opt
+
+    model_a, opt_a = fresh()
+    for _ in range(3):
+        opt_a.update(model_a, x, t)
+    path = str(tmp_path / "db.npz")
+    save_npz(path, opt_a)
+    for _ in range(2):
+        opt_a.update(model_a, x, t)
+
+    model_b, opt_b = fresh()
+    load_npz(path, opt_b)
+    for _ in range(2):
+        opt_b.update(model_b, x, t)
+
+    for (na, pa), (nb, pb) in zip(model_a.namedparams(),
+                                  model_b.namedparams()):
+        np.testing.assert_array_equal(np.asarray(pa.array),
+                                      np.asarray(pb.array),
+                                      err_msg=f"{na} diverged after "
+                                              f"double-buffered resume")
+
+
 def test_mnist_dp_end_to_end(tmp_path):
     """Full trainer pipeline: scatter → bcast → DP optimizer → evaluator."""
     comm = ct.create_communicator("jax_ici")
