@@ -45,6 +45,15 @@ def main():
     parser.add_argument("--out", "-o", default="result_imagenet")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
+    parser.add_argument("--mnbn", action="store_true",
+                        help="rewrite BatchNormalization links to the "
+                             "multi-node (sync) variant — the reference "
+                             "recipe for small per-device batches, where "
+                             "local BN statistics degenerate")
+    parser.add_argument("--lr", type=float, default=None,
+                        help="initial lr (default: 0.1 for resnet50, "
+                             "whose BN tames it; 0.01 for the BN-less "
+                             "archs per the reference recipes)")
     parser.add_argument("--fused", type=int, default=0,
                         help="fuse K optimizer steps per dispatch "
                              "(FusedUpdater/update_scan; 0 = per-step)")
@@ -66,9 +75,13 @@ def main():
              "googlenet": GoogLeNet}
     nhwc = args.arch == "resnet50" and args.layout == "NHWC"
     model = Classifier(archs[args.arch]())
+    if args.mnbn:
+        model = ct.links.create_mnbn_model(model, comm)
     comm.bcast_data(model)
+    lr = args.lr if args.lr is not None \
+        else (0.1 if args.arch == "resnet50" else 0.01)
     optimizer = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+        MomentumSGD(lr=lr, momentum=0.9), comm).setup(model)
     optimizer.add_hook(ct.core.WeightDecay(1e-4))
 
     train = get_synthetic_imagenet(n=args.n_train, size=args.size)
