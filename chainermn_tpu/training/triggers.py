@@ -52,6 +52,11 @@ class OnceTrigger:
         self._flag_resumed = False
         return fire
 
+    def serialize(self, serializer):
+        # reference parity: a resumed OnceTrigger must not re-fire unless
+        # constructed with call_on_resume (which stays untouched here)
+        self._flag_first = bool(serializer("flag_first", self._flag_first))
+
 
 class _BestValueTrigger:
     def __init__(self, key, compare, trigger=(1, "epoch")):
@@ -73,6 +78,27 @@ class _BestValueTrigger:
             self._best = value
             return True
         return False
+
+    def serialize(self, serializer):
+        """Best value + in-window summary + interval position: without
+        these a resumed Max/MinValueTrigger forgets its best and re-fires
+        on a WORSE value (e.g. re-saving a 'best' snapshot over a better
+        model)."""
+        if hasattr(self._interval, "serialize"):
+            self._interval.serialize(serializer["interval"])
+        if serializer.is_writer:
+            # explicit has-best flag: NaN is a legitimate latched best
+            # (a diverged metric window), not an "unset" sentinel
+            serializer("has_best", self._best is not None)
+            serializer("best", 0.0 if self._best is None else self._best)
+            serializer("summary", np.asarray(self._summary, np.float64))
+            return
+        has_best = bool(serializer("has_best", self._best is not None))
+        best = float(serializer("best", 0.0))
+        self._best = best if has_best else None
+        summary = serializer("summary", None)
+        self._summary = [] if summary is None \
+            else [float(v) for v in np.asarray(summary).ravel()]
 
 
 class MaxValueTrigger(_BestValueTrigger):

@@ -1,0 +1,142 @@
+"""Triggers (reference: ``chainer.training.triggers``): firing semantics
+and — the part resumes depend on — serialization of trigger STATE
+(IntervalTrigger position, OnceTrigger flag, best-value memory).
+"""
+
+import numpy as np
+
+from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                           NpzDeserializer)
+from chainermn_tpu.training.triggers import (IntervalTrigger,
+                                             MaxValueTrigger,
+                                             MinValueTrigger, OnceTrigger)
+
+
+class _FakeUpdater:
+    def __init__(self):
+        self.iteration = 0
+        self.epoch_detail = 0.0
+
+
+class _FakeTrainer:
+    def __init__(self):
+        self.updater = _FakeUpdater()
+        self.observation = {}
+
+    def step(self, obs=None):
+        self.updater.iteration += 1
+        self.updater.epoch_detail = self.updater.iteration / 4.0
+        self.observation = obs or {}
+
+
+def _roundtrip(trigger, build):
+    s = DictionarySerializer()
+    trigger.serialize(s)
+    fresh = build()
+    fresh.serialize(NpzDeserializer(s.target))
+    return fresh
+
+
+def test_interval_trigger_fires_on_period():
+    tr = _FakeTrainer()
+    trig = IntervalTrigger(3, "iteration")
+    fires = []
+    for _ in range(9):
+        tr.step()
+        fires.append(trig(tr))
+    assert fires == [False, False, True] * 3
+
+
+def test_once_trigger_fires_once_and_not_after_resume():
+    tr = _FakeTrainer()
+    trig = OnceTrigger()
+    assert trig(tr) is True
+    assert trig(tr) is False
+    resumed = _roundtrip(trig, OnceTrigger)
+    assert resumed(tr) is False  # already fired before the snapshot
+
+
+def test_once_trigger_call_on_resume():
+    trig = OnceTrigger(call_on_resume=True)
+    tr = _FakeTrainer()
+    assert trig(tr) is True
+    assert trig(tr) is False
+    resumed = _roundtrip(trig, lambda: OnceTrigger(call_on_resume=True))
+    assert resumed(tr) is True  # explicit opt-in re-fires after resume
+
+
+def test_max_value_trigger_fires_on_improvement():
+    tr = _FakeTrainer()
+    trig = MaxValueTrigger("acc", trigger=(1, "iteration"))
+    fires = []
+    for v in (0.1, 0.5, 0.3, 0.7):
+        tr.step({"acc": v})
+        fires.append(trig(tr))
+    assert fires == [True, True, False, True]
+
+
+def test_best_value_trigger_resume_keeps_best():
+    """A resumed MaxValueTrigger must remember its best: forgetting it
+    would re-fire on a WORSE value (e.g. overwrite a 'best' snapshot
+    with a worse model)."""
+    tr = _FakeTrainer()
+    trig = MaxValueTrigger("acc", trigger=(1, "iteration"))
+    tr.step({"acc": 0.9})
+    assert trig(tr) is True  # best = 0.9
+
+    resumed = _roundtrip(
+        trig, lambda: MaxValueTrigger("acc", trigger=(1, "iteration")))
+    tr.step({"acc": 0.5})
+    assert resumed(tr) is False  # worse than the remembered best
+    tr.step({"acc": 0.95})
+    assert resumed(tr) is True
+
+
+def test_min_value_trigger_resume_keeps_best():
+    tr = _FakeTrainer()
+    trig = MinValueTrigger("loss", trigger=(1, "iteration"))
+    tr.step({"loss": 0.2})
+    assert trig(tr) is True
+    resumed = _roundtrip(
+        trig, lambda: MinValueTrigger("loss", trigger=(1, "iteration")))
+    tr.step({"loss": 0.4})
+    assert resumed(tr) is False
+    tr.step({"loss": 0.1})
+    assert resumed(tr) is True
+
+
+def test_best_value_trigger_resume_preserves_nan_latch():
+    """A NaN best (diverged metric window) is a LATCHED state — NaN
+    comparisons are always False, so the trigger never fires again.
+    Resume must preserve that, not re-arm the trigger (which would
+    overwrite a 'best' snapshot unconditionally)."""
+    tr = _FakeTrainer()
+    trig = MaxValueTrigger("acc", trigger=(1, "iteration"))
+    tr.step({"acc": float("nan")})
+    assert trig(tr) is True  # first window always fires; best = NaN
+    tr.step({"acc": 0.9})
+    assert trig(tr) is False  # latched: NaN comparisons are False
+
+    resumed = _roundtrip(
+        trig, lambda: MaxValueTrigger("acc", trigger=(1, "iteration")))
+    tr.step({"acc": 0.9})
+    assert resumed(tr) is False  # still latched after resume
+
+
+def test_best_value_trigger_resume_keeps_summary_window():
+    """Mid-window observations (accumulated but not yet compared) must
+    survive a snapshot: the epoch-trigger mean after resume equals the
+    uninterrupted one."""
+    def build():
+        return MaxValueTrigger("acc", trigger=(2, "iteration"))
+
+    tr = _FakeTrainer()
+    trig = build()
+    tr.step({"acc": 1.0})
+    assert trig(tr) is False  # window open: summary holds [1.0]
+    resumed = _roundtrip(trig, build)
+    tr.step({"acc": 0.0})
+    # mean over the FULL window [1.0, 0.0] = 0.5; a dropped summary
+    # would compare mean([0.0]) = 0.0
+    assert resumed(tr) is True
+    assert resumed._best == 0.5
