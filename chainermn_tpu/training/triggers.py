@@ -93,10 +93,15 @@ class _BestValueTrigger:
             serializer("best", 0.0 if self._best is None else self._best)
             serializer("summary", np.asarray(self._summary, np.float64))
             return
+        # defaults are the CURRENT field values (IntervalTrigger's
+        # pattern): a non-strict load from a snapshot lacking these keys
+        # leaves the live trigger untouched instead of wiping its best
         has_best = bool(serializer("has_best", self._best is not None))
-        best = float(serializer("best", 0.0))
-        self._best = best if has_best else None
-        summary = serializer("summary", None)
+        best = serializer("best",
+                          0.0 if self._best is None else self._best)
+        self._best = float(best) if has_best and best is not None else None
+        summary = serializer("summary",
+                             np.asarray(self._summary, np.float64))
         self._summary = [] if summary is None \
             else [float(v) for v in np.asarray(summary).ravel()]
 

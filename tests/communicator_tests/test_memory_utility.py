@@ -39,6 +39,50 @@ def test_pack_unpack_params_grads():
     np.testing.assert_allclose(np.asarray(ps[1].grad), 6.0)
 
 
+def test_tree_pack_roundtrip_randomized_structures():
+    """Property sweep over the structures ZeRO flattens: random nesting,
+    shapes (incl. 0-d and empty), mixed dtypes — pack→unpack is the
+    identity on values, shapes, dtypes, and tree structure."""
+    import jax
+    import numpy as np
+    from chainermn_tpu.communicators._memory_utility import (tree_pack,
+                                                             tree_unpack)
+    rng = np.random.RandomState(0)
+    dtypes = [np.float32, np.float16, np.int32]
+    for case in range(20):
+        n_leaves = rng.randint(1, 7)
+        leaves = {}
+        for i in range(n_leaves):
+            nd = rng.randint(0, 4)
+            shape = tuple(int(s) for s in rng.randint(0, 5, nd))
+            dt = dtypes[rng.randint(len(dtypes))]
+            arr = (rng.randint(-100, 100, shape).astype(dt)
+                   if dt == np.int32
+                   else rng.normal(0, 1, shape).astype(dt))
+            # random nesting: half the leaves go under a sub-dict
+            if i % 2:
+                leaves.setdefault("sub", {})[f"l{i}"] = jnp.asarray(arr)
+            else:
+                leaves[f"l{i}"] = jnp.asarray(arr)
+        flat, spec = tree_pack(leaves)
+        assert flat.ndim == 1
+        assert flat.shape[0] == sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(leaves))
+        out = tree_unpack(flat, spec)
+        assert jax.tree.structure(out) == jax.tree.structure(leaves)
+        for a, b in zip(jax.tree.leaves(leaves), jax.tree.leaves(out)):
+            assert a.shape == b.shape and a.dtype == b.dtype, case
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tree_pack_empty_tree():
+    from chainermn_tpu.communicators._memory_utility import (tree_pack,
+                                                             tree_unpack)
+    flat, spec = tree_pack({})
+    assert flat.shape == (0,)
+    assert tree_unpack(flat, spec) == {}
+
+
 def test_orthogonal_initializer():
     from chainermn_tpu.nn.initializers import Orthogonal
     W = Orthogonal()((6, 6), np.float32, np.random.RandomState(0))

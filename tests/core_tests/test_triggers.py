@@ -123,6 +123,26 @@ def test_best_value_trigger_resume_preserves_nan_latch():
     assert resumed(tr) is False  # still latched after resume
 
 
+def test_best_value_trigger_nonstrict_load_preserves_live_state():
+    """A non-strict load from a snapshot LACKING the trigger keys (any
+    pre-upgrade snapshot) must leave the live trigger untouched — not
+    wipe its remembered best to 0.0 and clear the summary window."""
+    tr = _FakeTrainer()
+    trig = MaxValueTrigger("acc", trigger=(2, "iteration"))
+    tr.step({"acc": 0.9})
+    assert trig(tr) is False  # summary open: [0.9]
+    tr.step({"acc": 0.9})
+    assert trig(tr) is True   # best = 0.9
+    tr.step({"acc": 0.7})
+    assert trig(tr) is False  # summary open: [0.7]
+
+    trig.serialize(NpzDeserializer({}, strict=False))
+    assert trig._best == 0.9
+    assert trig._summary == [0.7]
+    tr.step({"acc": 0.8})
+    assert trig(tr) is False  # mean(0.7, 0.8) = 0.75 < 0.9
+
+
 def test_best_value_trigger_resume_keeps_summary_window():
     """Mid-window observations (accumulated but not yet compared) must
     survive a snapshot: the epoch-trigger mean after resume equals the

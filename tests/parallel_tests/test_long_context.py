@@ -305,3 +305,40 @@ def test_ring_causal_unequal_lengths_rejected():
         COMM.run_spmd(
             lambda q, k, v: ring_self_attention(COMM, q, k, v, causal=True),
             q, k, k, in_specs=(spec, spec, spec), out_specs=spec)
+
+
+def test_ring_attention_randomized_geometry_sweep():
+    """Property sweep: random (B, H, T, D) × causal × schedule, distributed
+    output == dense reference on the gathered sequence.  Catches
+    geometry-dependent masking/merge bugs the fixed-shape tests miss."""
+    from chainermn_tpu.parallel import zigzag_shard, zigzag_unshard
+    rng = np.random.RandomState(7)
+    n = COMM.size
+    for case in range(6):
+        B = int(rng.randint(1, 3))
+        H = int(rng.randint(1, 4))
+        D = int(2 ** rng.randint(2, 5))
+        t_mult = int(rng.randint(1, 4))
+        causal = bool(case % 2)
+        T = 2 * n * t_mult  # divisible for both layouts
+        q, k, v = (rng.normal(0, 1, (B, H, T, D)).astype(np.float32)
+                   for _ in range(3))
+        ref = _full_reference(q, k, v, causal)
+        # zigzag applies to every causal case: 3 distinct zigzag
+        # geometries per sweep, alongside naive for both causal modes
+        schedules = ("naive", "zigzag") if causal else ("naive",)
+        for schedule in schedules:
+            if schedule == "zigzag":
+                qs, ks, vs = (zigzag_shard(jnp.asarray(a), n)
+                              for a in (q, k, v))
+            else:
+                qs, ks, vs = (jnp.asarray(a) for a in (q, k, v))
+            out = _run(lambda a, b, c: ring_self_attention(
+                COMM, a, b, c, causal=causal, schedule=schedule),
+                qs, ks, vs)
+            if schedule == "zigzag":
+                out = zigzag_unshard(out, n)
+            np.testing.assert_allclose(
+                np.asarray(out), ref, rtol=2e-4, atol=2e-5,
+                err_msg=f"case={case} B={B} H={H} T={T} D={D} "
+                        f"causal={causal} schedule={schedule}")
