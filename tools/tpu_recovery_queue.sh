@@ -25,6 +25,17 @@ echo "--- transformer bs2 seq8192 remat ---"
 BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
 echo "--- flash vs xla attention T=2048/8192 ---"
 PROBE=flashcmp python tools/probe_perf.py || true
+
+# Fold the JSON result lines into BENCH_NOTES so the round records the
+# on-chip numbers even if nobody is awake to do it manually.
+{
+  echo ""
+  echo "## Round-4 on-chip results (auto-recorded by tpu_recovery_queue at $(date -u))"
+  echo ""
+  echo '```'
+  grep '^{' "$LOG" | tail -20
+  echo '```'
+} >> BENCH_NOTES.md
 echo "--- profile resnet NHWC bs64 (unsupervised: may wedge; keep last) ---"
 python tools/profile_tpu_step.py --layout NHWC --bs 64 --steps 8
 echo "--- profile resnet NCHW bs64 ---"
