@@ -704,7 +704,9 @@ def fmod(x, divisor):
 
 
 def fix(x):
-    return jnp.fix(x)
+    # jnp.fix is deprecated (removed in jax 0.10); trunc is identical
+    # (round toward zero)
+    return jnp.trunc(x)
 
 
 def relu6(x):
