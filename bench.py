@@ -63,7 +63,8 @@ DEFAULT_BS = 64
 DEFAULT_SIZE = 224
 DEFAULT_SEQ = 1024
 
-_CACHE_PATH = "/tmp/chainermn_tpu_last_bench.json"
+_CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
+                             "/tmp/chainermn_tpu_last_bench.json")
 _START = time.monotonic()
 _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "270"))
 
@@ -451,6 +452,15 @@ def _child_main():
     internal alarm fires 45 s before the hard deadline so this process
     can emit a stale/error line itself; the supervisor is the backstop
     for wedged C calls the alarm can't interrupt."""
+    if os.environ.get("BENCH_TEST_WEDGE") == "1":
+        # fault injection (tests/test_bench_harness.py): simulate the
+        # known failure mode — a child stuck in an uninterruptible call
+        # before any output.  SIGTERM is IGNORED (a thread wedged in a C
+        # call never runs the handler), so the supervisor's
+        # terminate→kill escalation is what the test exercises.
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        while True:
+            time.sleep(3600)
     def on_alarm(signum, frame):
         raise BenchDeadline("internal deadline "
                             f"({_DEADLINE_S - margin:.0f}s) exceeded")
