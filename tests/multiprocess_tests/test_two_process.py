@@ -109,6 +109,21 @@ def test_two_process_zero_step(tmp_path):
 
 
 @pytest.mark.slow
+def test_four_process_split_groups(tmp_path):
+    """MPI_Comm_Split across REAL process boundaries: 4 gloo processes,
+    colors [0,0,1,1] → two live 2-process sub-communicators, each
+    running its own compiled DP step with group-isolated collectives."""
+    outs = _launch("split_groups", 4, tmp_path, timeout=360)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
+        assert "ALL_OK" in out, out[-4000:]
+    for name in ("split_two_process_subgroups", "subgroup_dp_step_runs",
+                 "subgroup_matches_own_golden", "split_groups_isolated"):
+        for rc, out in outs:
+            assert f"PASS {name}" in out, (name, out[-4000:])
+
+
+@pytest.mark.slow
 def test_two_process_multidevice_topology(tmp_path):
     """2 controllers × 4 devices each: intra/inter topology and
     device-rank-weighted object collectives on a host layout the

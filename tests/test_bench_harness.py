@@ -120,6 +120,7 @@ def test_stale_reemit_never_repersists(cache_path, capsys, monkeypatch):
     capsys.readouterr()
 
 
+@pytest.mark.slow
 def test_supervisor_emits_error_line_when_child_wedges(tmp_path):
     """The core driver contract (VERDICT r2 Missing #1): a child wedged
     before ANY output AND ignoring SIGTERM (a thread stuck in a C call
