@@ -177,9 +177,19 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps):
     the SAME compiled program's gradient mean must cross P real process
     boundaries (gloo over localhost — an upper bound on framework
     overhead; ICI on a pod is faster than loopback gloo)."""
+    import re
     import socket
     import subprocess
     import sys
+    # 1 device per process is the measurement's contract: a leaked
+    # simulated-mesh flag (tests/conftest.py exports
+    # --xla_force_host_platform_device_count into the environment) would
+    # give every worker N devices and break the topology assert
+    env = dict(os.environ)
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "",
+            env["XLA_FLAGS"])
     rows = []
     for nprocs in proc_counts:
         with socket.socket() as s:
@@ -189,8 +199,17 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps):
             [sys.executable, os.path.abspath(__file__),
              "--gloo-worker", str(pid), str(nprocs), str(port),
              str(per_rank_bs), str(hidden), str(steps)],
-            stdout=subprocess.PIPE, text=True) for pid in range(nprocs)]
-        outs = [p.communicate(timeout=600)[0] for p in procs]
+            env=env, stdout=subprocess.PIPE, text=True)
+            for pid in range(nprocs)]
+        try:
+            outs = [p.communicate(timeout=600)[0] for p in procs]
+        finally:
+            # a wedged worker (dead peer in the gloo barrier) must not
+            # outlive the measurement: kill stragglers before raising
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
         assert all(p.returncode == 0 for p in procs), \
             [(p.returncode, o) for p, o in zip(procs, outs)]
         row = json.loads([ln for ln in outs[0].splitlines()
