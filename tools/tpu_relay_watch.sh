@@ -1,0 +1,38 @@
+#!/bin/bash
+# TPU relay recovery watcher — run from a NO-JAX shell (nohup ok).
+#
+# Relay discipline (project memory, BENCH_NOTES r1): never kill a
+# process mid-TPU-operation — a hard kill wedges the relay for hours.
+# This loop therefore (a) keeps at most ONE probe outstanding, (b) never
+# kills anything — a wedged probe is left alone (it may complete when
+# the relay heals and will write the sentinel itself), and (c) lives
+# entirely in bash so the watcher itself cannot wedge.
+#
+# On recovery it runs tools/tpu_recovery_queue.sh (prewarm + the full
+# on-chip measurement battery) and exits.
+PROBE=/tmp/tpu_probe.py
+SENTINEL=/tmp/tpu_probe_last.json
+cat > "$PROBE" <<'PYEOF'
+import time, json
+t0 = time.time()
+import jax
+devs = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+v = float((x @ x).sum())
+print(json.dumps({"platform": jax.default_backend(),
+                  "device_kind": devs[0].device_kind, "n": len(devs),
+                  "init_s": round(time.time() - t0, 1), "val": v}),
+      flush=True)
+PYEOF
+while true; do
+  if grep -q '"platform"' "$SENTINEL" 2>/dev/null; then
+    echo "TPU BACK at $(date -u): $(cat "$SENTINEL")"
+    "$(dirname "$0")/tpu_recovery_queue.sh"
+    exit 0
+  fi
+  if ! pgrep -f "python $PROBE" > /dev/null; then
+    (python "$PROBE" > "$SENTINEL" 2>/tmp/tpu_probe_last.err &)
+  fi
+  sleep 300
+done
