@@ -108,6 +108,15 @@ class _MultiNodeOptimizer:
 
     def setup(self, link):
         self.actual_optimizer.setup(link)
+        # setup() resets the wrapped optimizer's _opt_state; every piece
+        # of wrapper state whose lifetime tracks _opt_state (the ZeRO
+        # flat-layout, compiled-step cache, double-buffer slot) must
+        # reset with it — otherwise a later deserialize sees a stale
+        # _zero_layout, skips the flat-template pre-seed, and restores
+        # the saved flat chunks onto mismatched per-param slots.
+        super().__setattr__("_zero_layout", None)
+        super().__setattr__("_stale_grads", None)
+        self._mn_step_cache.clear()
         return self
 
     # -- update -------------------------------------------------------------

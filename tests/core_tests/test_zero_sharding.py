@@ -185,6 +185,53 @@ def test_zero_serialize_resume_roundtrip(tmp_path, opt_cls, kw):
                                               f"ZeRO resume")
 
 
+def test_zero_resetup_then_load_restores_correctly(tmp_path):
+    """Re-running setup() on a WARM ZeRO optimizer (e.g. rebinding the
+    model before a resume) resets the wrapped optimizer's _opt_state —
+    the wrapper's _zero_layout must reset with it.  A stale layout made
+    the deserialize guard skip the flat-template pre-seed: the base
+    reader then built a per-param template and placed the saved flat
+    chunks onto mismatched slots (corrupted state), and the next
+    update() crashed unpacking the layout."""
+    from chainermn_tpu.serializers import save_npz, load_npz
+
+    def fresh():
+        comm = ct.create_communicator("jax_ici")
+        model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1, momentum=0.9), comm,
+            zero_sharding=True).setup(model)
+        return model, opt
+
+    x, t = _data(seed=9)
+    model_a, opt_a = fresh()
+    for _ in range(3):
+        opt_a.update(model_a, x, t)
+    path = str(tmp_path / "zero_resetup.npz")
+    save_npz(path, opt_a)
+    for _ in range(2):
+        opt_a.update(model_a, x, t)
+
+    # warm optimizer, then setup() again before loading the snapshot
+    model_b, opt_b = fresh()
+    for _ in range(4):  # warm: _zero_layout/_opt_state populated
+        opt_b.update(model_b, x, t)
+    opt_b.setup(model_b)  # resets _opt_state — layout must reset too
+    load_npz(path, opt_b)
+    assert opt_b.t == 3
+    for _ in range(2):
+        opt_b.update(model_b, x, t)
+
+    for (na, pa), (nb, pb) in zip(model_a.namedparams(),
+                                  model_b.namedparams()):
+        assert na == nb
+        np.testing.assert_array_equal(np.asarray(pa.array),
+                                      np.asarray(pb.array),
+                                      err_msg=f"param {na} diverged after "
+                                              f"re-setup ZeRO resume")
+
+
 def test_zero_warm_load_without_saved_state_keeps_state(tmp_path):
     """Loading a snapshot that carries NO opt_state keys (saved before
     the first update) into a WARM ZeRO optimizer must preserve the

@@ -273,10 +273,11 @@ def run_dp_step(pid, nprocs):
 
 
 def _dp_golden_check(comm, seed=0, steps=3, lr=0.1, momentum=0.9,
-                     hooks=()):
-    """Shared DP-step scaffold: train a Classifier(MLP) under ``comm``,
-    assert losses match the single-process full-batch golden, and return
-    (model, losses, per-param digests) for scenario-specific asserts."""
+                     hooks=(), zero_sharding=False):
+    """Shared DP-step scaffold: train a Classifier(MLP) under ``comm``
+    (optionally with ZeRO-1 sharded optimizer state), assert losses AND
+    params match the single-process full-batch golden, and return
+    (model, opt, losses, per-param digests) for scenario asserts."""
     import numpy as np
 
     import chainermn_tpu as ct
@@ -294,7 +295,8 @@ def _dp_golden_check(comm, seed=0, steps=3, lr=0.1, momentum=0.9,
         else:
             comm_.bcast_data(model)
             opt = ct.create_multi_node_optimizer(
-                MomentumSGD(lr=lr, momentum=momentum), comm_).setup(model)
+                MomentumSGD(lr=lr, momentum=momentum), comm_,
+                zero_sharding=zero_sharding).setup(model)
         for hook in hooks:
             opt.add_hook(hook)
         return model, opt
@@ -309,36 +311,28 @@ def _dp_golden_check(comm, seed=0, steps=3, lr=0.1, momentum=0.9,
                                    np.asarray(gp.array),
                                    rtol=1e-4, atol=1e-6)
     digest = [np.asarray(p.array).tobytes() for p in model.params()]
-    return model, losses, digest
+    return model, opt, losses, digest
 
 
 def run_zero_step(pid, nprocs):
     """ZeRO-1 across REAL process boundaries: psum_scatter + all_gather
     span the gloo processes; each process's optimizer state is only its
     own 1/n chunk; trajectory matches the single-process full-batch
-    golden (the same contract run_dp_step certifies for plain DP)."""
-    import numpy as np
+    golden — the same `_dp_golden_check` scaffold run_dp_step and
+    run_split_groups certify with, plus the sharded global-norm
+    clipping hook."""
     import jax
 
     import chainermn_tpu as ct
-    from chainermn_tpu.core.optimizer import GradientClipping, MomentumSGD
-    from chainermn_tpu.models import MLP, Classifier
+    from chainermn_tpu.core.optimizer import GradientClipping
 
     comm = ct.create_communicator("jax_ici")
     assert comm.size == nprocs == jax.device_count()
 
-    rng = np.random.RandomState(0)
-    x = rng.normal(0, 1, (8, 12)).astype(np.float32)
-    t = rng.randint(0, 3, 8).astype(np.int32)
-
-    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
-    comm.bcast_data(model)
-    opt = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.1, momentum=0.9), comm,
-        zero_sharding=True).setup(model)
-    opt.add_hook(GradientClipping(0.05))  # sharded global-norm path
-    losses = [float(opt.update(model, x, t)) for _ in range(3)]
+    model, opt, losses, digest = _dp_golden_check(
+        comm, hooks=(GradientClipping(0.05),), zero_sharding=True)
     _ok("zero_step_runs")
+    _ok("zero_loss_matches_golden")
 
     # state is sharded: this process holds exactly 1/n of the flat vector
     flat = [l for l in jax.tree.leaves(opt.actual_optimizer._opt_state)
@@ -350,18 +344,6 @@ def run_zero_step(pid, nprocs):
             == leaf.shape[0] // nprocs
     _ok("zero_state_sharded_across_processes")
 
-    golden = Classifier(MLP(n_units=16, n_out=3, seed=0))
-    gopt = MomentumSGD(lr=0.1, momentum=0.9).setup(golden)
-    gopt.add_hook(GradientClipping(0.05))
-    glosses = [float(gopt.update(golden, x, t)) for _ in range(3)]
-    np.testing.assert_allclose(losses, glosses, rtol=1e-5, atol=1e-6)
-    _ok("zero_loss_matches_golden")
-
-    for p, gp in zip(model.params(), golden.params()):
-        np.testing.assert_allclose(np.asarray(p.array),
-                                   np.asarray(gp.array),
-                                   rtol=1e-4, atol=1e-6)
-    digest = [np.asarray(p.array).tobytes() for p in model.params()]
     agreed = comm._process_allgather_pickled(digest)
     assert all(d == agreed[0] for d in agreed[1:])
     _ok("zero_params_consistent")
@@ -393,7 +375,7 @@ def run_split_groups(pid, nprocs):
 
     # group-specific data (seed differs by group): the two groups must
     # NOT mix gradients
-    _, _, digest = _dp_golden_check(sub, seed=100 + group_id, steps=2)
+    _, _, _, digest = _dp_golden_check(sub, seed=100 + group_id, steps=2)
     _ok("subgroup_dp_step_runs")
     _ok("subgroup_matches_own_golden")
     # within-group agreement AND across-group divergence, checked over

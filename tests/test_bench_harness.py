@@ -19,9 +19,9 @@ import bench
 
 TPU_RESULT = {
     "metric": "resnet50_imagenet_train_throughput",
-    "value": 2022.0, "unit": "images/sec/chip", "vs_baseline": 8.99,
+    "value": 1390.0, "unit": "images/sec/chip", "vs_baseline": 6.18,
     "platform": "axon", "device_kind": "TPU v5 lite", "n_devices": 1,
-    "per_chip_batch": 256, "image_size": 224, "layout": "NHWC",
+    "per_chip_batch": 64, "image_size": 224, "layout": "NHWC",
     "compile_s": 109.0,
 }
 
@@ -52,17 +52,67 @@ def test_cacheable_accepts_only_default_config_accelerator_runs():
     assert not bench._cacheable({**TPU_RESULT, "platform": "cpu_fallback"})
     assert not bench._cacheable({**TPU_RESULT, "image_size": 32})
     assert not bench._cacheable({**TPU_RESULT, "per_chip_batch": 2})
+    assert not bench._cacheable({**TPU_RESULT, "per_chip_batch": 256})
     assert not bench._cacheable({**TPU_RESULT, "value": None})
     assert not bench._cacheable({**TPU_RESULT, "stale": True})
     assert not bench._cacheable({**TPU_RESULT, "error": "boom"})
+    # payload sanity: non-flagship layout / fused-dispatch numbers are a
+    # different measurement regime (planted/legacy-cache defense)
+    assert not bench._cacheable({**TPU_RESULT, "layout": "NCHW"})
+    assert not bench._cacheable({**TPU_RESULT,
+                                 "fused_steps_per_dispatch": 8})
+
+
+def test_cacheable_rejects_nondefault_requested_config(monkeypatch):
+    """The recovery queue's variant runs (BENCH_BS=256, BENCH_SCAN=8,
+    BENCH_LAYOUT=NCHW, BENCH_SEQ=8192 ...) must never persist under the
+    flagship metric, even when the payload looks plausible — the env
+    fingerprint covers every knob, including ones the payload omits."""
+    for knob, value in [("BENCH_BS", "256"), ("BENCH_SCAN", "8"),
+                        ("BENCH_LAYOUT", "NCHW"), ("BENCH_REMAT", "1"),
+                        ("BENCH_SIZE", "32")]:
+        monkeypatch.setenv(knob, value)
+        assert not bench._cacheable(TPU_RESULT), knob
+        monkeypatch.delenv(knob)
+    assert bench._cacheable(TPU_RESULT)
 
 
 def test_cacheable_transformer_needs_real_seq_len():
     base = {"metric": "transformer_lm_train_throughput", "value": 1e5,
-            "platform": "axon", "seq_len": 1024}
+            "platform": "axon", "seq_len": 1024, "per_chip_batch": 8}
     assert bench._cacheable(base)
     assert not bench._cacheable({**base, "seq_len": 64})
     assert not bench._cacheable({**base, "platform": "cpu"})
+
+
+def test_cacheable_transformer_rejects_model_shape_variants(monkeypatch):
+    """Vocab/heads/depth/width variants change FLOPs-per-token (a small
+    vocab drops the output projection, ~15-20% of fwd FLOPs) — they must
+    not masquerade as the flagship GPT-2-small datum, via either the env
+    fingerprint (fresh runs) or the payload checks (legacy entries)."""
+    base = {"metric": "transformer_lm_train_throughput", "value": 1e5,
+            "platform": "axon", "seq_len": 1024, "per_chip_batch": 8}
+    monkeypatch.setenv("BENCH_MODEL", "transformer")
+    for knob, value in [("BENCH_VOCAB", "512"), ("BENCH_HEADS", "4"),
+                        ("BENCH_D_MODEL", "256"), ("BENCH_LAYERS", "4")]:
+        monkeypatch.setenv(knob, value)
+        assert not bench._cacheable(base), knob
+        monkeypatch.delenv(knob)
+    assert bench._cacheable(base)
+    # payload-side defense for legacy (fingerprint-less) entries
+    assert not bench._cacheable({**base, "d_model": 256})
+    assert not bench._cacheable({**base, "n_layers": 4})
+    assert not bench._cacheable({**base, "n_vocab": 512})
+    assert not bench._cacheable({**base, "remat": True})
+
+
+def test_cacheable_transformer_rejects_longcontext_variant(monkeypatch):
+    base = {"metric": "transformer_lm_train_throughput", "value": 1e5,
+            "platform": "axon", "seq_len": 8192, "per_chip_batch": 2}
+    monkeypatch.setenv("BENCH_BS", "2")
+    monkeypatch.setenv("BENCH_SEQ", "8192")
+    monkeypatch.setenv("BENCH_REMAT", "1")
+    assert not bench._cacheable(base)
 
 
 def test_emit_persists_only_cacheable(cache_path, capsys):
@@ -72,7 +122,27 @@ def test_emit_persists_only_cacheable(cache_path, capsys):
     bench._emit(TPU_RESULT)
     with open(cache_path) as f:
         saved = json.load(f)
-    assert saved["result"]["value"] == TPU_RESULT["value"]
+    entry = saved["entries"][TPU_RESULT["metric"]]
+    assert entry["result"]["value"] == TPU_RESULT["value"]
+    assert entry["fingerprint"] == \
+        bench._DEFAULT_FINGERPRINTS["resnet50"]
+    capsys.readouterr()
+
+
+def test_cache_keeps_one_slot_per_metric(cache_path, capsys):
+    """The recovery queue interleaves resnet and transformer runs; a
+    transformer persist must not destroy the last-good resnet datum."""
+    tf_result = {"metric": "transformer_lm_train_throughput",
+                 "value": 1e5, "unit": "tokens/sec/chip",
+                 "platform": "axon", "seq_len": 1024, "per_chip_batch": 8}
+    bench._emit(TPU_RESULT)
+    bench._emit(tf_result)
+    with open(cache_path) as f:
+        entries = json.load(f)["entries"]
+    assert entries["resnet50_imagenet_train_throughput"]["result"][
+        "value"] == TPU_RESULT["value"]
+    assert entries["transformer_lm_train_throughput"]["result"][
+        "value"] == tf_result["value"]
     capsys.readouterr()
 
 
@@ -104,6 +174,74 @@ def test_stale_reemit_serves_real_tpu_datum(cache_path, capsys,
     assert out["stale"] is True
     assert out["platform"] == "axon"
     assert out["error"] == "relay wedged"
+
+
+def test_stale_reemit_refuses_fingerprint_mismatch(cache_path, capsys,
+                                                   monkeypatch):
+    """A new-format entry recorded under a variant config (here scan=8)
+    must not be re-served by a default-config run, even if its payload
+    were doctored to look default."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    fp = dict(bench._DEFAULT_FINGERPRINTS["resnet50"], scan=8)
+    with open(cache_path, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "old", "saved_at": 0.0, "fingerprint": fp,
+            "result": TPU_RESULT}}}, f)
+    bench._emit_stale_or_error("relay wedged")
+    out = _last_line(capsys)
+    assert out["value"] is None
+    assert "wedged" in out["error"]
+
+
+def test_stale_reemit_serves_new_format_default_entry(cache_path, capsys,
+                                                      monkeypatch):
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    with open(cache_path, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "earlier-run", "saved_at": 0.0,
+            "fingerprint": bench._DEFAULT_FINGERPRINTS["resnet50"],
+            "result": TPU_RESULT}}}, f)
+    bench._emit_stale_or_error("relay wedged")
+    out = _last_line(capsys)
+    assert out["value"] == TPU_RESULT["value"]
+    assert out["stale"] is True
+    assert out["config"] == bench._DEFAULT_FINGERPRINTS["resnet50"]
+
+
+def test_stale_fp_override_restores_fallback_reserve(cache_path, capsys,
+                                                     monkeypatch):
+    """The CPU-fallback re-exec shrinks BENCH_BS for its own cpu
+    measurement; BENCH_STALE_FP carries the ORIGINAL requested config so
+    the child can still re-serve the cached default-config flagship
+    datum when its cpu attempt also fails."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    monkeypatch.setenv("BENCH_BS", "8")  # the fallback child's cpu knob
+    with open(cache_path, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "earlier-run", "saved_at": 0.0,
+            "fingerprint": bench._DEFAULT_FINGERPRINTS["resnet50"],
+            "result": TPU_RESULT}}}, f)
+    # without the override the shrunken bs refuses the cached datum ...
+    bench._emit_stale_or_error("tpu down, cpu fallback also failed")
+    assert _last_line(capsys)["value"] is None
+    # ... with it (what _child_main sets on the re-exec) it re-serves
+    monkeypatch.setenv("BENCH_STALE_FP", json.dumps(
+        bench._DEFAULT_FINGERPRINTS["resnet50"]))
+    bench._emit_stale_or_error("tpu down, cpu fallback also failed")
+    out = _last_line(capsys)
+    assert out["value"] == TPU_RESULT["value"]
+    assert out["stale"] is True
+
+
+def test_config_fingerprint_never_raises_on_bad_env(monkeypatch):
+    """`_emit_stale_or_error` is documented 'never raises' — a typo'd
+    int knob must not turn the always-emit fallback into a traceback."""
+    monkeypatch.setenv("BENCH_SCAN", "8x")
+    monkeypatch.setenv("BENCH_BS", "")
+    fp = bench._config_fingerprint("resnet50")
+    assert fp["scan"] == 0 and fp["bs"] == bench.DEFAULT_BS
 
 
 def test_stale_reemit_never_repersists(cache_path, capsys, monkeypatch):

@@ -9,31 +9,60 @@ exec >> "$LOG" 2>&1
 echo "=== TPU recovery queue started $(date -u) ==="
 export PYTHONPATH=/root/repo:$PYTHONPATH
 
-echo "--- prewarm (warms XLA cache + seeds last-good cache) ---"
-BENCH_STEPS=4 BENCH_DEADLINE_S=900 python bench.py
-echo "--- resnet bs64 NHWC ---"
-BENCH_DEADLINE_S=600 BENCH_TRIALS=3 python bench.py
-echo "--- resnet bs256 NHWC ---"
-BENCH_BS=256 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
-echo "--- resnet bs256 NCHW (layout comparison) ---"
-BENCH_BS=256 BENCH_LAYOUT=NCHW BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
-echo "--- resnet bs256 NHWC scan8 (fused dispatch) ---"
-BENCH_BS=256 BENCH_SCAN=8 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
-echo "--- transformer bs8 seq1024 ---"
-BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
-echo "--- transformer bs2 seq8192 remat ---"
-BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3 python bench.py
-echo "--- flash vs xla attention T=2048/8192 ---"
-PROBE=flashcmp python tools/probe_perf.py || true
+# Authoritative results of THIS run only: the cumulative $LOG may hold
+# rows from earlier/aborted runs, and each bench prints preliminary
+# early-emit lines before its final line — only the LAST JSON line per
+# invocation is authoritative (bench.py's emit contract).
+RESULTS=$(mktemp /tmp/tpu_queue_results.XXXXXX)
 
-# Fold the JSON result lines into BENCH_NOTES so the round records the
-# on-chip numbers even if nobody is awake to do it manually.
+# Each bench writes to its own step file DIRECTLY (no pipe, no command
+# substitution): if this shell dies mid-bench, the bench keeps a valid
+# fd and finishes — a pipe would SIGPIPE-kill it mid-TPU-operation,
+# the exact hard-kill the relay discipline forbids.  The step file is
+# folded into $LOG after each step (not live; postmortems read the
+# step file).
+STEP=0
+run_one() {
+  desc="$1"; shift
+  echo "--- $desc ---"
+  STEP=$((STEP + 1))
+  stepf=/tmp/tpu_queue_step_${STEP}.log
+  env "$@" python bench.py > "$stepf" 2>&1
+  cat "$stepf"
+  line=$(grep '^{' "$stepf" | tail -1)
+  [ -n "$line" ] && printf '%s\n' "$line" >> "$RESULTS"
+}
+
+run_one "prewarm (warms XLA cache + seeds last-good cache)" \
+  BENCH_STEPS=4 BENCH_DEADLINE_S=900
+run_one "resnet bs64 NHWC (flagship default)" \
+  BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+run_one "resnet bs256 NHWC" \
+  BENCH_BS=256 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "resnet bs256 NCHW (layout comparison)" \
+  BENCH_BS=256 BENCH_LAYOUT=NCHW BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "resnet bs256 NHWC scan8 (fused dispatch)" \
+  BENCH_BS=256 BENCH_SCAN=8 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "transformer bs8 seq1024" \
+  BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "transformer bs2 seq8192 remat" \
+  BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 \
+  BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+
+echo "--- flash vs xla attention T=2048/8192 ---"
+stepf=/tmp/tpu_queue_step_flashcmp.log
+PROBE=flashcmp python tools/probe_perf.py > "$stepf" 2>&1 || true
+cat "$stepf"
+grep '^{' "$stepf" >> "$RESULTS"
+
+# Fold THIS run's authoritative JSON lines into BENCH_NOTES so the round
+# records the on-chip numbers even if nobody is awake to do it manually.
 {
   echo ""
   echo "## Round-4 on-chip results (auto-recorded by tpu_recovery_queue at $(date -u))"
   echo ""
   echo '```'
-  grep '^{' "$LOG" | tail -20
+  cat "$RESULTS"
   echo '```'
 } >> BENCH_NOTES.md
 echo "--- profile resnet NHWC bs64 (unsupervised: may wedge; keep last) ---"

@@ -16,6 +16,10 @@ cat > "$PROBE" <<'PYEOF'
 import time, json
 t0 = time.time()
 import jax
+# Pin to the TPU relay: never probe-succeed on a CPU fallback.  The
+# env var is ignored on this box (axon sitecustomize) — must use
+# jax.config.update.
+jax.config.update("jax_platforms", "axon")
 devs = jax.devices()
 import jax.numpy as jnp
 x = jnp.ones((256, 256), dtype=jnp.bfloat16)
@@ -25,11 +29,33 @@ print(json.dumps({"platform": jax.default_backend(),
                   "init_s": round(time.time() - t0, 1), "val": v}),
       flush=True)
 PYEOF
+# A sentinel from a PREVIOUS watcher/session could false-fire the
+# one-shot recovery.  Do NOT rm it — an in-flight probe's stdout
+# redirect already points at that inode, and unlinking the path would
+# silently lose its result.  Instead require the sentinel to be newer
+# than this watcher's start: an old completed sentinel is ignored (and
+# overwritten by the next probe launch), while a pre-existing in-flight
+# probe that completes after we started gets a fresh mtime and fires.
+START_TS=$(date +%s)
+sentinel_fresh() {
+  [ -s "$SENTINEL" ] || return 1
+  [ "$(stat -c %Y "$SENTINEL" 2>/dev/null || echo 0)" -ge "$START_TS" ]
+}
 while true; do
-  if grep -q '"platform"' "$SENTINEL" 2>/dev/null; then
+  # Fire only on a REAL accelerator probe: "platform" present and not
+  # cpu.  THIS script's probe pins jax_platforms=axon and so can never
+  # report cpu — the elif below defends against a sentinel written by a
+  # pre-existing in-flight probe from an OLDER watcher version (such
+  # probes are never killed, per the relay discipline) whose un-pinned
+  # jax init could fall back to cpu when the relay fails fast.
+  if sentinel_fresh && grep -q '"platform"' "$SENTINEL" \
+      && ! grep -q '"platform": "cpu' "$SENTINEL"; then
     echo "TPU BACK at $(date -u): $(cat "$SENTINEL")"
     "$(dirname "$0")/tpu_recovery_queue.sh"
     exit 0
+  elif sentinel_fresh && grep -q '"platform": "cpu' "$SENTINEL"; then
+    echo "cpu-fallback probe at $(date -u) — relay still down; retrying"
+    rm -f "$SENTINEL"  # probe completed (it wrote the line): rm is safe
   fi
   if ! pgrep -f "python $PROBE" > /dev/null; then
     (python "$PROBE" > "$SENTINEL" 2>/tmp/tpu_probe_last.err &)
