@@ -566,15 +566,20 @@ class _MultiNodeOptimizer:
 
     def add_hook(self, hook, name=None, timing="pre"):
         self.actual_optimizer.add_hook(hook, name, timing)
-        # _zero_layout's lifetime tracks _opt_state's (which add_hook just
-        # reset): a stale layout would make the serialize pre-seed guard
-        # skip rebuilding the flat template
+        # add_hook resets _opt_state; every piece of wrapper state whose
+        # lifetime tracks it resets too (same invariant as setup()): a
+        # stale _zero_layout would make the serialize pre-seed guard
+        # skip rebuilding the flat template, and a kept _stale_grads
+        # would apply a pre-hook gradient against fresh optimizer state
+        # instead of the double-buffer fresh-start semantics
         super().__setattr__("_zero_layout", None)
+        super().__setattr__("_stale_grads", None)
         self._mn_step_cache.clear()
 
     def remove_hook(self, name):
         self.actual_optimizer.remove_hook(name)
         super().__setattr__("_zero_layout", None)
+        super().__setattr__("_stale_grads", None)
         self._mn_step_cache.clear()
 
     def serialize(self, serializer):

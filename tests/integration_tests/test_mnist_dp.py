@@ -111,6 +111,29 @@ def test_double_buffering_one_step_stale():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_double_buffering_add_hook_resets_stale_grads():
+    """add_hook resets the wrapped optimizer's state mid-run; the
+    double-buffer slot must reset with it — otherwise the next update
+    applies the PRE-hook stale gradient against fresh optimizer state
+    instead of the documented fresh-start (zero-grads-first) semantics."""
+    x, t = _batch(64)
+    model = Classifier(MLP())
+    comm = ct.create_communicator("pure_nccl")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm,
+                                         double_buffering=True).setup(model)
+    for _ in range(3):
+        opt.update(model, x, t)
+    assert opt._stale_grads is not None
+    # GradientClipping is a no-op on the zero fresh-start grads (unlike
+    # WeightDecay, which correctly moves params even at zero gradient)
+    opt.add_hook(ct.core.GradientClipping(1.0))
+    assert opt._stale_grads is None
+    w_before = np.asarray(model.predictor.l1.W.array).copy()
+    opt.update(model, x, t)  # fresh start: applies zero grads
+    np.testing.assert_allclose(
+        np.asarray(model.predictor.l1.W.array), w_before)
+
+
 def test_double_buffering_converges():
     x, t = _batch(128)
     model = Classifier(MLP())

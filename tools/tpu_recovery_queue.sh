@@ -3,17 +3,25 @@
 # Every bench.py invocation has its own no-jax supervisor + deadline and
 # emits stale/error lines instead of hanging; profile runs go last so a
 # wedge there cannot block the benches. Nothing here kills a TPU process.
-cd /root/repo
-LOG=/root/repo/tpu_recovery_run.log
+#
+# QUEUE_REPO/QUEUE_LOG/QUEUE_NOTES env overrides exist for the bitrot
+# test (tests/test_recovery_queue.py) — this script runs unattended
+# exactly once per recovery, so its mechanics are tested with a stubbed
+# `python` rather than trusted.
+REPO=${QUEUE_REPO:-/root/repo}
+cd "$REPO"
+LOG=${QUEUE_LOG:-$REPO/tpu_recovery_run.log}
+NOTES=${QUEUE_NOTES:-$REPO/BENCH_NOTES.md}
 exec >> "$LOG" 2>&1
 echo "=== TPU recovery queue started $(date -u) ==="
-export PYTHONPATH=/root/repo:$PYTHONPATH
+export PYTHONPATH=$REPO:$PYTHONPATH
 
 # Authoritative results of THIS run only: the cumulative $LOG may hold
 # rows from earlier/aborted runs, and each bench prints preliminary
 # early-emit lines before its final line — only the LAST JSON line per
 # invocation is authoritative (bench.py's emit contract).
 RESULTS=$(mktemp /tmp/tpu_queue_results.XXXXXX)
+STEPDIR=$(mktemp -d /tmp/tpu_queue_steps.XXXXXX)
 
 # Each bench writes to its own step file DIRECTLY (no pipe, no command
 # substitution): if this shell dies mid-bench, the bench keeps a valid
@@ -26,7 +34,7 @@ run_one() {
   desc="$1"; shift
   echo "--- $desc ---"
   STEP=$((STEP + 1))
-  stepf=/tmp/tpu_queue_step_${STEP}.log
+  stepf=$STEPDIR/step_${STEP}.log
   env "$@" python bench.py > "$stepf" 2>&1
   cat "$stepf"
   line=$(grep '^{' "$stepf" | tail -1)
@@ -50,7 +58,7 @@ run_one "transformer bs2 seq8192 remat" \
   BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 
 echo "--- flash vs xla attention T=2048/8192 ---"
-stepf=/tmp/tpu_queue_step_flashcmp.log
+stepf=$STEPDIR/step_flashcmp.log
 PROBE=flashcmp python tools/probe_perf.py > "$stepf" 2>&1 || true
 cat "$stepf"
 grep '^{' "$stepf" >> "$RESULTS"
@@ -64,7 +72,7 @@ grep '^{' "$stepf" >> "$RESULTS"
   echo '```'
   cat "$RESULTS"
   echo '```'
-} >> BENCH_NOTES.md
+} >> "$NOTES"
 echo "--- profile resnet NHWC bs64 (unsupervised: may wedge; keep last) ---"
 python tools/profile_tpu_step.py --layout NHWC --bs 64 --steps 8
 echo "--- profile resnet NCHW bs64 ---"
