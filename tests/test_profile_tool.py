@@ -44,3 +44,40 @@ def test_summarize_empty_dir_reports_cleanly(tmp_path, capsys):
     profile_tpu_step.summarize(str(tmp_path))
     out = capsys.readouterr().out
     assert "no xplane.pb" in out
+
+
+def test_compare_diffs_two_real_traces(tmp_path, capsys):
+    """--compare is the queue's NCHW-vs-NHWC instrument: capture two
+    traces of different programs and assert per-op delta rows print
+    (ops matched by name, missing side = 0)."""
+
+    @jax.jit
+    def step_a(x):
+        return jnp.tanh(x @ x).sum()
+
+    @jax.jit
+    def step_b(x):
+        return jnp.exp(jnp.sin(x @ x)).sum()  # different op mix
+
+    x = jnp.ones((256, 256), jnp.float32)
+    float(step_a(x)), float(step_b(x))  # compile outside the windows
+    dirs = []
+    for name, step in [("a", step_a), ("b", step_b)]:
+        d = str(tmp_path / name)
+        with jax.profiler.trace(d):
+            for _ in range(2):
+                loss = step(x)
+            float(loss)
+        dirs.append(d)
+
+    profile_tpu_step.compare(*dirs)
+    out = capsys.readouterr().out
+    assert "total delta (B-A):" in out
+    rows = re.findall(r"^\s*[\d.]+\s+[\d.]+\s+[+-][\d.]+\s+\S+", out, re.M)
+    assert rows, f"no delta rows:\n{out}"
+
+
+def test_compare_missing_trace_reports_cleanly(tmp_path, capsys):
+    profile_tpu_step.compare(str(tmp_path / "nope"), str(tmp_path / "x"))
+    out = capsys.readouterr().out
+    assert "EMPTY" in out

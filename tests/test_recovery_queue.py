@@ -90,5 +90,7 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     log_text = log.read_text()
     assert "STALE-OLD-ROW" in log_text
     assert "=== TPU recovery queue done" in log_text
-    # both profile invocations ran after the auto-record
-    assert log_text.count("profile stub ran") == 2
+    # all three profile invocations (NHWC + NCHW captures, then the
+    # offline layout compare) ran after the auto-record
+    assert log_text.count("profile stub ran") == 3
+    assert "--compare" in log_text

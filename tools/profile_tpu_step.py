@@ -34,9 +34,20 @@ def main():
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--out", default="/tmp/chainermn_tpu_trace")
+    ap.add_argument("--tag", default=None,
+                    help="stable trace-dir name (default: timestamp) so "
+                         "a later --compare can find it")
+    ap.add_argument("--compare", nargs=2, metavar=("DIR_A", "DIR_B"),
+                    default=None,
+                    help="offline per-op diff of two existing traces "
+                         "(no jax import, no device touch)")
     ap.add_argument("--platform", default=None,
                     help="override platform (cpu for a smoke run)")
     args = ap.parse_args()
+
+    if args.compare:
+        compare(*args.compare)
+        return
 
     import jax
     if args.platform:
@@ -88,7 +99,13 @@ def main():
     loss = opt.update(model, x, t)
     float(loss)
 
-    out_dir = os.path.join(args.out, time.strftime("%Y%m%d-%H%M%S"))
+    out_dir = os.path.join(args.out,
+                           args.tag or time.strftime("%Y%m%d-%H%M%S"))
+    if args.tag and os.path.isdir(out_dir):
+        # a stable tag dir re-used across runs would hold several trace
+        # sessions and the parser could pick a stale one — start fresh
+        import shutil
+        shutil.rmtree(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     with jax.profiler.trace(out_dir):
         for _ in range(args.steps):
@@ -141,19 +158,21 @@ def _walk_fields(buf):
             return
 
 
-def summarize(out_dir, top=25):
-    """Aggregate per-op self-time from the device XPlane.
+def _collect(out_dir):
+    """Parse the trace into {plane_name: {op_name: total_ps}}.
 
     XSpace: planes(1) -> XPlane{name(2), lines(3) -> XLine{events(4) ->
     XEvent{metadata_id(1), duration_ps(3)}}, event_metadata(5) map<id,
-    XEventMetadata{id(1), name(2)}>}.
+    XEventMetadata{id(1), name(2)}>}.  Prefers device planes (TPU);
+    falls back to the host CPU plane for smoke runs.
     """
     paths = glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"),
                       recursive=True)
     if not paths:
-        print("no xplane.pb found (trace empty?)")
-        return
-    data = open(paths[0], "rb").read()
+        return None  # no trace file at all (vs {}: file but no events)
+    # a re-used --tag dir can hold several trace sessions; parse the
+    # newest capture, not scandir order
+    data = open(max(paths, key=os.path.getmtime), "rb").read()
     planes = [v for f, w, v in _walk_fields(data) if f == 1 and w == 2]
 
     def plane_name(plane):
@@ -162,11 +181,11 @@ def summarize(out_dir, top=25):
                 return v.decode(errors="replace")
         return ""
 
-    # prefer device planes (TPU); fall back to host CPU for smoke runs
     chosen = [p for p in planes
               if "TPU" in plane_name(p) or "/device" in plane_name(p).lower()]
     if not chosen:
         chosen = [p for p in planes if plane_name(p) == "/host:CPU"]
+    result = {}
     for plane in chosen:
         name = ""
         metadata = {}
@@ -202,12 +221,60 @@ def summarize(out_dir, top=25):
                     if mid is not None:
                         key = metadata.get(mid, str(mid))
                         totals[key] = totals.get(key, 0) + dur
-        if not totals:
-            continue
+        if totals:
+            result[name] = totals
+    return result
+
+
+def summarize(out_dir, top=25):
+    """Print per-op self-time aggregated from the device XPlane."""
+    collected = _collect(out_dir)
+    if collected is None:
+        print("no xplane.pb found (trace not written?)")
+        return
+    if not collected:
+        print("xplane.pb present but no plane had events "
+              "(empty trace window?)")
+        return
+    for name, totals in collected.items():
         total_ps = sum(totals.values())
         print(f"\n== plane: {name} — total {total_ps/1e12:.3f} s of events")
         for op, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
             print(f"  {ps/1e9:10.3f} ms  {100*ps/total_ps:5.1f}%  {op[:90]}")
+
+
+def compare(dir_a, dir_b, top=30):
+    """Offline A/B diff of two traces (e.g. NCHW vs NHWC): per-op
+    self-time for each side and the delta, sorted by |delta|.  Ops are
+    matched by name; fusion boundaries can differ between layouts, so
+    one side's missing op shows as 0.  Pure parsing — no jax import, so
+    it can run from a no-jax shell while the chip session is live."""
+    ca, cb = _collect(dir_a), _collect(dir_b)
+    if not ca or not cb:
+        print(f"missing trace: A={'ok' if ca else 'EMPTY'} "
+              f"B={'ok' if cb else 'EMPTY'}")
+        return
+
+    def merge(collected):
+        # multi-plane (multi-core) traces: the same op name on several
+        # cores must SUM, not overwrite
+        totals = {}
+        for t in collected.values():
+            for op, ps in t.items():
+                totals[op] = totals.get(op, 0) + ps
+        return totals
+
+    ta, tb = merge(ca), merge(cb)
+    sum_a, sum_b = sum(ta.values()), sum(tb.values())
+    print(f"A: {dir_a} — {sum_a/1e12:.3f} s of events")
+    print(f"B: {dir_b} — {sum_b/1e12:.3f} s of events")
+    print(f"total delta (B-A): {(sum_b-sum_a)/1e9:+.3f} ms")
+    print(f"{'A ms':>10} {'B ms':>10} {'delta ms':>10}  op")
+    merged = sorted(set(ta) | set(tb),
+                    key=lambda op: -abs(tb.get(op, 0) - ta.get(op, 0)))
+    for op in merged[:top]:
+        a, b = ta.get(op, 0), tb.get(op, 0)
+        print(f"{a/1e9:10.3f} {b/1e9:10.3f} {(b-a)/1e9:+10.3f}  {op[:80]}")
 
 
 if __name__ == "__main__":

@@ -74,7 +74,10 @@ grep '^{' "$stepf" >> "$RESULTS"
   echo '```'
 } >> "$NOTES"
 echo "--- profile resnet NHWC bs64 (unsupervised: may wedge; keep last) ---"
-python tools/profile_tpu_step.py --layout NHWC --bs 64 --steps 8
+python tools/profile_tpu_step.py --layout NHWC --bs 64 --steps 8 --tag nhwc64
 echo "--- profile resnet NCHW bs64 ---"
-python tools/profile_tpu_step.py --layout NCHW --bs 64 --steps 8
+python tools/profile_tpu_step.py --layout NCHW --bs 64 --steps 8 --tag nchw64
+echo "--- layout comparison (offline parse, no device touch) ---"
+python tools/profile_tpu_step.py --compare \
+  /tmp/chainermn_tpu_trace/nchw64 /tmp/chainermn_tpu_trace/nhwc64
 echo "=== TPU recovery queue done $(date -u) ==="
