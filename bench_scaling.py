@@ -120,12 +120,17 @@ def project_efficiency(step_ms, n_chips, grad_mb=51.1, ici_gbps=100.0,
     return t_1 / t_n
 
 
-def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps):
+def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
+                 zero=False):
     """One process of the REAL cross-process compiled DP step (the same
     path as ``tests/multiprocess_tests/_worker.py · run_dp_step``): gloo
     CPU backend, 1 device per process, the whole DP step one shard_mapped
-    jit whose gradient pmean crosses actual process boundaries.  Times
-    the steady-state step; rank 0 prints the row."""
+    jit whose gradient pmean crosses actual process boundaries.  With
+    ``zero`` the optimizer state is ZeRO-1 sharded: the gradient
+    traffic becomes psum_scatter + all_gather instead of one pmean —
+    the curve then measures the reduce-scatter refactoring's transport
+    cost across real process boundaries.  Times the steady-state step;
+    rank 0 prints the row."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
@@ -144,7 +149,8 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps):
     model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.01, momentum=0.9), comm).setup(model)
+        MomentumSGD(lr=0.01, momentum=0.9), comm,
+        zero_sharding=zero).setup(model)
 
     gbs = per_rank_bs * nprocs
     rng = np.random.RandomState(0)
@@ -166,12 +172,13 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps):
                        for p in model.params())
         print(json.dumps({
             "processes": nprocs, "per_rank_bs": per_rank_bs,
+            "zero_sharding": bool(zero),
             "grad_payload_mb": round(n_params * 4 / 1e6, 2),
             "step_ms": round(dt / steps * 1e3, 3),
             "examples_per_sec": round(steps * gbs / dt, 1)}), flush=True)
 
 
-def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps):
+def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False):
     """Launch each P-process measurement and report per-hop overhead:
     step_ms(P) - step_ms(1) is the cost the framework adds per step when
     the SAME compiled program's gradient mean must cross P real process
@@ -208,7 +215,8 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps):
             procs = [subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__),
                  "--gloo-worker", str(pid), str(nprocs), str(port),
-                 str(per_rank_bs), str(hidden), str(steps)],
+                 str(per_rank_bs), str(hidden), str(steps),
+                 str(int(zero))],
                 env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
                 for pid in range(nprocs)]
@@ -298,20 +306,24 @@ def main():
                         help="comma list, e.g. 1,2,4: measure the REAL "
                              "cross-process compiled DP step at each "
                              "process count (gloo CPU backend)")
-    parser.add_argument("--gloo-worker", nargs=6, default=None,
+    parser.add_argument("--gloo-worker", nargs=7, default=None,
                         help=argparse.SUPPRESS)  # internal
     parser.add_argument("--gloo-hidden", type=int, default=512,
                         help="MLP hidden width for --gloo-procs")
+    parser.add_argument("--gloo-zero", action="store_true",
+                        help="use the ZeRO-1 sharded step (psum_scatter"
+                             " + all_gather) instead of plain DP pmean")
     args = parser.parse_args()
 
     if args.gloo_worker:
-        pid, nprocs, port, bs, hidden, steps = map(int, args.gloo_worker)
-        _gloo_worker(pid, nprocs, port, bs, hidden, steps)
+        pid, nprocs, port, bs, hidden, steps, zero = \
+            map(int, args.gloo_worker)
+        _gloo_worker(pid, nprocs, port, bs, hidden, steps, bool(zero))
         return
     if args.gloo_procs:
         counts = [int(c) for c in args.gloo_procs.split(",")]
         _run_gloo_curve(counts, args.per_chip_bs, args.gloo_hidden,
-                        args.steps)
+                        args.steps, zero=args.gloo_zero)
         return
 
     if args.project:

@@ -291,39 +291,56 @@ def test_supervisor_emits_error_line_when_child_wedges(tmp_path):
     assert elapsed < 45, f"supervisor took {elapsed:.0f}s for an 8s deadline"
 
 
-@pytest.mark.slow
-def test_gloo_scaling_harness_two_process(tmp_path):
-    """bench_scaling --gloo-procs mechanics: the real cross-process
-    compiled-DP measurement (VERDICT r3 Missing #4's instrument) keeps
-    working — rows parse, per-hop summary present."""
+def _run_gloo_harness(extra_args, timeout):
+    """Shared launcher for the bench_scaling gloo tests: own session so
+    a timeout reaps the gloo worker GRANDCHILDREN too (not just the
+    bench_scaling parent), stdout parsed into JSON rows."""
+    import signal
     import subprocess
     import sys
-
-    import signal
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    # own session: a timeout must reap the gloo worker grandchildren
-    # too, not just the bench_scaling parent
     proc = subprocess.Popen(
         [sys.executable, os.path.join(root, "bench_scaling.py"),
-         "--gloo-procs", "1,2", "--per-chip-bs", "8", "--steps", "5",
-         "--gloo-hidden", "32"],
+         *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, start_new_session=True)
     try:
-        stdout, stderr = proc.communicate(timeout=420)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         proc.communicate()
         raise
     assert proc.returncode == 0, stderr[-2000:]
-    rows = [json.loads(ln) for ln in stdout.splitlines()
+    return [json.loads(ln) for ln in stdout.splitlines()
             if ln.startswith("{")]
+
+
+@pytest.mark.slow
+def test_gloo_scaling_harness_two_process(tmp_path):
+    """bench_scaling --gloo-procs mechanics: the real cross-process
+    compiled-DP measurement (VERDICT r3 Missing #4's instrument) keeps
+    working — rows parse, per-hop summary present."""
+    rows = _run_gloo_harness(
+        ["--gloo-procs", "1,2", "--per-chip-bs", "8", "--steps", "5",
+         "--gloo-hidden", "32"], timeout=420)
     by_procs = {r["processes"]: r for r in rows if "step_ms" in r}
     assert set(by_procs) == {1, 2}
     assert all(r["step_ms"] > 0 for r in by_procs.values())
     summary = [r for r in rows if "per_hop_overhead_raw_ms" in r]
     assert summary and summary[0]["processes"] == 2
     assert "overhead_vs_serialized_compute_ms" in summary[0]
+    assert all(r["zero_sharding"] is False for r in by_procs.values())
+
+
+@pytest.mark.slow
+def test_gloo_scaling_harness_zero_mode(tmp_path):
+    """--gloo-zero mechanics: the ZeRO-1 cross-process curve (psum_scatter
+    + all_gather data plane) keeps producing parseable rows."""
+    rows = _run_gloo_harness(
+        ["--gloo-procs", "1", "--per-chip-bs", "8", "--steps", "5",
+         "--gloo-hidden", "32", "--gloo-zero"], timeout=300)
+    assert rows and rows[0]["zero_sharding"] is True
+    assert rows[0]["step_ms"] > 0
