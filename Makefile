@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test bench bench-smoke bench-prewarm scaling dryrun examples clean
+.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -27,6 +27,13 @@ bench-prewarm:    ## warm the XLA + last-good-result caches on the chip
 
 scaling:
 	$(PY) bench_scaling.py --platform cpu --simulate-devices 8 --per-chip-bs 4 --size 64 --steps 3
+
+scaling-gloo:     ## real cross-process compiled-DP + ZeRO curves (CPU gloo)
+	$(PY) bench_scaling.py --gloo-procs 1,2,4 --per-chip-bs 64 --steps 200
+	$(PY) bench_scaling.py --gloo-procs 1,2,4 --per-chip-bs 64 --steps 200 --gloo-zero
+
+watch:            ## start the detached TPU relay recovery watcher
+	(setsid nohup bash tools/tpu_relay_watch.sh > /tmp/tpu_watch.log 2>&1 < /dev/null &) && sleep 1 && pgrep -f tpu_relay_watch
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
