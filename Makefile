@@ -32,8 +32,12 @@ scaling-gloo:     ## real cross-process compiled-DP + ZeRO curves (CPU gloo)
 	$(PY) bench_scaling.py --gloo-procs 1,2,4 --per-chip-bs 64 --steps 200
 	$(PY) bench_scaling.py --gloo-procs 1,2,4 --per-chip-bs 64 --steps 200 --gloo-zero
 
-watch:            ## start the detached TPU relay recovery watcher
-	(setsid nohup bash tools/tpu_relay_watch.sh > /tmp/tpu_watch.log 2>&1 < /dev/null &) && sleep 1 && pgrep -f tpu_relay_watch
+watch:            ## start the detached TPU relay recovery watcher (idempotent)
+	@# the recipe shell's own cmdline must not match the pgrep: bracket
+	@# the pattern AND quote-split the script name in the spawn branch
+	@pgrep -f "[t]pu_relay_watch.sh" > /dev/null && echo "watcher already running:" || \
+	  (setsid nohup bash tools/tpu_relay_watch.s''h > /tmp/tpu_watch.log 2>&1 < /dev/null &) ; \
+	sleep 1; pgrep -f "[t]pu_relay_watch.sh"
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -53,10 +53,18 @@ struct Loader {
     std::atomic<bool> stop{false};
     int n_threads = 1;
 
-    // intra-batch parallel gather state
+    // intra-batch parallel gather state.  `current` may only be
+    // (re)assigned under gmu AND with active_gatherers == 0: helpers
+    // read it lock-free inside gather_rows, so reassigning while one is
+    // still copying is a use-after-move on the indices vector (a real
+    // crash seen as a flaky suite segfault).  `epoch` stops a helper
+    // that finished its chunks early from re-entering the same job in a
+    // spin while `gathering` is still up.
     std::mutex gmu;
     std::condition_variable cv_gather;
     Job current;
+    uint64_t epoch = 0;               // guarded by gmu
+    std::atomic<int> active_gatherers{0};
     std::atomic<size_t> next_row{0};
     std::atomic<size_t> rows_done{0};
     std::atomic<bool> gathering{false};
@@ -82,6 +90,7 @@ void gather_rows(Loader* L) {
 }
 
 void worker_main(Loader* L, bool leader) {
+    uint64_t last_epoch = 0;  // helpers: last job generation gathered
     for (;;) {
         if (leader) {
             Job job;
@@ -95,8 +104,17 @@ void worker_main(Loader* L, bool leader) {
                 L->pending.pop_front();
             }
             {
-                std::lock_guard<std::mutex> g(L->gmu);
+                // helpers from the PREVIOUS job must be fully out of
+                // gather_rows before `current` is reassigned (they read
+                // it lock-free)
+                std::unique_lock<std::mutex> g(L->gmu);
+                L->cv_gather.wait(g, [&] {
+                    return L->stop.load() ||
+                           L->active_gatherers.load() == 0;
+                });
+                if (L->stop.load()) break;
                 L->current = std::move(job);
+                L->epoch++;
                 L->next_row.store(0);
                 L->rows_done.store(0);
                 L->gathering.store(true);
@@ -117,13 +135,23 @@ void worker_main(Loader* L, bool leader) {
             }
             L->cv_done.notify_all();
         } else {
-            std::unique_lock<std::mutex> lk(L->gmu);
-            L->cv_gather.wait(lk, [&] {
-                return L->stop.load() || L->gathering.load();
-            });
-            if (L->stop.load()) break;
-            lk.unlock();
+            {
+                std::unique_lock<std::mutex> lk(L->gmu);
+                L->cv_gather.wait(lk, [&] {
+                    return L->stop.load() ||
+                           (L->gathering.load() &&
+                            L->epoch != last_epoch);
+                });
+                if (L->stop.load()) break;
+                last_epoch = L->epoch;
+                L->active_gatherers.fetch_add(1);
+            }
             gather_rows(L);
+            {
+                std::lock_guard<std::mutex> lk(L->gmu);
+                L->active_gatherers.fetch_sub(1);
+            }
+            L->cv_gather.notify_all();  // leader may wait for idle
         }
     }
 }
@@ -205,7 +233,17 @@ void loader_release(void* handle, int buffer_id) {
 
 void loader_destroy(void* handle) {
     Loader* L = static_cast<Loader*>(handle);
-    L->stop.store(true);
+    // store stop while holding each CV's mutex: a bare store+notify can
+    // land between a waiter's predicate check and its sleep (the waiter
+    // holds the mutex there, but a notifier that never takes it can
+    // slip into that window) — the wakeup is lost and join() hangs
+    {
+        std::lock_guard<std::mutex> lk(L->mu);
+        L->stop.store(true);
+    }
+    {
+        std::lock_guard<std::mutex> g(L->gmu);
+    }
     L->cv_pending.notify_all();
     L->cv_gather.notify_all();
     L->cv_free.notify_all();

@@ -117,3 +117,35 @@ def test_reset_drains_inflight_submissions():
     np.testing.assert_array_equal(np.sort(got[:, 0]),
                                   data[np.argsort(data[:, 0]), 0])
     it.finalize()
+
+
+def test_loader_churn_and_midflight_destroy_stress(lib):
+    """Regression for a shutdown/steady-state race: helpers read
+    ``current`` lock-free inside gather_rows while the leader could
+    move-assign it for the next job (use-after-move on the indices
+    vector — observed as a flaky suite segfault in loader_destroy's
+    join window).  Back-to-back submissions with several threads hammer
+    the reassign path; closing with jobs still in flight hammers the
+    shutdown path."""
+    rng = np.random.RandomState(0)
+    data = rng.normal(0, 1, (512, 16)).astype(np.float32)
+
+    # steady-state churn: many consecutive jobs through few buffers
+    loader = native.NativeLoader(data, max_batch=32, n_buffers=2,
+                                 n_threads=4)
+    for step in range(100):
+        idx = rng.randint(0, len(data), 32)
+        loader.submit(idx)
+        batch = loader.next()
+        np.testing.assert_array_equal(batch, data[idx])
+    loader.close()
+
+    # mid-flight destroy: close while queued jobs are being gathered
+    for trial in range(20):
+        loader = native.NativeLoader(data, max_batch=64, n_buffers=3,
+                                     n_threads=4)
+        for _ in range(3):
+            loader.submit(rng.randint(0, len(data), 64))
+        if trial % 2:
+            loader.next()  # consume one, leave the rest in flight
+        loader.close()
