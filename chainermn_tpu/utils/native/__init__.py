@@ -14,7 +14,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["load_library", "NativeLoader"]
+__all__ = ["load_library", "bind_signatures", "NativeLoader"]
 
 _lock = threading.Lock()
 _lib = None
@@ -45,22 +45,31 @@ def load_library():
             lib = ctypes.CDLL(out)
         except Exception:
             return None
-        lib.loader_create.restype = ctypes.c_void_p
-        lib.loader_create.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_int, ctypes.c_int]
-        lib.loader_submit.restype = ctypes.c_int
-        lib.loader_submit.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64]
-        lib.loader_next.restype = ctypes.c_int
-        lib.loader_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_int64)]
-        lib.loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.loader_destroy.argtypes = [ctypes.c_void_p]
+        bind_signatures(lib)
         _lib = lib
         return _lib
+
+
+def bind_signatures(lib):
+    """Declare the C ABI on a loaded library handle.  The single source
+    of truth for the loader's ctypes signatures — also used by
+    tools/tsan_check_dataloader.sh on its sanitizer-built variant, so a
+    signature change cannot silently drift between the two."""
+    lib.loader_create.restype = ctypes.c_void_p
+    lib.loader_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+    lib.loader_submit.restype = ctypes.c_int
+    lib.loader_submit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64]
+    lib.loader_next.restype = ctypes.c_int
+    lib.loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.loader_destroy.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 class NativeLoader:

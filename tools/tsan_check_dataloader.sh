@@ -1,0 +1,57 @@
+#!/bin/bash
+# ThreadSanitizer check of the native dataloader's gather engine.
+#
+# Builds dataloader.cpp with -fsanitize=thread and drives it through the
+# same churn + mid-flight-destroy stress the suite uses (200 jobs / 4
+# threads / 2 buffers, then 30 destroys with jobs in flight), under
+# LD_PRELOAD'd libtsan.  Exit 0 = no races reported; TSAN exitcode=66 on
+# a report.  Methodology validated against the pre-fix engine (commit
+# 6d96fb4~1), where this exact driver exits 66 every run with multiple
+# race warnings (2-4 observed; the count is scheduling-dependent).
+set -e
+cd "$(dirname "$0")/.."
+SO=$(mktemp /tmp/_dataloader_tsan.XXXXXX.so)
+trap 'rm -f "$SO"' EXIT
+g++ -O1 -g -shared -fPIC -std=c++17 -pthread -fsanitize=thread \
+    chainermn_tpu/utils/native/dataloader.cpp -o "$SO"
+LIBTSAN=$(g++ -print-file-name=libtsan.so)
+LD_PRELOAD="$LIBTSAN" TSAN_OPTIONS="exitcode=66" DATALOADER_SO="$SO" \
+python - <<'EOF'
+import ctypes, os, sys
+import numpy as np
+
+sys.path.insert(0, os.getcwd())
+from chainermn_tpu.utils.native import bind_signatures
+
+lib = bind_signatures(ctypes.CDLL(os.environ["DATALOADER_SO"]))
+
+rng = np.random.RandomState(0)
+data = np.ascontiguousarray(rng.normal(0, 1, (512, 16)).astype(np.float32))
+
+def submit(h, idx):
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    assert lib.loader_submit(h, idx.ctypes.data_as(
+        ctypes.POINTER(ctypes.c_int64)), idx.size) == 0
+
+h = lib.loader_create(data.ctypes.data, 512, 64, 64, 2, 4)
+for step in range(200):
+    idx = rng.randint(0, 512, 64)
+    submit(h, idx)
+    ptr, rows = ctypes.c_void_p(), ctypes.c_int64()
+    bid = lib.loader_next(h, ctypes.byref(ptr), ctypes.byref(rows))
+    assert bid >= 0 and rows.value == 64
+    lib.loader_release(h, bid)
+lib.loader_destroy(h)
+
+for trial in range(30):
+    h = lib.loader_create(data.ctypes.data, 512, 64, 64, 3, 4)
+    for _ in range(3):
+        submit(h, rng.randint(0, 512, 64))
+    if trial % 2:
+        ptr, rows = ctypes.c_void_p(), ctypes.c_int64()
+        bid = lib.loader_next(h, ctypes.byref(ptr), ctypes.byref(rows))
+        assert bid >= 0 and rows.value == 64
+        lib.loader_release(h, bid)
+    lib.loader_destroy(h)
+print("TSAN CHECK CLEAN")
+EOF
