@@ -1,29 +1,46 @@
 #!/bin/bash
-# ThreadSanitizer check of the native dataloader's gather engine.
+# Sanitizer checks of the native dataloader's gather engine.
 #
-# Builds dataloader.cpp with -fsanitize=thread and drives it through the
-# same churn + mid-flight-destroy stress the suite uses (200 jobs / 4
-# threads / 2 buffers, then 30 destroys with jobs in flight), under
-# LD_PRELOAD'd libtsan.  Exit 0 = no races reported; TSAN exitcode=66 on
-# a report.  Methodology validated against the pre-fix engine (commit
+# Builds dataloader.cpp with -fsanitize=thread (and, second pass,
+# -fsanitize=address) and drives it through the same churn +
+# mid-flight-destroy stress the suite uses (200 jobs / 4 threads / 2
+# buffers, then 30 destroys with jobs in flight), under the LD_PRELOAD'd
+# sanitizer runtime.  Exit 0 = clean; the sanitizer exits nonzero on a
+# report.  TSAN methodology validated against the pre-fix engine (commit
 # 6d96fb4~1), where this exact driver exits 66 every run with multiple
 # race warnings (2-4 observed; the count is scheduling-dependent).
 set -e
 cd "$(dirname "$0")/.."
-SO=$(mktemp /tmp/_dataloader_tsan.XXXXXX.so)
-trap 'rm -f "$SO"' EXIT
-g++ -O1 -g -shared -fPIC -std=c++17 -pthread -fsanitize=thread \
-    chainermn_tpu/utils/native/dataloader.cpp -o "$SO"
-LIBTSAN=$(g++ -print-file-name=libtsan.so)
-LD_PRELOAD="$LIBTSAN" TSAN_OPTIONS="exitcode=66" DATALOADER_SO="$SO" \
-python - <<'EOF'
-import ctypes, os, sys
+
+DRIVER=$(mktemp /tmp/_dataloader_san_driver.XXXXXX.py)
+SO_A=$(mktemp /tmp/_dataloader_san.XXXXXX.so)
+SO_B=$(mktemp /tmp/_dataloader_san.XXXXXX.so)
+trap 'rm -f "$DRIVER" "$SO_A" "$SO_B"' EXIT
+
+run_driver() {  # $1 = sanitizer flag, $2 = runtime .so, $3 = so path, $4 = env opts
+  g++ -O1 -g -shared -fPIC -std=c++17 -pthread "$1" \
+      chainermn_tpu/utils/native/dataloader.cpp -o "$3"
+  LD_PRELOAD="$(g++ -print-file-name="$2")" DATALOADER_SO="$3" \
+    env $4 python "$DRIVER"
+}
+
+cat > "$DRIVER" <<'EOF'
+import ctypes, os
+import importlib.util
 import numpy as np
 
-sys.path.insert(0, os.getcwd())
-from chainermn_tpu.utils.native import bind_signatures
+# load the binding module STANDALONE: importing the chainermn_tpu
+# package would pull jax into a process the sanitizer may terminate
+# abnormally (and is heavyweight under the sanitizer runtime); the
+# native module itself only needs ctypes + numpy
+spec = importlib.util.spec_from_file_location(
+    "native_binding",
+    os.path.join(os.getcwd(), "chainermn_tpu", "utils", "native",
+                 "__init__.py"))
+native = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(native)
 
-lib = bind_signatures(ctypes.CDLL(os.environ["DATALOADER_SO"]))
+lib = native.bind_signatures(ctypes.CDLL(os.environ["DATALOADER_SO"]))
 
 rng = np.random.RandomState(0)
 data = np.ascontiguousarray(rng.normal(0, 1, (512, 16)).astype(np.float32))
@@ -53,5 +70,14 @@ for trial in range(30):
         assert bid >= 0 and rows.value == 64
         lib.loader_release(h, bid)
     lib.loader_destroy(h)
-print("TSAN CHECK CLEAN")
+print("SANITIZER DRIVER CLEAN")
 EOF
+
+echo "--- ThreadSanitizer pass ---"
+run_driver -fsanitize=thread libtsan.so "$SO_A" "TSAN_OPTIONS=exitcode=66"
+echo "--- AddressSanitizer pass ---"
+# leak detection off: the long-lived python interpreter under LD_PRELOAD
+# reports unrelated interpreter allocations; we want bounds/UAF checks
+run_driver -fsanitize=address libasan.so "$SO_B" \
+  "ASAN_OPTIONS=detect_leaks=0:exitcode=66"
+echo "TSAN+ASAN CHECK CLEAN"
