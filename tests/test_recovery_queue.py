@@ -81,8 +81,12 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
-    # flashcmp rows recorded (all of them — each comparison is a datum)
+    # flashcmp rows recorded in their own section AFTER the main fold
+    # (the fold must precede the unsupervised wedge-capable steps)
     assert notes_text.count('"flash_vs_xla"') == 2
+    assert "Flash-vs-XLA attention rows" in notes_text
+    assert notes_text.index("Round-4 on-chip results") \
+        < notes_text.index("Flash-vs-XLA attention rows")
     # isolation: preliminary lines and the old run's rows are excluded
     assert '"prelim"' not in notes_text
     assert "STALE-OLD-ROW" not in notes_text
@@ -94,3 +98,46 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     # offline layout compare) ran after the auto-record
     assert log_text.count("profile stub ran") == 3
     assert "--compare" in log_text
+
+
+FLASHCMP_NO_JSON_STUB = STUB.replace(
+    """  *probe_perf.py*)
+    echo "flashcmp header text"
+    echo '{"flash_vs_xla": "T2048"}'
+    echo '{"flash_vs_xla": "T8192"}'
+    ;;""",
+    """  *probe_perf.py*)
+    echo "flashcmp crashed before any JSON"
+    exit 1
+    ;;""")
+
+
+@pytest.mark.slow
+def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
+    """When the flash-vs-xla probe wedges/crashes before printing JSON,
+    the queue must still complete (|| true), the seven bench rows must
+    already be folded, and NO empty 'Flash-vs-XLA' section may be
+    appended."""
+    shim = tmp_path / "bin"
+    shim.mkdir()
+    py = shim / "python"
+    py.write_text(FLASHCMP_NO_JSON_STUB)
+    py.chmod(py.stat().st_mode | stat.S_IEXEC)
+
+    repo = tmp_path / "repo"
+    (repo / "tools").mkdir(parents=True)
+    notes = repo / "NOTES.md"
+    notes.write_text("# notes\n")
+
+    env = dict(os.environ,
+               PATH=f"{shim}{os.pathsep}{os.environ['PATH']}",
+               QUEUE_REPO=str(repo), QUEUE_LOG=str(repo / "queue.log"),
+               QUEUE_NOTES=str(notes))
+    proc = subprocess.run(["bash", QUEUE], env=env, capture_output=True,
+                          text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    notes_text = notes.read_text()
+    assert "Round-4 on-chip results" in notes_text
+    assert len([ln for ln in notes_text.splitlines()
+                if '"final"' in ln]) == 7
+    assert "Flash-vs-XLA" not in notes_text

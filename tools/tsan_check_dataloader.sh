@@ -17,11 +17,12 @@ SO_A=$(mktemp /tmp/_dataloader_san.XXXXXX.so)
 SO_B=$(mktemp /tmp/_dataloader_san.XXXXXX.so)
 trap 'rm -f "$DRIVER" "$SO_A" "$SO_B"' EXIT
 
-run_driver() {  # $1 = sanitizer flag, $2 = runtime .so, $3 = so path, $4 = env opts
+run_driver() {  # $1 = sanitizer flag, $2 = runtime .so, $3 = so path,
+                # $4.. = env VAR=VALUE assignments (quoted, may hold spaces)
   g++ -O1 -g -shared -fPIC -std=c++17 -pthread "$1" \
       chainermn_tpu/utils/native/dataloader.cpp -o "$3"
   LD_PRELOAD="$(g++ -print-file-name="$2")" DATALOADER_SO="$3" \
-    env $4 python "$DRIVER"
+    env "${@:4}" python "$DRIVER"
 }
 
 cat > "$DRIVER" <<'EOF'
