@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 import chainermn_tpu as ct
 from chainermn_tpu import F, L
-from chainermn_tpu.core.optimizer import SGD, Adam
+from chainermn_tpu.core.optimizer import SGD, Adam, MomentumSGD
 from chainermn_tpu.dataset import SerialIterator, get_mnist
 from chainermn_tpu.training import StandardUpdater, Trainer, extensions
 
@@ -315,6 +315,49 @@ def test_update_scan_equals_sequential_updates():
                                 model_seq.namedparams()):
         np.testing.assert_allclose(np.asarray(p1.array), np.asarray(p2.array),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_update_scan_snapshot_resume_bit_exact(tmp_path):
+    """Mid-training save between fused K-step dispatches, resume in a
+    FRESH optimizer, continue with update_scan: the resumed trajectory
+    (params AND step count) must bit-match the uninterrupted one —
+    pins that the scan path keeps `t` and the optax state serializable
+    exactly like per-step update()."""
+    from chainermn_tpu.serializers import save_npz, load_npz
+    K = 3
+
+    def fresh():
+        model = Classifier(MLP())
+        comm = ct.create_communicator("jax_ici")
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+        return model, opt
+
+    def block(seed0):
+        xs = jnp.stack([_batch(64, seed=seed0 + i)[0] for i in range(K)])
+        ts = jnp.stack([_batch(64, seed=seed0 + i)[1] for i in range(K)])
+        return xs, ts
+
+    model_a, opt_a = fresh()
+    opt_a.update_scan(model_a, *block(0))
+    path = str(tmp_path / "scan_mid.npz")
+    save_npz(path, opt_a)
+    opt_a.update_scan(model_a, *block(10))  # uninterrupted continuation
+
+    model_b, opt_b = fresh()
+    load_npz(path, opt_b)
+    assert opt_b.t == K
+    opt_b.update_scan(model_b, *block(10))
+
+    assert opt_a.t == opt_b.t == 2 * K
+    for (na, pa), (nb, pb) in zip(model_a.namedparams(),
+                                  model_b.namedparams()):
+        assert na == nb
+        np.testing.assert_array_equal(np.asarray(pa.array),
+                                      np.asarray(pb.array),
+                                      err_msg=f"param {na} diverged after "
+                                              f"scan resume")
 
 
 def test_update_scan_rejects_double_buffering():
