@@ -57,6 +57,11 @@ def main():
     parser.add_argument("--fused", type=int, default=0,
                         help="fuse K optimizer steps per dispatch "
                              "(FusedUpdater/update_scan; 0 = per-step)")
+    parser.add_argument("--zero", action="store_true",
+                        help="ZeRO-1 sharded optimizer state: "
+                             "reduce-scatter grads, 1/n-chunk momentum "
+                             "+ update, all-gather params — same "
+                             "trajectory as plain DP, 1/n state memory")
     args = parser.parse_args()
 
     if args.simulate_devices:
@@ -81,7 +86,8 @@ def main():
     lr = args.lr if args.lr is not None \
         else (0.1 if args.arch == "resnet50" else 0.01)
     optimizer = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=lr, momentum=0.9), comm).setup(model)
+        MomentumSGD(lr=lr, momentum=0.9), comm,
+        zero_sharding=args.zero).setup(model)
     optimizer.add_hook(ct.core.WeightDecay(1e-4))
 
     train = get_synthetic_imagenet(n=args.n_train, size=args.size)
