@@ -1,0 +1,100 @@
+"""Bitrot guard for tools/tpu_relay_watch.sh's fire-once logic.
+
+The watcher runs unattended and consumes itself on the first accepted
+sentinel — a false fire wastes the one recovery shot, a missed fire
+loses the chip session.  A PATH-shimmed `python` stands in for the
+probe; a stub queue records invocations.  No jax, no device touch.
+"""
+
+import os
+import stat
+import subprocess
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCH = os.path.join(ROOT, "tools", "tpu_relay_watch.sh")
+
+TPU_LINE = '{"platform": "axon", "device_kind": "TPU v5 lite", "n": 1}'
+CPU_LINE = '{"platform": "cpu", "device_kind": "cpu", "n": 1}'
+
+
+def _setup(tmp_path, probe_stub):
+    shim = tmp_path / "bin"
+    shim.mkdir()
+    py = shim / "python"
+    py.write_text(probe_stub)
+    py.chmod(py.stat().st_mode | stat.S_IEXEC)
+    queue = tmp_path / "queue.sh"
+    queue.write_text("#!/bin/bash\necho fired >> %s\n"
+                     % (tmp_path / "queue_calls"))
+    queue.chmod(queue.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ,
+               PATH=f"{shim}{os.pathsep}{os.environ['PATH']}",
+               WATCH_PROBE=str(tmp_path / "probe.py"),
+               WATCH_SENTINEL=str(tmp_path / "sentinel.json"),
+               WATCH_ERRFILE=str(tmp_path / "probe.err"),
+               WATCH_INTERVAL="1", WATCH_QUEUE=str(queue))
+    return env, tmp_path / "queue_calls"
+
+
+@pytest.mark.slow
+def test_fires_queue_once_on_tpu_sentinel(tmp_path):
+    env, calls = _setup(tmp_path, f"#!/bin/bash\necho '{TPU_LINE}'\n")
+    proc = subprocess.run(["bash", WATCH], env=env, capture_output=True,
+                          text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "TPU BACK" in proc.stdout
+    assert calls.read_text() == "fired\n"  # exactly once
+
+
+@pytest.mark.slow
+def test_cpu_fallback_sentinel_does_not_consume_watcher(tmp_path):
+    """A cpu-fallback probe result must NOT fire the one-shot recovery;
+    the watcher clears it and keeps probing (here: the second probe
+    reports the TPU and fires)."""
+    stub = f"""#!/bin/bash
+marker={tmp_path}/first_done
+if [ ! -e "$marker" ]; then
+  touch "$marker"
+  echo '{CPU_LINE}'
+else
+  echo '{TPU_LINE}'
+fi
+"""
+    env, calls = _setup(tmp_path, stub)
+    proc = subprocess.run(["bash", WATCH], env=env, capture_output=True,
+                          text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "cpu-fallback probe" in proc.stdout
+    assert "TPU BACK" in proc.stdout
+    assert calls.read_text() == "fired\n"
+
+
+@pytest.mark.slow
+def test_failed_queue_propagates_nonzero_exit(tmp_path):
+    """A missing/failing recovery script must not let the one-shot
+    watcher exit 0 as if the measurement battery had run."""
+    env, calls = _setup(tmp_path, f"#!/bin/bash\necho '{TPU_LINE}'\n")
+    env["WATCH_QUEUE"] = str(tmp_path / "does_not_exist.sh")
+    proc = subprocess.run(["bash", WATCH], env=env, capture_output=True,
+                          text=True, timeout=30)
+    assert proc.returncode != 0
+    assert "RECOVERY QUEUE FAILED" in proc.stdout
+
+
+@pytest.mark.slow
+def test_stale_pre_start_sentinel_is_ignored(tmp_path):
+    """A complete TPU sentinel left by a PREVIOUS session must not fire
+    the recovery (its mtime predates this watcher's start); the watcher
+    keeps probing instead."""
+    env, calls = _setup(tmp_path, "#!/bin/bash\n")  # probe writes nothing
+    sentinel = tmp_path / "sentinel.json"
+    sentinel.write_text(TPU_LINE + "\n")
+    old = time.time() - 7200
+    os.utime(sentinel, (old, old))
+    with pytest.raises(subprocess.TimeoutExpired):
+        subprocess.run(["bash", WATCH], env=env, capture_output=True,
+                       text=True, timeout=5)
+    assert not calls.exists()

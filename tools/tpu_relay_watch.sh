@@ -10,8 +10,15 @@
 #
 # On recovery it runs tools/tpu_recovery_queue.sh (prewarm + the full
 # on-chip measurement battery) and exits.
-PROBE=/tmp/tpu_probe.py
-SENTINEL=/tmp/tpu_probe_last.json
+#
+# WATCH_* env overrides exist for the bitrot test
+# (tests/test_relay_watch.py) — the fire-once logic runs unattended, so
+# it is tested with a stubbed `python`/queue rather than trusted.
+PROBE=${WATCH_PROBE:-/tmp/tpu_probe.py}
+SENTINEL=${WATCH_SENTINEL:-/tmp/tpu_probe_last.json}
+ERRFILE=${WATCH_ERRFILE:-/tmp/tpu_probe_last.err}
+INTERVAL=${WATCH_INTERVAL:-300}
+QUEUE=${WATCH_QUEUE:-$(dirname "$0")/tpu_recovery_queue.sh}
 cat > "$PROBE" <<'PYEOF'
 import time, json
 t0 = time.time()
@@ -51,14 +58,19 @@ while true; do
   if sentinel_fresh && grep -q '"platform"' "$SENTINEL" \
       && ! grep -q '"platform": "cpu' "$SENTINEL"; then
     echo "TPU BACK at $(date -u): $(cat "$SENTINEL")"
-    "$(dirname "$0")/tpu_recovery_queue.sh"
-    exit 0
+    # propagate the queue's status: a missing/failed recovery script
+    # must not let the one-shot watcher exit 0 as if the measurement
+    # battery had run
+    "$QUEUE"
+    rc=$?
+    [ "$rc" -ne 0 ] && echo "RECOVERY QUEUE FAILED rc=$rc"
+    exit "$rc"
   elif sentinel_fresh && grep -q '"platform": "cpu' "$SENTINEL"; then
     echo "cpu-fallback probe at $(date -u) — relay still down; retrying"
     rm -f "$SENTINEL"  # probe completed (it wrote the line): rm is safe
   fi
   if ! pgrep -f "python $PROBE" > /dev/null; then
-    (python "$PROBE" > "$SENTINEL" 2>/tmp/tpu_probe_last.err &)
+    (python "$PROBE" > "$SENTINEL" 2>"$ERRFILE" &)
   fi
-  sleep 300
+  sleep "$INTERVAL"
 done
