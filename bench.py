@@ -10,7 +10,9 @@ emit a parseable result line well inside the driver's timeout window,
 no matter what wedges.  Three layers of defense:
 
 1. **Supervisor/child split.**  ``main()`` re-execs itself as a child
-   process and enforces ``BENCH_DEADLINE_S`` (default 270 s) from the
+   process and enforces ``BENCH_DEADLINE_S`` (default 270 s once the
+   prewarm sentinel marks the XLA cache warm, 480 s on first contact —
+   cold compile through the relay measured 75–109 s in r2) from the
    parent, which never imports jax.  This is the only mechanism that
    survives the known failure mode on this box — ``jax.devices()``
    blocking forever inside ``make_c_api_client`` when the remote relay
@@ -62,6 +64,11 @@ BASELINE_IMG_PER_SEC = 225.0  # ChainerMN-era images/sec/P100 (docstring)
 DEFAULT_BS = 64
 DEFAULT_SIZE = 224
 DEFAULT_SEQ = 1024
+# steps per timing trial: part of the fingerprint/payload gates — a
+# short-step warmup (the recovery queue's BENCH_STEPS=4 prewarm) has
+# different amortization and must never be re-served as flagship data
+DEFAULT_STEPS = 40
+DEFAULT_TF_STEPS = 20
 # transformer-mode flagship config (GPT-2-small-class): shared by the
 # env parsing, the fingerprint, and the payload checks — one definition
 # so a bump cannot silently desync the cache gates
@@ -72,8 +79,16 @@ DEFAULT_TF_VOCAB = 32768
 
 _CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
                              "/tmp/chainermn_tpu_last_bench.json")
+# Touched after any successful real-accelerator trial: signals the
+# persistent XLA compile cache is warm.  A first-contact run (cold cache
+# + relay round-trips; r2 measured 75–109 s cold compile) gets a longer
+# default deadline so it cannot stale-out on compile time alone
+# (VERDICT r4 Weak #4).  Explicit BENCH_DEADLINE_S always wins.
+_PREWARM_SENTINEL = os.environ.get("BENCH_PREWARM_SENTINEL",
+                                   "/tmp/chainermn_tpu_bench_prewarmed")
 _START = time.monotonic()
-_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "270"))
+_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S") or
+                    (270 if os.path.exists(_PREWARM_SENTINEL) else 480))
 
 # Peak bf16 flops by TPU generation (per chip).  v5 lite = v5e.
 _PEAK_TFLOPS = {
@@ -112,12 +127,12 @@ _METRIC_TO_MODEL = {
 _DEFAULT_FINGERPRINTS = {
     "resnet50": {"model": "resnet50", "bs": DEFAULT_BS,
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
-                 "scan": 0, "remat": False},
+                 "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
                     "n_vocab": DEFAULT_TF_VOCAB, "heads": 0,
-                    "remat": False},
+                    "remat": False, "n_steps": DEFAULT_TF_STEPS},
 }
 
 
@@ -161,6 +176,7 @@ def _config_fingerprint(model=None):
             "n_vocab": _env_int("BENCH_VOCAB", DEFAULT_TF_VOCAB),
             "heads": _env_int("BENCH_HEADS", 0),
             "remat": os.environ.get("BENCH_REMAT", "0") == "1",
+            "n_steps": _env_int("BENCH_STEPS", DEFAULT_TF_STEPS),
         }
     return {
         "model": "resnet50",
@@ -169,6 +185,7 @@ def _config_fingerprint(model=None):
         "layout": os.environ.get("BENCH_LAYOUT", "NHWC"),
         "scan": _env_int("BENCH_SCAN", 0),
         "remat": os.environ.get("BENCH_REMAT", "0") == "1",
+        "n_steps": _env_int("BENCH_STEPS", DEFAULT_STEPS),
     }
 
 
@@ -204,6 +221,10 @@ def _cacheable(result):
                 and result.get("layout", "NHWC") == "NHWC"
                 and result.get("fused_steps_per_dispatch", 1) == 1
                 and not result.get("remat", False)
+                # payload-level n_steps check: a short-step prewarm datum
+                # (queue step 1, BENCH_STEPS=4) measures amortization, not
+                # throughput — tolerate only legacy entries lacking the key
+                and result.get("n_steps", DEFAULT_STEPS) == DEFAULT_STEPS
                 and DEFAULT_BS // 4 <= result.get("per_chip_batch", 0)
                 <= DEFAULT_BS)
     return (result.get("seq_len", 0) == DEFAULT_SEQ
@@ -214,6 +235,7 @@ def _cacheable(result):
             and result.get("n_vocab", DEFAULT_TF_VOCAB)
             == DEFAULT_TF_VOCAB
             and not result.get("remat", False)
+            and result.get("n_steps", DEFAULT_TF_STEPS) == DEFAULT_TF_STEPS
             and DEFAULT_TF_BS // 4 <= result.get("per_chip_batch", 0)
             <= DEFAULT_TF_BS)
 
@@ -227,6 +249,17 @@ def _emit(result, persist=True):
     result = dict(result)
     print(json.dumps(result), flush=True)
     _EMITTED[0] = result
+    if result.get("value") is not None and not result.get("stale") \
+            and not result.get("error") \
+            and result.get("platform") not in (None, "cpu", "cpu_fallback"):
+        # ANY successful on-chip trial (flagship or variant, including
+        # the recovery queue's prewarm) marks the XLA cache warm: later
+        # default-deadline runs drop back to the tight 270 s window
+        try:
+            with open(_PREWARM_SENTINEL, "w") as f:
+                f.write(f"{os.environ['BENCH_RUN_ID']} {time.time()}\n")
+        except Exception:
+            pass
     if not persist or not _cacheable(result):
         return
     try:
@@ -375,7 +408,7 @@ def _run_bench_transformer():
 
     per_chip_bs = int(os.environ.get("BENCH_BS", str(DEFAULT_TF_BS)))
     seq_len = int(os.environ.get("BENCH_SEQ", str(DEFAULT_SEQ)))
-    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    n_steps = int(os.environ.get("BENCH_STEPS", str(DEFAULT_TF_STEPS)))
     d_model = int(os.environ.get("BENCH_D_MODEL",
                                  str(DEFAULT_TF_D_MODEL)))
     n_layers = int(os.environ.get("BENCH_LAYERS",
@@ -407,6 +440,7 @@ def _run_bench_transformer():
             "n_layers": n_layers,
             "n_vocab": n_vocab,
             "remat": remat,
+            "n_steps": n_steps,
             "compile_s": round(compile_s, 1),
         }
         peak = _peak_tflops(devices)
@@ -475,7 +509,7 @@ def _run_bench():
     per_chip_bs = int(os.environ.get("BENCH_BS", str(DEFAULT_BS)))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     image_size = int(os.environ.get("BENCH_SIZE", str(DEFAULT_SIZE)))
-    n_steps = int(os.environ.get("BENCH_STEPS", "40"))
+    n_steps = int(os.environ.get("BENCH_STEPS", str(DEFAULT_STEPS)))
     # BENCH_SCAN=K fuses K steps per dispatch via update_scan (one jit
     # containing a lax.scan) — isolates device throughput from host/relay
     # dispatch latency; 0 = plain per-step update() dispatch
@@ -502,6 +536,7 @@ def _run_bench():
             "image_size": image_size,
             "layout": layout,
             "remat": remat,
+            "n_steps": n_steps,
             "compile_s": round(compile_s, 1),
             "fused_steps_per_dispatch": scan_k or 1,
         }

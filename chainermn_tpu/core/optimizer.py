@@ -17,6 +17,7 @@ can mutate ``optimizer.lr`` between steps without recompiling.
 from __future__ import annotations
 
 from collections import OrderedDict
+import warnings
 
 import numpy as np
 
@@ -213,10 +214,11 @@ def serialize_flat_tree(serializer, tree, count_key, leaf_prefix):
 def deserialize_flat_tree(serializer, template, count_key, leaf_prefix):
     """Read a pytree written by :func:`serialize_flat_tree` onto
     ``template``'s structure.  Returns ``None`` when the snapshot has no
-    ``count_key`` (pre-feature or partial snapshot).  Leaves beyond the
-    saved count — or missing under a non-strict reader — keep the
-    template's value, so a leaf-count mismatch degrades to a partial
-    restore instead of a ``tree.unflatten`` crash."""
+    ``count_key`` (pre-feature or partial snapshot).  A leaf-count
+    mismatch or a leaf missing under a non-strict reader keeps the
+    template's value for the affected leaves — but warns loudly, because
+    a snapshot saved under a different optimizer/hook configuration
+    would otherwise resume with silently mixed optimizer state."""
     try:
         n = serializer(count_key, None)
     except KeyError:
@@ -224,15 +226,28 @@ def deserialize_flat_tree(serializer, template, count_key, leaf_prefix):
     if n is None:
         return None
     flat, treedef = jax.tree.flatten(template)
+    if int(n) != len(flat):
+        warnings.warn(
+            f"flat-tree snapshot '{count_key}' holds {int(n)} leaves but the "
+            f"current configuration expects {len(flat)}; leaves beyond the "
+            "saved count keep their template (fresh) values.  This usually "
+            "means the snapshot was saved under a different optimizer/hook "
+            "configuration.", stacklevel=2)
     new = []
+    missing = []
     for i, leaf in enumerate(flat):
         data = None
         if i < int(n):
             try:
                 data = serializer(f"{leaf_prefix}{i}", None)
             except KeyError:
-                data = None
+                missing.append(i)
         new.append(jnp.asarray(data) if data is not None else leaf)
+    if missing:
+        warnings.warn(
+            f"flat-tree snapshot '{count_key}' is missing leaves {missing}; "
+            "those leaves keep their template (fresh) values.",
+            stacklevel=2)
     return jax.tree.unflatten(treedef, new)
 
 

@@ -32,6 +32,8 @@ longer serializes on the current step's collective).
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -582,6 +584,68 @@ class _MultiNodeOptimizer:
         super().__setattr__("_stale_grads", None)
         self._mn_step_cache.clear()
 
+    def _gather_opt_state_to_host(self, opt_state):
+        """Assemble non-fully-addressable (real multi-controller sharded)
+        leaves as full host ndarrays on EVERY process, via the object
+        channel.  ``np.asarray`` on such leaves raises — each process only
+        holds its own 1/n chunk — so the npz writer cannot see them
+        directly.  Gathering to host makes every per-host snapshot carry
+        the complete flat vector; ``_commit_opt_state_to_mesh`` re-pads it
+        on load, so resume tolerates a changed communicator size.
+
+        COLLECTIVE on a real multi-process mesh: every process must enter
+        ``serialize`` (the per-host multi-node checkpointer does; a
+        rank-0-only ``extensions.snapshot()`` pattern would deadlock in
+        the allgather — use ``create_multi_node_checkpointer`` for ZeRO
+        runs, as the reference does for distributed state)."""
+        def materialize(leaf):
+            if not isinstance(leaf, jax.Array) or leaf.is_fully_addressable:
+                return leaf
+            local = [(s.index, np.asarray(s.data))
+                     for s in leaf.addressable_shards]
+            gathered = self.communicator._process_allgather_pickled(local)
+            out = np.empty(leaf.shape, leaf.dtype)
+            for shards in gathered:
+                for index, data in shards:
+                    out[index] = data
+            return out
+
+        return jax.tree.map(materialize, opt_state)
+
+    def _commit_opt_state_to_mesh(self, opt_state):
+        """Re-commit restored flat (n_pad,) leaves to the ZeRO sharded
+        layout.  ``deserialize_flat_tree`` leaves full host-replicated
+        arrays; on a real multi-process mesh the compiled step's
+        ``shard_map`` needs globally-sharded ``jax.Array`` inputs, and on
+        any mesh committing up front avoids a device_put inside the first
+        post-resume step.  A flat vector saved under a DIFFERENT
+        communicator size (padding to a different multiple) is sliced to
+        the true parameter length ``n`` and re-padded to this mesh's
+        ``n_pad`` first — the host-gathered snapshots are full vectors,
+        so size-changed resume is well-defined."""
+        axis = self.communicator.axis_name
+        mesh = self.communicator.mesh
+        _, n, n_pad = self._zero_layout
+
+        def commit(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                # already mesh-sharded (e.g. the pre-seeded template kept
+                # by a partial/pre-feature snapshot): nothing to commit,
+                # and np.asarray on it would raise
+                return leaf
+            if getattr(leaf, "ndim", 0) != 1:
+                return leaf
+            if leaf.shape[0] != n_pad:
+                if leaf.shape[0] < n:
+                    return leaf  # not a flat param vector
+                leaf = jnp.pad(jnp.asarray(leaf)[:n], (0, n_pad - n))
+            host = np.asarray(leaf)
+            sharding = jax.sharding.NamedSharding(mesh, P(axis))
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+
+        return jax.tree.map(commit, opt_state)
+
     def serialize(self, serializer):
         actual = self.actual_optimizer
         if self.zero_sharding and not serializer.is_writer \
@@ -606,7 +670,26 @@ class _MultiNodeOptimizer:
             if params and all(v is not None for v in params.values()):
                 actual._opt_state = None
                 self._ensure_zero_opt_state(params)
-        actual.serialize(serializer)
+        device_state = None
+        if serializer.is_writer and self.zero_sharding \
+                and actual._opt_state is not None \
+                and any(isinstance(l, jax.Array)
+                        and not l.is_fully_addressable
+                        for l in jax.tree.leaves(actual._opt_state)):
+            # real multi-controller mesh: swap in host-assembled full
+            # vectors for the write, then restore the sharded originals
+            device_state = actual._opt_state
+            actual._opt_state = self._gather_opt_state_to_host(device_state)
+        try:
+            actual.serialize(serializer)
+        finally:
+            if device_state is not None:
+                actual._opt_state = device_state
+        if self.zero_sharding and not serializer.is_writer \
+                and actual._opt_state is not None \
+                and self._zero_layout is not None:
+            actual._opt_state = self._commit_opt_state_to_mesh(
+                actual._opt_state)
         if self._double_buffering:
             # the one-step-stale gradient buffer is OBSERVABLE state:
             # without it a resumed run applies zeros on its first update

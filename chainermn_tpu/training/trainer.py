@@ -162,7 +162,18 @@ class Trainer:
     def serialize(self, serializer):
         self.updater.serialize(serializer["updater"])
         if hasattr(self.stop_trigger, "serialize"):
-            self.stop_trigger.serialize(serializer["stop_trigger"])
+            # Guarded like extension triggers: snapshots written before
+            # triggers grew serialize() lack these keys, and a strict
+            # reader would otherwise KeyError on resume.  The trigger
+            # keeps its fresh state in that case.
+            try:
+                self.stop_trigger.serialize(serializer["stop_trigger"])
+            except KeyError:
+                # KeyError only — the strict reader's missing-key signal.
+                # Corrupt present keys must still fail loudly, and the
+                # writer must never silently drop state from a snapshot.
+                if serializer.is_writer:
+                    raise
         s = serializer["extensions"]
         t = serializer["extension_triggers"]
         for name, entry in self._extensions.items():

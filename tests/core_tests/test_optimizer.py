@@ -216,6 +216,48 @@ def test_optimizer_serialize_before_first_update(tmp_path):
     assert opt2.t == 0
 
 
+def test_deserialize_flat_tree_warns_on_leaf_count_mismatch():
+    """ADVICE r4: resuming a flat-tree snapshot saved under a different
+    optimizer/hook configuration must warn, not silently mix template
+    and saved leaves."""
+    import warnings
+
+    from chainermn_tpu.core.optimizer import (deserialize_flat_tree,
+                                              serialize_flat_tree)
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    s = DictionarySerializer()
+    serialize_flat_tree(s, [np.ones(2), np.zeros(3)], "n", "leaf")
+    template = [np.full(2, 7.0), np.full(3, 7.0), np.full(4, 7.0)]
+    with pytest.warns(UserWarning, match="leaves"):
+        out = deserialize_flat_tree(NpzDeserializer(s.target), template,
+                                    "n", "leaf")
+    np.testing.assert_array_equal(np.asarray(out[0]), np.ones(2))
+    np.testing.assert_array_equal(np.asarray(out[2]), np.full(4, 7.0))
+    # the exact-match path stays silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = deserialize_flat_tree(
+            NpzDeserializer(s.target), [np.zeros(2), np.ones(3)],
+            "n", "leaf")
+    np.testing.assert_array_equal(np.asarray(out[1]), np.zeros(3))
+
+
+def test_deserialize_flat_tree_warns_on_missing_leaf():
+    from chainermn_tpu.core.optimizer import (deserialize_flat_tree,
+                                              serialize_flat_tree)
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    s = DictionarySerializer()
+    serialize_flat_tree(s, [np.ones(2), np.zeros(3)], "n", "leaf")
+    del s.target["leaf1"]
+    with pytest.warns(UserWarning, match="missing"):
+        out = deserialize_flat_tree(
+            NpzDeserializer(s.target), [np.zeros(2), np.full(3, 7.0)],
+            "n", "leaf")
+    np.testing.assert_array_equal(np.asarray(out[1]), np.full(3, 7.0))
+
+
 def test_donate_params_same_results():
     """donate_params=True must not change the math (in-place is an XLA
     aliasing hint; CPU ignores it, TPU updates params in place)."""

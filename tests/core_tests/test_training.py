@@ -96,6 +96,34 @@ def test_snapshot_and_resume(tmp_path, mnist_small):
     np.testing.assert_allclose(w1, w2, rtol=1e-6)
 
 
+def test_resume_pre_trigger_serialize_snapshot(tmp_path, mnist_small):
+    """ADVICE r4: Max/Min/OnceTrigger gained serialize() in r4, so a
+    STRICT load of a snapshot written before that (no stop_trigger/
+    keys) must not KeyError — the stop trigger keeps fresh state."""
+    train, _ = mnist_small
+
+    def build():
+        model = Classifier(MLP())
+        optimizer = SGD(lr=0.05).setup(model)
+        it = SerialIterator(train, 64, seed=3)
+        updater = StandardUpdater(it, optimizer)
+        return model, Trainer(updater, (2, "iteration"),
+                              out=str(tmp_path / "pre"))
+
+    from chainermn_tpu.serializers.npz import DictionarySerializer
+    model, trainer = build()
+    trainer.run()
+    s = DictionarySerializer()
+    trainer.serialize(s)
+    legacy = {k: v for k, v in s.target.items()
+              if not k.startswith("stop_trigger/")}
+    path = str(tmp_path / "legacy_snap.npz")
+    np.savez(path, **legacy)
+    _, trainer2 = build()
+    load_npz(path, trainer2)  # strict — must not raise
+    assert trainer2.updater.iteration == 2
+
+
 def test_exponential_shift(tmp_path, mnist_small):
     train, _ = mnist_small
     model = Classifier(MLP())

@@ -185,6 +185,50 @@ def test_zero_serialize_resume_roundtrip(tmp_path, opt_cls, kw):
                                               f"ZeRO resume")
 
 
+def test_zero_resume_under_changed_communicator_size(tmp_path):
+    """The host-gathered snapshot is a FULL flat vector, so resuming
+    under a different communicator size is well-defined: the commit path
+    slices to the true length n and re-pads to the new mesh's n_pad
+    (8-way save → 2-way resume here: n_pad 264 vs 260 for the 259-param
+    MLP).  Trajectory must keep matching the original continuation."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+
+    x, t = _data(seed=5)
+
+    # save under the 8-device jax_ici communicator
+    comm = ct.create_communicator("jax_ici")
+    model_a = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model_a)
+    opt_a = ct.create_multi_node_optimizer(
+        Adam(alpha=1e-2), comm, zero_sharding=True).setup(model_a)
+    for _ in range(3):
+        opt_a.update(model_a, x, t)
+    path = str(tmp_path / "zero8.npz")
+    save_npz(path, opt_a)
+
+    # golden continuation on the original 8-way run
+    for _ in range(2):
+        opt_a.update(model_a, x, t)
+
+    # resume under a 2-device communicator (different n_pad)
+    comm2 = ct.create_communicator("jax_ici", devices=jax.devices()[:2])
+    model_b = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    opt_b = ct.create_multi_node_optimizer(
+        Adam(alpha=1e-2), comm2, zero_sharding=True).setup(model_b)
+    load_npz(path, opt_b)
+    assert opt_b.t == 3
+    for _ in range(2):
+        opt_b.update(model_b, x, t)
+
+    for (na, pa), (nb, pb) in zip(model_a.namedparams(),
+                                  model_b.namedparams()):
+        assert na == nb
+        np.testing.assert_allclose(
+            np.asarray(pa.array), np.asarray(pb.array),
+            rtol=1e-5, atol=1e-6,
+            err_msg=f"param {na} diverged after size-changed resume")
+
+
 def test_zero_resetup_then_load_restores_correctly(tmp_path):
     """Re-running setup() on a WARM ZeRO optimizer (e.g. rebinding the
     model before a resume) resets the wrapped optimizer's _opt_state —
