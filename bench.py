@@ -79,16 +79,27 @@ DEFAULT_TF_VOCAB = 32768
 
 _CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
                              "/tmp/chainermn_tpu_last_bench.json")
-# Touched after any successful real-accelerator trial: signals the
-# persistent XLA compile cache is warm.  A first-contact run (cold cache
-# + relay round-trips; r2 measured 75–109 s cold compile) gets a longer
-# default deadline so it cannot stale-out on compile time alone
-# (VERDICT r4 Weak #4).  Explicit BENCH_DEADLINE_S always wins.
-_PREWARM_SENTINEL = os.environ.get("BENCH_PREWARM_SENTINEL",
-                                   "/tmp/chainermn_tpu_bench_prewarmed")
+# Touched after a successful real-accelerator trial: signals the
+# persistent XLA compile cache is warm.  Per MODEL family (resnet50 /
+# transformer compile different programs — a warm transformer cache says
+# nothing about the flagship resnet program): a first-contact run for a
+# model with no sentinel (cold cache + relay round-trips; r2 measured
+# 75–109 s cold compile) gets a longer default deadline so it cannot
+# stale-out on compile time alone (VERDICT r4 Weak #4).  Explicit
+# BENCH_DEADLINE_S always wins.
+_PREWARM_SENTINEL_BASE = os.environ.get(
+    "BENCH_PREWARM_SENTINEL", "/tmp/chainermn_tpu_bench_prewarmed")
+
+
+def _prewarm_sentinel(model):
+    return f"{_PREWARM_SENTINEL_BASE}.{model}"
+
+
 _START = time.monotonic()
 _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S") or
-                    (270 if os.path.exists(_PREWARM_SENTINEL) else 480))
+                    (270 if os.path.exists(_prewarm_sentinel(
+                        os.environ.get("BENCH_MODEL", "resnet50")))
+                     else 480))
 
 # Peak bf16 flops by TPU generation (per chip).  v5 lite = v5e.
 _PEAK_TFLOPS = {
@@ -251,12 +262,15 @@ def _emit(result, persist=True):
     _EMITTED[0] = result
     if result.get("value") is not None and not result.get("stale") \
             and not result.get("error") \
-            and result.get("platform") not in (None, "cpu", "cpu_fallback"):
-        # ANY successful on-chip trial (flagship or variant, including
-        # the recovery queue's prewarm) marks the XLA cache warm: later
-        # default-deadline runs drop back to the tight 270 s window
+            and result.get("platform") not in (None, "cpu", "cpu_fallback") \
+            and result.get("metric") in _METRIC_TO_MODEL:
+        # any successful on-chip trial of this MODEL family (flagship or
+        # variant, including the recovery queue's prewarm) marks its XLA
+        # cache warm: later default-deadline runs of the same model drop
+        # back to the tight 270 s window
         try:
-            with open(_PREWARM_SENTINEL, "w") as f:
+            with open(_prewarm_sentinel(
+                    _METRIC_TO_MODEL[result["metric"]]), "w") as f:
                 f.write(f"{os.environ['BENCH_RUN_ID']} {time.time()}\n")
         except Exception:
             pass
@@ -296,7 +310,11 @@ def _load_cache(metric):
     """Return (run_id, result, fingerprint) for the metric's cache slot.
     fingerprint is None for entries written by the legacy single-slot
     format (pre-fingerprint); such entries rely on `_cacheable`'s
-    payload checks alone."""
+    payload checks alone.  A stored fingerprint that predates a newly
+    ADDED fingerprint key (e.g. n_steps) is backfilled with that key's
+    default — mirroring the payload checks' legacy tolerance, so a
+    fingerprint-schema bump cannot orphan a valid flagship datum
+    mid-outage."""
     try:
         with open(_CACHE_PATH) as f:
             data = json.load(f)
@@ -306,8 +324,11 @@ def _load_cache(metric):
             entry = data
         else:
             entry = {}
-        return entry.get("run_id"), entry.get("result"), \
-            entry.get("fingerprint")
+        fp = entry.get("fingerprint")
+        if fp is not None:
+            default = _DEFAULT_FINGERPRINTS.get(fp.get("model"), {})
+            fp = {**{k: v for k, v in default.items() if k not in fp}, **fp}
+        return entry.get("run_id"), entry.get("result"), fp
     except Exception:
         return None, None, None
 

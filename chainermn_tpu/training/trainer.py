@@ -13,6 +13,7 @@ import collections
 import os
 import time
 import traceback
+import warnings
 
 from ..core import reporter as reporter_module
 from .triggers import get_trigger
@@ -168,12 +169,18 @@ class Trainer:
             # keeps its fresh state in that case.
             try:
                 self.stop_trigger.serialize(serializer["stop_trigger"])
-            except KeyError:
+            except KeyError as e:
                 # KeyError only — the strict reader's missing-key signal.
                 # Corrupt present keys must still fail loudly, and the
                 # writer must never silently drop state from a snapshot.
                 if serializer.is_writer:
                     raise
+                warnings.warn(
+                    f"snapshot lacks stop-trigger state ({e}); the stop "
+                    "trigger keeps its fresh (possibly partially "
+                    "restored) state — snapshots written before triggers "
+                    "gained serialize() resume this way by design",
+                    stacklevel=2)
         s = serializer["extensions"]
         t = serializer["extension_triggers"]
         for name, entry in self._extensions.items():

@@ -41,7 +41,7 @@ def cache_path(tmp_path, monkeypatch):
     # _emit marks the XLA cache warm on successful accelerator results;
     # a test's fake axon payload must not plant the real sentinel (it
     # would shrink the driver's genuine first-contact deadline)
-    monkeypatch.setattr(bench, "_PREWARM_SENTINEL",
+    monkeypatch.setattr(bench, "_PREWARM_SENTINEL_BASE",
                         str(tmp_path / "prewarmed"))
     return path
 
@@ -287,15 +287,21 @@ def test_cacheable_rejects_prewarm_step_count(cache_path, monkeypatch):
 
 def test_emit_writes_prewarm_sentinel_on_accelerator_success(
         cache_path, capsys, monkeypatch):
-    """Any successful on-chip trial (flagship or variant) marks the XLA
-    cache warm; cpu/stale/error results must not."""
-    sentinel = bench._PREWARM_SENTINEL  # fixture points it at tmp_path
+    """Any successful on-chip trial (flagship or variant) marks its
+    MODEL's XLA cache warm; cpu/stale/error results must not, and a
+    transformer run must not mark the resnet flagship program warm."""
+    sentinel = bench._prewarm_sentinel("resnet50")  # base is at tmp_path
     monkeypatch.setenv("BENCH_RUN_ID", "rid-1")
     bench._emit(CPU_SMOKE)
     assert not os.path.exists(sentinel)
     bench._emit({**TPU_RESULT, "stale": True}, persist=False)
     assert not os.path.exists(sentinel)
-    # a VARIANT on-chip run (not cacheable) still warms the cache
+    # a transformer success warms only the transformer program's slot
+    bench._emit({"metric": "transformer_lm_train_throughput", "value": 1e5,
+                 "platform": "axon", "seq_len": 1024, "per_chip_batch": 8})
+    assert not os.path.exists(sentinel)
+    assert os.path.exists(bench._prewarm_sentinel("transformer"))
+    # a VARIANT on-chip resnet run (not cacheable) still warms the cache
     bench._emit({**TPU_RESULT, "layout": "NCHW"})
     assert os.path.exists(sentinel)
     capsys.readouterr()
@@ -311,12 +317,12 @@ def test_default_deadline_extends_when_cache_cold(tmp_path):
     import sys
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sentinel = tmp_path / "prewarmed"
+    base = tmp_path / "prewarmed"
 
     def deadline(env_extra):
-        env = dict(os.environ,
-                   BENCH_PREWARM_SENTINEL=str(sentinel), **env_extra)
+        env = dict(os.environ, BENCH_PREWARM_SENTINEL=str(base))
         env.pop("BENCH_DEADLINE_S", None)
+        env.pop("BENCH_MODEL", None)
         env.update(env_extra)
         out = subprocess.run(
             [sys.executable, "-c", "import bench; print(bench._DEADLINE_S)"],
@@ -325,8 +331,10 @@ def test_default_deadline_extends_when_cache_cold(tmp_path):
         return float(out.stdout.strip())
 
     assert deadline({}) == 480.0
-    sentinel.write_text("rid 0\n")
+    (tmp_path / "prewarmed.resnet50").write_text("rid 0\n")
     assert deadline({}) == 270.0
+    # the warm resnet sentinel does not cover the transformer program
+    assert deadline({"BENCH_MODEL": "transformer"}) == 480.0
     assert deadline({"BENCH_DEADLINE_S": "123"}) == 123.0
 
 
