@@ -58,7 +58,7 @@ def bind_signatures(lib):
     lib.loader_create.restype = ctypes.c_void_p
     lib.loader_create.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
     lib.loader_submit.restype = ctypes.c_int
     lib.loader_submit.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
@@ -73,7 +73,13 @@ def bind_signatures(lib):
 
 
 class NativeLoader:
-    """One gather engine over a contiguous [N, ...] numpy array."""
+    """One gather engine over a contiguous [N, ...] numpy array.
+
+    Ring-slot memory is allocated HERE as a numpy array and lent to the
+    C++ engine: batch views are numpy slices whose ``.base`` chain keeps
+    the ring alive, so a view held past ``close()`` (or interpreter
+    shutdown teardown order) can go stale in CONTENT but never dangle —
+    the use-after-free class of bugs is excluded by ownership."""
 
     def __init__(self, array: np.ndarray, max_batch: int, n_buffers=3,
                  n_threads=4):
@@ -87,10 +93,13 @@ class NativeLoader:
         self._row_bytes = int(self._array.dtype.itemsize
                               * np.prod(self.row_shape, dtype=np.int64))
         self.max_batch = max_batch
+        self._ring = np.empty((n_buffers, max_batch * self._row_bytes),
+                              dtype=np.uint8)
         self._handle = lib.loader_create(
             self._array.ctypes.data_as(ctypes.c_void_p),
             self._array.shape[0], self._row_bytes, max_batch,
-            n_buffers, n_threads)
+            n_buffers, n_threads,
+            self._ring.ctypes.data_as(ctypes.c_void_p))
 
     def submit(self, indices: np.ndarray):
         idx = np.ascontiguousarray(indices, dtype=np.int64)
@@ -108,9 +117,10 @@ class NativeLoader:
         if buf_id < 0:
             raise RuntimeError("loader stopped")
         n = rows.value
-        raw = (ctypes.c_char * (n * self._row_bytes)).from_address(ptr.value)
-        view = np.frombuffer(raw, dtype=self.dtype).reshape(
-            (n,) + self.row_shape)
+        # slice of the python-owned ring (not a raw-pointer frombuffer):
+        # the view's .base keeps the memory alive beyond close()
+        view = self._ring[buf_id, :n * self._row_bytes] \
+            .view(self.dtype).reshape((n,) + self.row_shape)
         return view, buf_id
 
     def next(self) -> np.ndarray:

@@ -36,7 +36,12 @@ struct Loader {
     size_t max_batch = 0;
     int n_buffers = 0;
 
-    std::vector<std::vector<uint8_t>> buffers;
+    // Ring slots.  `buffers` are raw slot pointers; when the caller
+    // supplies the ring memory (the Python binding passes a numpy-owned
+    // block), `owned` stays empty and destroy() never frees the slots —
+    // so a consumer-held view can never dangle, whatever its lifetime.
+    std::vector<uint8_t*> buffers;
+    std::vector<std::vector<uint8_t>> owned;
     std::vector<size_t> buffer_rows;  // rows filled per buffer
 
     // free buffer pool / pending jobs / completed buffers
@@ -73,7 +78,7 @@ struct Loader {
 void gather_rows(Loader* L) {
     // workers cooperatively pull row ranges of the current job
     const size_t chunk = 64;
-    uint8_t* dst = L->buffers[L->current.buffer_id].data();
+    uint8_t* dst = L->buffers[L->current.buffer_id];
     const size_t n = L->current.indices.size();
     for (;;) {
         size_t start = L->next_row.fetch_add(chunk);
@@ -160,8 +165,12 @@ void worker_main(Loader* L, bool leader) {
 
 extern "C" {
 
+// `ring`: optional caller-owned slot memory (n_buffers contiguous slots
+// of max_batch*row_bytes each).  NULL = loader-allocated (freed on
+// destroy; callers must then drop every view before destroy).
 void* loader_create(const void* data, int64_t n_rows, int64_t row_bytes,
-                    int64_t max_batch, int n_buffers, int n_threads) {
+                    int64_t max_batch, int n_buffers, int n_threads,
+                    void* ring) {
     Loader* L = new Loader();
     L->data = static_cast<const uint8_t*>(data);
     L->n_rows = static_cast<size_t>(n_rows);
@@ -171,10 +180,20 @@ void* loader_create(const void* data, int64_t n_rows, int64_t row_bytes,
     L->n_threads = n_threads > 0 ? n_threads : 1;
     L->buffers.resize(n_buffers);
     L->buffer_rows.resize(n_buffers, 0);
-    for (int i = 0; i < n_buffers; ++i) {
-        L->buffers[i].resize(L->max_batch * L->row_bytes);
-        L->free_buffers.push_back(i);
+    const size_t slot_bytes = L->max_batch * L->row_bytes;
+    if (ring != nullptr) {
+        uint8_t* base = static_cast<uint8_t*>(ring);
+        for (int i = 0; i < n_buffers; ++i)
+            L->buffers[i] = base + static_cast<size_t>(i) * slot_bytes;
+    } else {
+        L->owned.resize(n_buffers);
+        for (int i = 0; i < n_buffers; ++i) {
+            L->owned[i].resize(slot_bytes);
+            L->buffers[i] = L->owned[i].data();
+        }
     }
+    for (int i = 0; i < n_buffers; ++i)
+        L->free_buffers.push_back(i);
     L->workers.emplace_back(worker_main, L, true);
     for (int t = 1; t < L->n_threads; ++t)
         L->workers.emplace_back(worker_main, L, false);
@@ -216,7 +235,7 @@ int loader_next(void* handle, void** out_ptr, int64_t* out_rows) {
     if (L->stop.load() && L->completed.empty()) return -1;
     int id = L->completed.front();
     L->completed.pop_front();
-    *out_ptr = L->buffers[id].data();
+    *out_ptr = L->buffers[id];
     *out_rows = static_cast<int64_t>(L->buffer_rows[id]);
     return id;
 }

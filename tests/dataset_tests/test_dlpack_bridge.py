@@ -81,6 +81,45 @@ def test_native_iterator_zero_copy_handoff():
     np.testing.assert_array_equal(full, data)
 
 
+def test_zero_copy_view_outlives_loader():
+    """Ring memory is python-owned (numpy), lent to the C++ engine: a
+    view held past finalize() may go STALE in content but must never
+    dangle.  Regression for a shutdown segfault: zero_copy batches still
+    referenced when the loader closed dereferenced freed C++ heap."""
+    from chainermn_tpu.utils.native import NativeLoader, load_library
+    if load_library() is None:
+        pytest.skip("native loader unavailable")
+    data = np.arange(80, dtype=np.float32).reshape(20, 4)
+    loader = NativeLoader(data, 5, n_buffers=2)
+    loader.submit(np.arange(5, dtype=np.int64))
+    view, buf_id = loader.next_view()
+    # the view must alias the PYTHON-owned ring — the load-bearing
+    # assertion: a regression back to C++-owned buffers (raw-pointer
+    # frombuffer) would pass the post-close read below, because freed
+    # heap pages are usually still mapped outside ASAN
+    assert np.shares_memory(view, loader._ring), \
+        "zero-copy view does not alias the python-owned ring"
+    expect = view.copy()
+    loader.release(buf_id)
+    loader.close()  # destroys the C++ engine while `view` is still held
+    # reading the held view after close must be safe: memory stays valid
+    # via numpy ownership (content is whatever the last fill left — no
+    # new fill happened after our batch, so it is still our batch)
+    np.testing.assert_array_equal(view, expect)
+
+    # and the full zero_copy iterator flow stays alive through the same
+    # sequence (jax may import the DLPack capsule by copy or by alias;
+    # either way nothing may crash)
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    labels = np.arange(20, dtype=np.int32)
+    it = NativeBatchIterator((data, labels), 5, shuffle=False,
+                             zero_copy=True, n_prefetch=1)
+    x, t = it.next()
+    it.finalize()
+    assert np.asarray(x).shape == (5, 4)
+    assert np.isfinite(np.asarray(x)).all()
+
+
 def test_serializer_uses_bridge(tmp_path):
     from chainermn_tpu.serializers.npz import DictionarySerializer
     s = DictionarySerializer()

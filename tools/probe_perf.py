@@ -202,6 +202,67 @@ def probe_prefetch_overhead():
         "note": "serializer aliases _order (no O(dataset) copy/batch)"}))
 
 
+def probe_input_pipeline():
+    """Host input-pipeline bandwidth at flagship scale (VERDICT r4 Weak
+    #6) — no chip needed.  Can the native gather engine assemble
+    224²×bs-256 ImageNet batches faster than the chip consumes them on
+    this host?  Demand side: r2 measured 2022 img/s (7.9 batches/s);
+    the 25-30% MFU target needs ~4-5k img/s (15.6-19.5 batches/s).
+
+    Measured per mode:
+      * uint8 gather (the TPU-idiomatic pipeline: ship uint8, cast to
+        bf16 on device — 38.5 MB/batch host traffic)
+      * uint8 gather + host float32 cast (the reference's CPU-side
+        ``concat_examples`` convention — 154 MB/batch more host writes)
+      * zero_copy ring hand-off (DLPack aliasing the C++ ring slot)
+    """
+    from chainermn_tpu.dataset import NativeBatchIterator, TupleDataset
+
+    n_img = int(os.environ.get("PROBE_N_IMG", "2048"))
+    bs = int(os.environ.get("PROBE_BS", "256"))
+    n_batches = int(os.environ.get("PROBE_BATCHES", "40"))
+    rng = np.random.RandomState(0)
+    # dtype-direct draw: no 8x transient int64 intermediate, full range
+    x = rng.randint(0, 256, (n_img, 224, 224, 3), dtype=np.uint8)
+    t = rng.randint(0, 1000, n_img).astype(np.int32)
+    batch_mb = bs * x[0].nbytes / 1e6
+    demand_r2 = 2022.0 / bs
+    demand_mfu = 4500.0 / bs
+
+    def run(tag, zero_copy, cast_f32):
+        it = NativeBatchIterator(TupleDataset(x, t), bs, shuffle=True,
+                                 seed=0, n_prefetch=2,
+                                 n_threads=max(1, os.cpu_count() or 1),
+                                 zero_copy=zero_copy)
+        try:
+            for _ in range(4):  # warm the ring
+                it.next()
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                xb, tb = it.next()
+                if cast_f32:
+                    xb = np.asarray(xb).astype(np.float32)
+                # touch one element so a lazy view cannot cheat the timer
+                _ = xb.reshape(-1)[0] if hasattr(xb, "reshape") else xb
+            dt = (time.perf_counter() - t0) / n_batches
+        finally:
+            it.finalize()
+        bps = 1.0 / dt
+        print(json.dumps({
+            "probe": "input_pipeline", "mode": tag, "batch_size": bs,
+            "image_mb_per_batch": round(batch_mb, 1),
+            "batches_per_sec": round(bps, 2),
+            "images_per_sec": round(bps * bs, 0),
+            "gather_mb_per_sec": round(bps * batch_mb, 0),
+            "margin_vs_r2_throughput": round(bps / demand_r2, 2),
+            "margin_vs_mfu_target_4500ips": round(bps / demand_mfu, 2),
+        }), flush=True)
+
+    run("uint8_gather", zero_copy=False, cast_f32=False)
+    run("uint8_gather_f32cast", zero_copy=False, cast_f32=True)
+    run("uint8_zero_copy", zero_copy=True, cast_f32=False)
+
+
 def probe_flashcmp():
     """Flash (Pallas) vs xla_attention payoff, quantified (VERDICT r3
     Missing #3): causal self-attention fwd+bwd at GPT-2-small geometry,
@@ -263,5 +324,7 @@ if __name__ == "__main__":
         probe_resnet(int(os.environ.get("PROBE_SCAN", "8")))
     if which == "prefetch":
         probe_prefetch_overhead()
+    if which == "input_pipeline":
+        probe_input_pipeline()
     if which == "flashcmp":
         probe_flashcmp()

@@ -51,18 +51,46 @@ def submit(h, idx):
     assert lib.loader_submit(h, idx.ctypes.data_as(
         ctypes.POINTER(ctypes.c_int64)), idx.size) == 0
 
-h = lib.loader_create(data.ctypes.data, 512, 64, 64, 2, 4)
-for step in range(200):
-    idx = rng.randint(0, 512, 64)
-    submit(h, idx)
-    ptr, rows = ctypes.c_void_p(), ctypes.c_int64()
-    bid = lib.loader_next(h, ctypes.byref(ptr), ctypes.byref(rows))
-    assert bid >= 0 and rows.value == 64
-    lib.loader_release(h, bid)
+# churn leg runs BOTH ownership modes: loader-owned (ring=NULL) and the
+# caller-owned ring the Python binding always uses in production
+for ring in (None, np.empty((2, 64 * 64), np.uint8)):
+    ring_ptr = (ring.ctypes.data_as(ctypes.c_void_p)
+                if ring is not None else None)
+    h = lib.loader_create(data.ctypes.data, 512, 64, 64, 2, 4, ring_ptr)
+    for step in range(200):
+        idx = rng.randint(0, 512, 64)
+        submit(h, idx)
+        ptr, rows = ctypes.c_void_p(), ctypes.c_int64()
+        bid = lib.loader_next(h, ctypes.byref(ptr), ctypes.byref(rows))
+        assert bid >= 0 and rows.value == 64
+        lib.loader_release(h, bid)
+    lib.loader_destroy(h)
+
+# the ownership property itself, under ASAN: with a CALLER-owned ring a
+# view read AFTER loader_destroy must be legal (the memory is ours); a
+# regression back to loader-freed ring memory turns this into a
+# heap-use-after-free report
+ring = np.empty((2, 64 * 64), np.uint8)
+h = lib.loader_create(data.ctypes.data, 512, 64, 64, 2, 4,
+                      ring.ctypes.data_as(ctypes.c_void_p))
+submit(h, rng.randint(0, 512, 64))
+ptr, rows = ctypes.c_void_p(), ctypes.c_int64()
+bid = lib.loader_next(h, ctypes.byref(ptr), ctypes.byref(rows))
+assert bid >= 0 and rows.value == 64
+view = np.frombuffer(
+    (ctypes.c_char * (64 * 64)).from_address(ptr.value),
+    dtype=np.float32).copy  # bind the address, defer the read
+lib.loader_release(h, bid)
 lib.loader_destroy(h)
+_ = view()  # read the slot after destroy: legal iff caller-owned
+assert _.size == 64 * 16
 
 for trial in range(30):
-    h = lib.loader_create(data.ctypes.data, 512, 64, 64, 3, 4)
+    # alternate caller-owned vs loader-owned ring memory
+    ring = np.empty((3, 64 * 64), np.uint8) if trial % 3 else None
+    h = lib.loader_create(data.ctypes.data, 512, 64, 64, 3, 4,
+                          ring.ctypes.data_as(ctypes.c_void_p)
+                          if ring is not None else None)
     for _ in range(3):
         submit(h, rng.randint(0, 512, 64))
     if trial % 2:
