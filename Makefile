@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch dryrun examples clean
+.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch watch-status dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -38,6 +38,17 @@ watch:            ## start the detached TPU relay recovery watcher (idempotent)
 	@pgrep -f "[t]pu_relay_watch.sh" > /dev/null && echo "watcher already running:" || \
 	  (setsid nohup bash tools/tpu_relay_watch.s''h > /tmp/tpu_watch.log 2>&1 < /dev/null &) ; \
 	sleep 1; pgrep -f "[t]pu_relay_watch.sh"
+
+watch-status:     ## round-start checklist: watcher liveness + probe + queue state
+	@pgrep -af "[t]pu_relay_watch.sh" || echo "WATCHER DEAD -- run: make watch"
+	@if pgrep -f "[t]pu_probe.py" > /dev/null; then \
+	  echo "probe IN FLIGHT (stderr mtime = launch time):"; \
+	  stat -c '  %y' /tmp/tpu_probe_last.err 2>/dev/null || true; \
+	else echo "no probe in flight"; fi
+	@echo "last probe result: $$(cat /tmp/tpu_probe_last.json 2>/dev/null | tail -c 300)"
+	@if [ -s tpu_recovery_run.log ]; then \
+	  echo "recovery queue log tail:"; tail -3 tpu_recovery_run.log; \
+	else echo "recovery queue has NOT fired"; fi
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

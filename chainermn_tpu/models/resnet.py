@@ -15,9 +15,18 @@ Freshly designed for TPU rather than transcribed:
   reference era's fp16 training recipe.
 * Identity shortcuts use stride-slicing + channel-pad (option A) or
   projection (option B, the ResNet-50 default), all fusible.
+* ``input_norm="imagenet"`` moves input normalization IN-GRAPH: the host
+  pipeline ships raw uint8 pixels and the cast + per-channel standardize
+  fuses into the first conv on device.  Measured motivation (BENCH_NOTES
+  r5 input-pipeline probe): host-side float32 casting caps the one-core
+  input pipeline at ~2.6k img/s — below the 25-30% MFU target's ~4.5k
+  img/s demand — while the uint8 gather sustains ~9k img/s; shipping
+  uint8 also cuts host→HBM DMA traffic 4×.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax.numpy as jnp
 
@@ -26,7 +35,50 @@ from ..nn import functions as F
 from ..nn import links as L
 
 __all__ = ["ResNet50", "ResNet18", "ResNet101", "BottleneckBlock",
-           "BasicBlock"]
+           "BasicBlock", "IMAGENET_MEAN", "IMAGENET_STD"]
+
+# ImageNet channel statistics in 0-1 scale (the standard ImageNet
+# normalization the reference's example pipeline applies on HOST per
+# image; here the same math runs in-graph over 0-255 inputs)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _input_norm_consts(input_norm):
+    """(scale, bias) folding 0-255→0-1 and channel standardization into
+    one multiply-add: y = x·scale + bias ≡ (x/255 − mean)/std.  Returns
+    None for ``input_norm=None`` (inputs already normalized floats)."""
+    if input_norm is None:
+        return None
+    if isinstance(input_norm, str):
+        if input_norm != "imagenet":
+            raise ValueError(
+                f"unknown input_norm preset {input_norm!r}; valid: "
+                "'imagenet', None, or a (mean, std) pair in 0-1 scale")
+        mean, std = IMAGENET_MEAN, IMAGENET_STD
+    else:  # (mean, std) pair in 0-1 scale
+        mean, std = input_norm
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    return 1.0 / (255.0 * std), -mean / std
+
+
+def _normalize_input(x, consts, layout, compute_dtype):
+    """Cast + (optionally) standardize on DEVICE, inside the compiled
+    step: constants fold, XLA fuses the multiply-add into the first
+    conv's input, and uint8 host→device transfers stay uint8.  The
+    multiply-add itself runs in float32 and only the RESULT casts to
+    ``compute_dtype`` — matching the host-normalized pipeline's
+    precision (one rounding, not a bf16 FMA over bf16-rounded
+    constants)."""
+    if consts is None:
+        return x.astype(compute_dtype) if compute_dtype is not None else x
+    scale, bias = consts
+    shape = (1, 1, 1, 3) if layout == "NHWC" else (1, 3, 1, 1)
+    out = (x.astype(jnp.float32)
+           * jnp.asarray(scale, jnp.float32).reshape(shape)
+           + jnp.asarray(bias, jnp.float32).reshape(shape))
+    return out.astype(compute_dtype) if compute_dtype is not None else out
 
 
 class ConvBN(Chain):
@@ -122,11 +174,13 @@ class _Stage(ChainList):
 
 class ResNet(Chain):
     def __init__(self, block_counts, n_classes=1000, compute_dtype=None,
-                 seed=42, remat=False, layout="NCHW"):
+                 seed=42, remat=False, layout="NCHW", input_norm=None):
         super().__init__()
         self.compute_dtype = compute_dtype
         self.remat = remat
         self.layout = layout
+        self.input_norm = input_norm
+        self._in_consts = _input_norm_consts(input_norm)
         with self.init_scope():
             self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed,
                                 layout=layout)
@@ -169,8 +223,8 @@ class ResNet(Chain):
         return out
 
     def forward(self, x):
-        if self.compute_dtype is not None:
-            x = x.astype(self.compute_dtype)
+        x = _normalize_input(x, self._in_consts, self.layout,
+                             self.compute_dtype)
         h = self.conv1(x)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False,
                              layout=self.layout)
@@ -184,22 +238,27 @@ class ResNet(Chain):
 
 class ResNet50(ResNet):
     def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
-                 remat=False, layout="NCHW"):
+                 remat=False, layout="NCHW", input_norm=None):
         super().__init__([3, 4, 6, 3], n_classes, compute_dtype, seed,
-                         remat=remat, layout=layout)
+                         remat=remat, layout=layout,
+                         input_norm=input_norm)
 
 
 class ResNet101(ResNet):
     def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
-                 remat=False, layout="NCHW"):
+                 remat=False, layout="NCHW", input_norm=None):
         super().__init__([3, 4, 23, 3], n_classes, compute_dtype, seed,
-                         remat=remat, layout=layout)
+                         remat=remat, layout=layout,
+                         input_norm=input_norm)
 
 
 class ResNet18(Chain):
-    def __init__(self, n_classes=1000, compute_dtype=None, seed=42):
+    def __init__(self, n_classes=1000, compute_dtype=None, seed=42,
+                 input_norm=None):
         super().__init__()
         self.compute_dtype = compute_dtype
+        self.input_norm = input_norm
+        self._in_consts = _input_norm_consts(input_norm)
         cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
         with self.init_scope():
             self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
@@ -213,8 +272,8 @@ class ResNet18(Chain):
             self.fc = L.Linear(512, n_classes, seed=seed + 999)
 
     def forward(self, x):
-        if self.compute_dtype is not None:
-            x = x.astype(self.compute_dtype)
+        x = _normalize_input(x, self._in_consts, "NCHW",
+                             self.compute_dtype)
         h = self.conv1(x)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)
         for block in self.body:

@@ -62,7 +62,16 @@ def main():
                              "reduce-scatter grads, 1/n-chunk momentum "
                              "+ update, all-gather params — same "
                              "trajectory as plain DP, 1/n state memory")
+    parser.add_argument("--uint8-input", action="store_true",
+                        help="ship raw uint8 pixels and normalize "
+                             "IN-GRAPH on device (resnet50 only) — the "
+                             "measured input-pipeline fix: host f32 "
+                             "casting caps at ~2.6k img/s on one core, "
+                             "uint8 gather sustains ~9k (BENCH_NOTES r5)")
     args = parser.parse_args()
+    if args.uint8_input and args.arch != "resnet50":
+        parser.error("--uint8-input requires --arch resnet50 "
+                     "(in-graph input_norm)")
 
     if args.simulate_devices:
         from chainermn_tpu.utils import simulate_devices
@@ -73,9 +82,10 @@ def main():
 
     comm = ct.create_communicator(args.communicator,
                                   allreduce_grad_dtype=args.grad_dtype)
-    archs = {"resnet50": lambda: ResNet50(compute_dtype=jnp.bfloat16,
-                                          remat=args.remat,
-                                          layout=args.layout),
+    archs = {"resnet50": lambda: ResNet50(
+                 compute_dtype=jnp.bfloat16, remat=args.remat,
+                 layout=args.layout,
+                 input_norm="imagenet" if args.uint8_input else None),
              "alex": AlexNet, "nin": NIN, "vgg16": VGG16,
              "googlenet": GoogLeNet}
     nhwc = args.arch == "resnet50" and args.layout == "NHWC"
@@ -90,7 +100,9 @@ def main():
         zero_sharding=args.zero).setup(model)
     optimizer.add_hook(ct.core.WeightDecay(1e-4))
 
-    train = get_synthetic_imagenet(n=args.n_train, size=args.size)
+    train = get_synthetic_imagenet(
+        n=args.n_train, size=args.size,
+        dtype="uint8" if args.uint8_input else "float32")
     if nhwc:
         from chainermn_tpu.dataset import TransformDataset
         train = TransformDataset(

@@ -41,6 +41,44 @@ def test_resnet50_bf16_compute():
     assert np.isfinite(np.asarray(y)).all()
 
 
+def test_resnet50_uint8_input_norm_matches_host_normalized():
+    """input_norm='imagenet' over raw uint8 pixels must equal the same
+    weights fed host-normalized float32 ((x/255 - mean)/std) — the
+    in-graph path exists so the pipeline can ship uint8 and cast on
+    device (BENCH_NOTES r5 input-pipeline probe)."""
+    from chainermn_tpu.models.resnet import IMAGENET_MEAN, IMAGENET_STD
+
+    rng = np.random.RandomState(0)
+    x8 = rng.randint(0, 256, (2, 3, 64, 64)).astype(np.uint8)
+    mean = np.asarray(IMAGENET_MEAN, np.float32).reshape(1, 3, 1, 1)
+    std = np.asarray(IMAGENET_STD, np.float32).reshape(1, 3, 1, 1)
+    xf = (x8.astype(np.float32) / 255.0 - mean) / std
+
+    m_u8 = ResNet50(n_classes=10, seed=0, input_norm="imagenet")
+    m_f = ResNet50(n_classes=10, seed=0)
+    y_u8 = np.asarray(m_u8(jnp.asarray(x8)))
+    y_f = np.asarray(m_f(jnp.asarray(xf)))
+    np.testing.assert_allclose(y_u8, y_f, rtol=2e-4, atol=2e-4)
+    # NHWC layout flavor keeps the same math
+    m_u8n = ResNet50(n_classes=10, seed=0, input_norm="imagenet",
+                     layout="NHWC")
+    y_u8n = np.asarray(m_u8n(jnp.asarray(
+        np.transpose(x8, (0, 2, 3, 1)))))
+    np.testing.assert_allclose(y_u8n, y_f, rtol=2e-4, atol=2e-4)
+    # bf16 flavor: the in-graph normalize runs in f32 and casts only the
+    # result, so it must track the host-normalized bf16 model within
+    # bf16 rounding (not merely stay finite)
+    m_b = ResNet50(n_classes=10, seed=0, input_norm="imagenet",
+                   compute_dtype=jnp.bfloat16)
+    m_bf = ResNet50(n_classes=10, seed=0, compute_dtype=jnp.bfloat16)
+    y_b = np.asarray(m_b(jnp.asarray(x8)))
+    y_bf = np.asarray(m_bf(jnp.asarray(xf)))
+    np.testing.assert_allclose(y_b, y_bf, rtol=5e-2, atol=5e-2)
+    # misspelled preset fails loudly at construction
+    with pytest.raises(ValueError, match="input_norm preset"):
+        ResNet50(n_classes=10, input_norm="ImageNet")
+
+
 def test_resnet18_trains_on_synthetic_cifar():
     model = Classifier(ResNet18(n_classes=10, seed=0))
     opt = Adam().setup(model)
