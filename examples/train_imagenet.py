@@ -68,6 +68,11 @@ def main():
                              "measured input-pipeline fix: host f32 "
                              "casting caps at ~2.6k img/s on one core, "
                              "uint8 gather sustains ~9k (BENCH_NOTES r5)")
+    parser.add_argument("--native-loader", action="store_true",
+                        help="assemble batches with the C++ gather "
+                             "engine (NativeBatchIterator); pair with "
+                             "--uint8-input for the full measured-fast "
+                             "host pipeline")
     args = parser.parse_args()
     if args.uint8_input and args.arch != "resnet50":
         parser.error("--uint8-input requires --arch resnet50 "
@@ -108,29 +113,42 @@ def main():
         train = TransformDataset(
             train, lambda ex: (ex[0].transpose(1, 2, 0), ex[1]))
     train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
-    train_iter = MultithreadIterator(train, args.batchsize * comm.size)
 
-    converter = None
+    from chainermn_tpu.dataset import concat_examples, identity_converter
+    converter = concat_examples  # both updaters' default
+    if args.native_loader:
+        # C++ gather engine over the materialized local shard: batches
+        # arrive pre-stacked (x, t) tuples, so downstream converters are
+        # identity.  With --uint8-input the rows stay uint8 end to end
+        # and the cast happens in-graph on device — the full
+        # measured-fast pipeline (BENCH_NOTES r5).
+        from chainermn_tpu.dataset import NativeBatchIterator
+        xs, ys = concat_examples([train[i] for i in range(len(train))])
+        train_iter = NativeBatchIterator((xs, ys),
+                                         args.batchsize * comm.size,
+                                         seed=0)
+        converter = identity_converter
+    else:
+        train_iter = MultithreadIterator(train,
+                                         args.batchsize * comm.size)
+
     if args.device_prefetch and not args.fused:
         # device-feed stage: the next batch's host->device DMA overlaps
         # this step's compute (FusedUpdater stacks K batches itself, so
         # per-batch prefetch placement doesn't apply there)
-        from chainermn_tpu.dataset import (DevicePrefetchIterator,
-                                           concat_examples,
-                                           identity_converter)
+        from chainermn_tpu.dataset import DevicePrefetchIterator
         train_iter = DevicePrefetchIterator(
             train_iter, size=args.device_prefetch,
-            converter=concat_examples)
+            converter=None if args.native_loader else concat_examples)
         converter = identity_converter
 
     if args.fused:
         from chainermn_tpu.training import FusedUpdater
-        updater = FusedUpdater(train_iter, optimizer, n_fused=args.fused)
-    elif converter is not None:
+        updater = FusedUpdater(train_iter, optimizer, n_fused=args.fused,
+                               converter=converter)
+    else:
         updater = StandardUpdater(train_iter, optimizer,
                                   converter=converter)
-    else:
-        updater = StandardUpdater(train_iter, optimizer)
     stop = (args.iterations, "iteration") if args.iterations \
         else (args.epoch, "epoch")
     trainer = Trainer(updater, stop, out=args.out)

@@ -68,7 +68,7 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
     notes_text = notes.read_text()
-    assert "Round-4 on-chip results" in notes_text
+    assert "On-chip results" in notes_text
     # all 7 bench steps recorded, each once, in queue order
     expected = [
         "resnet50-bsd-d-scand-seqd",       # prewarm (default knobs)
@@ -85,7 +85,7 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     # (the fold must precede the unsupervised wedge-capable steps)
     assert notes_text.count('"flash_vs_xla"') == 2
     assert "Flash-vs-XLA attention rows" in notes_text
-    assert notes_text.index("Round-4 on-chip results") \
+    assert notes_text.index("On-chip results") \
         < notes_text.index("Flash-vs-XLA attention rows")
     # isolation: preliminary lines and the old run's rows are excluded
     assert '"prelim"' not in notes_text
@@ -137,7 +137,7 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
                           text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr[-2000:]
     notes_text = notes.read_text()
-    assert "Round-4 on-chip results" in notes_text
+    assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
                 if '"final"' in ln]) == 7
     assert "Flash-vs-XLA" not in notes_text

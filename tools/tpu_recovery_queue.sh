@@ -68,7 +68,7 @@ run_one "transformer bs2 seq8192 remat" \
 # a wedge there must not cost the seven recorded bench rows.
 {
   echo ""
-  echo "## Round-4 on-chip results (auto-recorded by tpu_recovery_queue at $(date -u))"
+  echo "## On-chip results (auto-recorded by tpu_recovery_queue at $(date -u))"
   echo ""
   echo '```'
   cat "$RESULTS"
