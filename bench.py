@@ -138,7 +138,8 @@ _METRIC_TO_MODEL = {
 _DEFAULT_FINGERPRINTS = {
     "resnet50": {"model": "resnet50", "bs": DEFAULT_BS,
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
-                 "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS},
+                 "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
+                 "input_pipeline": False},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -197,6 +198,8 @@ def _config_fingerprint(model=None):
         "scan": _env_int("BENCH_SCAN", 0),
         "remat": os.environ.get("BENCH_REMAT", "0") == "1",
         "n_steps": _env_int("BENCH_STEPS", DEFAULT_STEPS),
+        "input_pipeline":
+            os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1",
     }
 
 
@@ -236,6 +239,7 @@ def _cacheable(result):
                 # (queue step 1, BENCH_STEPS=4) measures amortization, not
                 # throughput — tolerate only legacy entries lacking the key
                 and result.get("n_steps", DEFAULT_STEPS) == DEFAULT_STEPS
+                and not result.get("input_pipeline", False)
                 and DEFAULT_BS // 4 <= result.get("per_chip_batch", 0)
                 <= DEFAULT_BS)
     return (result.get("seq_len", 0) == DEFAULT_SEQ
@@ -537,6 +541,25 @@ def _run_bench():
     scan_k = int(os.environ.get("BENCH_SCAN", "0"))
     # activation layout: NHWC is the TPU-native convolution layout
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
+    # BENCH_INPUT_PIPELINE=1: feed each step from the REAL host pipeline
+    # (uint8 synthetic rows → NativeBatchIterator C++ gather →
+    # DevicePrefetchIterator async placement → in-graph input_norm cast)
+    # instead of one pre-staged device batch — measures on chip how much
+    # of the host feed the async dispatch actually hides (the
+    # delta vs the pre-staged flagship row is the exposed input cost)
+    input_pipeline = os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1"
+    if input_pipeline and scan_k:
+        raise ValueError("BENCH_INPUT_PIPELINE measures the per-step "
+                         "host feed; BENCH_SCAN pre-stacks batches — "
+                         "the two modes are mutually exclusive")
+    if input_pipeline:
+        # fail fast: a missing native loader must not burn deadline
+        # budget on the OOM-backoff loop's model rebuilds
+        from chainermn_tpu.utils.native import load_library
+        if load_library() is None:
+            raise RuntimeError(
+                "BENCH_INPUT_PIPELINE=1 requires the native loader "
+                "(g++ toolchain) — unavailable on this host")
 
     devices = jax.devices()  # raises if the backend is unavailable
     n_devices = len(devices)
@@ -558,6 +581,7 @@ def _run_bench():
             "layout": layout,
             "remat": remat,
             "n_steps": n_steps,
+            "input_pipeline": input_pipeline,
             "compile_s": round(compile_s, 1),
             "fused_steps_per_dispatch": scan_k or 1,
         }
@@ -572,9 +596,10 @@ def _run_bench():
         global_bs = per_chip_bs * n_devices
         comm = ct.create_communicator("jax_ici",
                                       allreduce_grad_dtype="bfloat16")
-        model = Classifier(ResNet50(n_classes=1000, remat=remat,
-                                    compute_dtype=jnp.bfloat16, seed=0,
-                                    layout=layout))
+        model = Classifier(ResNet50(
+            n_classes=1000, remat=remat, compute_dtype=jnp.bfloat16,
+            seed=0, layout=layout,
+            input_norm="imagenet" if input_pipeline else None))
         comm.bcast_data(model)
         inner = MomentumSGD(lr=0.1, momentum=0.9)
         inner.donate_params = True  # in-place param update (bench owns the model)
@@ -583,17 +608,29 @@ def _run_bench():
         rng = np.random.RandomState(0)
         shape = ((global_bs, image_size, image_size, 3) if layout == "NHWC"
                  else (global_bs, 3, image_size, image_size))
-        x = jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
-        t = jnp.asarray(rng.randint(0, 1000, global_bs).astype(np.int32))
 
-        if scan_k:
-            xs = jnp.broadcast_to(x, (scan_k,) + x.shape)
-            ts = jnp.broadcast_to(t, (scan_k,) + t.shape)
-            do_steps = lambda: opt.update_scan(model, xs, ts)[-1]
-            steps_per_call, calls = scan_k, max(1, n_steps // scan_k)
-        else:
-            do_steps = lambda: opt.update(model, x, t)
+        if input_pipeline:
+            from chainermn_tpu.dataset import (DevicePrefetchIterator,
+                                               NativeBatchIterator)
+            n_img = max(2 * global_bs, 256)
+            xs = rng.randint(0, 256, (n_img,) + shape[1:], dtype=np.uint8)
+            ys = rng.randint(0, 1000, n_img).astype(np.int32)
+            it = DevicePrefetchIterator(
+                NativeBatchIterator((xs, ys), global_bs, seed=0), size=2)
+            do_steps = lambda: opt.update(model, *it.next())
             steps_per_call, calls = 1, n_steps
+        else:
+            x = jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 1000, global_bs)
+                            .astype(np.int32))
+            if scan_k:
+                xs = jnp.broadcast_to(x, (scan_k,) + x.shape)
+                ts = jnp.broadcast_to(t, (scan_k,) + t.shape)
+                do_steps = lambda: opt.update_scan(model, xs, ts)[-1]
+                steps_per_call, calls = scan_k, max(1, n_steps // scan_k)
+            else:
+                do_steps = lambda: opt.update(model, x, t)
+                steps_per_call, calls = 1, n_steps
 
         def on_first(elapsed, compile_s):
             ips = calls * steps_per_call * global_bs / elapsed

@@ -263,6 +263,17 @@ def test_stale_reemit_never_repersists(cache_path, capsys, monkeypatch):
     capsys.readouterr()
 
 
+def test_cacheable_rejects_input_pipeline_variant(cache_path, monkeypatch):
+    """BENCH_INPUT_PIPELINE=1 measures the host feed, a different regime
+    than the pre-staged flagship row — both the env fingerprint and the
+    payload flag must keep it out of the last-good cache."""
+    monkeypatch.setenv("BENCH_INPUT_PIPELINE", "1")
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_INPUT_PIPELINE")
+    assert bench._cacheable(TPU_RESULT)
+    assert not bench._cacheable({**TPU_RESULT, "input_pipeline": True})
+
+
 def test_cacheable_rejects_prewarm_step_count(cache_path, monkeypatch):
     """ADVICE r4: the recovery queue's BENCH_STEPS=4 prewarm has
     different amortization than the 40-step flagship trial — it must not

@@ -55,6 +55,10 @@ run_one "resnet bs256 NCHW (layout comparison)" \
   BENCH_BS=256 BENCH_LAYOUT=NCHW BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 run_one "resnet bs256 NHWC scan8 (fused dispatch)" \
   BENCH_BS=256 BENCH_SCAN=8 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+# delta vs the bs64 flagship row = exposed host input cost on chip
+# (uint8 C++ gather -> async device placement -> in-graph cast)
+run_one "resnet bs64 real input pipeline (uint8 native gather)" \
+  BENCH_INPUT_PIPELINE=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 run_one "transformer bs2 seq8192 remat" \
