@@ -20,6 +20,35 @@ __all__ = ["Iterator", "SerialIterator", "MultiprocessIterator",
            "MultithreadIterator", "DevicePrefetchIterator"]
 
 
+def serialize_rng(serializer, rng):
+    """Write a ``np.random.RandomState``'s MT19937 state under the
+    shared key names every iterator uses (``rng_keys``/``rng_pos``/...)
+    — post-resume reshuffles then match the uninterrupted run exactly."""
+    _, keys, pos, has_gauss, cached = rng.get_state()
+    serializer("rng_keys", np.asarray(keys))
+    serializer("rng_pos", int(pos))
+    serializer("rng_has_gauss", int(has_gauss))
+    serializer("rng_cached_gaussian", float(cached))
+
+
+def deserialize_rng(serializer, rng):
+    """Restore :func:`serialize_rng`'s state; tolerates snapshots that
+    lack the keys (pre-feature, or written by an iterator class that
+    didn't save RNG state) by keeping the current state.  Returns True
+    when a state was restored."""
+    try:
+        keys = serializer("rng_keys", None)
+    except KeyError:
+        return False
+    if keys is None:
+        return False
+    rng.set_state(("MT19937", np.asarray(keys, np.uint32),
+                   int(serializer("rng_pos", 0)),
+                   int(serializer("rng_has_gauss", 0)),
+                   float(serializer("rng_cached_gaussian", 0.0))))
+    return True
+
+
 class Iterator:
     """Iterator protocol: ``__next__``, ``epoch``, ``is_new_epoch``, ``reset``."""
 
@@ -125,17 +154,14 @@ class SerialIterator(Iterator):
             self._order = np.asarray(order)
         self._previous_epoch_detail = float(serializer(
             "previous_epoch_detail", self._previous_epoch_detail))
-        # RNG state too (beyond the reference): post-resume reshuffles then
-        # match the uninterrupted run exactly — checkpoint fidelity is
-        # bit-exact, not just epoch-aligned
-        name, keys, pos, has_gauss, cached = self._rng.get_state()
-        keys = serializer("rng_keys", np.asarray(keys))
-        pos = serializer("rng_pos", pos)
-        has_gauss = serializer("rng_has_gauss", has_gauss)
-        cached = serializer("rng_cached_gaussian", cached)
-        if not serializer.is_writer and keys is not None:
-            self._rng.set_state((name, np.asarray(keys, np.uint32),
-                                 int(pos), int(has_gauss), float(cached)))
+        # RNG state too (beyond the reference): checkpoint fidelity is
+        # bit-exact, not just epoch-aligned (shared helpers so every
+        # iterator class reads/writes the same keys with the same
+        # missing-key tolerance)
+        if serializer.is_writer:
+            serialize_rng(serializer, self._rng)
+        else:
+            deserialize_rng(serializer, self._rng)
 
 
 class MultithreadIterator(Iterator):

@@ -67,38 +67,51 @@ class NativeBatchIterator(Iterator):
         return None
 
     # -- schedule ----------------------------------------------------------
-    def reset(self):
+    def _drain_pipeline(self):
+        """Release held slots and drain batches already submitted to the
+        C++ FIFO: otherwise a re-scheduled stream would start with the
+        OLD schedule's batches while reporting the new schedule's
+        positions (and each reset would leak n_prefetch ring slots)."""
         for loader, buf_id in getattr(self, "_held", []):
             try:
                 loader.release(buf_id)
             except Exception:
                 pass
         self._held = []
-        # drain batches already submitted to the C++ FIFO: otherwise the
-        # post-reset stream would start with the OLD schedule's batches
-        # while reporting the new schedule's positions (and each reset
-        # would leak n_prefetch ring slots)
         for _ in getattr(self, "_in_flight", []):
             for loader in self._loaders:
                 _, buf_id = loader.next_view()
                 loader.release(buf_id)
-        self.epoch = 0
-        self.is_new_epoch = False
-        self.current_position = 0
-        self._previous_epoch_detail = -1.0
-        self._order = (self._rng.permutation(self._n) if self._shuffle
-                       else np.arange(self._n))
         self._in_flight = []
+        self._sched_states = []
+
+    def _refill(self):
         self._exhausted = False
         for _ in range(self._n_prefetch):
             self._submit_next()
 
+    def reset(self):
+        self._drain_pipeline()
+        self.epoch = 0
+        self.is_new_epoch = False
+        self.current_position = 0
+        self._sched_epoch = 0
+        self._previous_epoch_detail = -1.0
+        self._order = (self._rng.permutation(self._n) if self._shuffle
+                       else np.arange(self._n))
+        self._refill()
+
     def _next_indices(self):
-        """Advance the schedule; returns (indices, epoch, is_new_epoch)."""
+        """Advance the schedule; returns (indices, epoch, is_new_epoch).
+        The epoch counter is the SCHEDULER's (``_sched_epoch``), not the
+        consumer-visible ``self.epoch``: submissions run ``n_prefetch``
+        ahead of consumption, and reading the consumer attribute here
+        would mis-number batches submitted across an epoch boundary
+        before the boundary batch is consumed."""
         i = self.current_position
         i_end = i + self.batch_size
         idx = self._order[i:i_end]
-        epoch, new_epoch = self.epoch, False
+        epoch, new_epoch = self._sched_epoch, False
         if i_end >= self._n:
             if self._repeat:
                 rest = i_end - self._n
@@ -112,6 +125,7 @@ class NativeBatchIterator(Iterator):
                 self.current_position = self._n
             epoch += 1
             new_epoch = True
+            self._sched_epoch = epoch
         else:
             self.current_position = i_end
         self.epoch_after = epoch
@@ -123,12 +137,17 @@ class NativeBatchIterator(Iterator):
         if not self._repeat and self.current_position >= self._n:
             self._exhausted = True
             return
+        # schedule state BEFORE this submission: the consumer-granular
+        # snapshot serialize() writes (oldest unconsumed batch's state)
+        state = (self.current_position, self._sched_epoch, self._order,
+                 self._rng.get_state())
         idx, epoch, new_epoch = self._next_indices()
         if idx.size == 0:
             self._exhausted = True
             return
         for loader in self._loaders:
             loader.submit(idx)
+        self._sched_states.append(state)
         self._in_flight.append((epoch, new_epoch,
                                 (self.current_position, self._n)))
 
@@ -137,6 +156,7 @@ class NativeBatchIterator(Iterator):
             raise StopIteration
         self._previous_epoch_detail = self.epoch_detail
         epoch, new_epoch, (pos, n) = self._in_flight.pop(0)
+        self._sched_states.pop(0)
         if self._zero_copy:
             for loader, buf_id in self._held:  # previous batch consumed
                 loader.release(buf_id)
@@ -165,6 +185,67 @@ class NativeBatchIterator(Iterator):
     @property
     def previous_epoch_detail(self):
         return self._previous_epoch_detail
+
+    def serialize(self, serializer):
+        """Consumer-granularity snapshot (the reference
+        ``MultiprocessIterator``'s resume contract): the saved schedule
+        state is the one from just before the oldest UNCONSUMED batch
+        was submitted, so a resumed stream replays exactly the batches
+        the uninterrupted run would have delivered — regardless of
+        prefetch depth.  On load the C++ pipeline is drained and
+        re-filled from the restored schedule."""
+        from .iterators import deserialize_rng, serialize_rng
+        if serializer.is_writer:
+            if self._sched_states:
+                pos, ep, order, rng_state = self._sched_states[0]
+            else:
+                pos, ep, order, rng_state = (
+                    self.current_position, self._sched_epoch,
+                    self._order, self._rng.get_state())
+            saved_rng = np.random.RandomState()
+            saved_rng.set_state(rng_state)
+            serializer("current_position", int(pos))
+            serializer("sched_epoch", int(ep))
+            serializer("order", np.asarray(order))
+            serialize_rng(serializer, saved_rng)
+            serializer("epoch", self.epoch)
+            serializer("is_new_epoch", int(self.is_new_epoch))
+            serializer("previous_epoch_detail",
+                       self._previous_epoch_detail)
+            serializer("detail_pos", getattr(self, "_detail_pos", 0))
+            return
+        # Read EVERYTHING into locals first; commit only when the reads
+        # succeed.  Missing-key tolerance is per key: snapshots written
+        # by SerialIterator/MultithreadIterator (this class is their
+        # drop-in) carry the shared keys but not the native-only ones
+        # (sched_epoch) — for those the consumer state IS the schedule
+        # state (such iterators save at consumer granularity).
+        def rd(key, default):
+            try:
+                value = serializer(key, None)
+            except KeyError:
+                return default
+            return default if value is None else value
+
+        pos = rd("current_position", None)
+        if pos is None:
+            return  # snapshot predates iterator serialization
+        epoch = int(rd("epoch", 0))
+        sched_epoch = int(rd("sched_epoch", epoch))
+        order = np.asarray(rd("order", self._order), dtype=np.int64)
+        is_new_epoch = bool(int(rd("is_new_epoch", 0)))
+        prev_detail = float(rd("previous_epoch_detail", -1.0))
+        detail_pos = int(rd("detail_pos", int(pos)))
+        self.current_position = int(pos)
+        self._sched_epoch = sched_epoch
+        self._order = order
+        deserialize_rng(serializer, self._rng)
+        self.epoch = epoch
+        self.is_new_epoch = is_new_epoch
+        self._previous_epoch_detail = prev_detail
+        self._detail_pos = detail_pos
+        self._drain_pipeline()
+        self._refill()
 
     def finalize(self):
         for loader, buf_id in getattr(self, "_held", []):

@@ -98,6 +98,79 @@ def test_native_iterator_trains_with_updater(lib):
     it.finalize()
 
 
+def test_native_iterator_serialize_resume_exact(lib):
+    """Consumer-granularity snapshot (reference MultiprocessIterator
+    contract): save after K consumed batches, resume in a FRESH
+    iterator, and the continued stream must be batch-for-batch
+    identical to the uninterrupted one — across epoch boundaries and
+    regardless of the n_prefetch submissions in flight at save time."""
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    x = np.arange(40, dtype=np.float32).reshape(40, 1)
+    y = np.arange(40, dtype=np.int32)
+
+    def fresh():
+        return NativeBatchIterator(TupleDataset(x, y), 8, shuffle=True,
+                                   seed=7, n_prefetch=3)
+
+    for k in (2, 4, 7):  # mid-epoch, boundary-adjacent, into epoch 2
+        it = fresh()
+        for _ in range(k):
+            it.next()
+        s = DictionarySerializer()
+        it.serialize(s)
+        golden = [(it.next()[1].tolist(), it.epoch, it.is_new_epoch,
+                   it.epoch_detail) for _ in range(6)]
+        it.finalize()
+
+        it2 = fresh()
+        it2.serialize(NpzDeserializer(s.target))
+        resumed = [(it2.next()[1].tolist(), it2.epoch, it2.is_new_epoch,
+                    it2.epoch_detail) for _ in range(6)]
+        it2.finalize()
+        assert golden == resumed, f"diverged after k={k}"
+
+
+def test_native_iterator_resumes_serial_iterator_snapshot(lib):
+    """Drop-in contract: a snapshot written by SerialIterator (shared
+    key names, no native-only keys) must restore cleanly under the
+    STRICT reader and continue the same index stream."""
+    from chainermn_tpu.dataset.iterators import SerialIterator
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                               NpzDeserializer)
+    x = np.arange(32, dtype=np.float32).reshape(32, 1)
+    y = np.arange(32, dtype=np.int32)
+    serial = SerialIterator(TupleDataset(x, y), 8, shuffle=True, seed=3)
+    for _ in range(2):
+        serial.next()
+    s = DictionarySerializer()
+    serial.serialize(s)
+    expect = [sorted(t for _, t in serial.next()) for _ in range(3)]
+
+    it = NativeBatchIterator(TupleDataset(x, y), 8, shuffle=True, seed=99)
+    it.serialize(NpzDeserializer(s.target))
+    got = [sorted(it.next()[1].tolist()) for _ in range(3)]
+    it.finalize()
+    assert got == expect
+
+
+def test_native_iterator_legacy_snapshot_tolerated(lib):
+    """Snapshots written before the iterator gained serialize() (no
+    keys) must load as a no-op under the strict reader."""
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    from chainermn_tpu.serializers.npz import NpzDeserializer
+    it = NativeBatchIterator(TupleDataset(
+        np.zeros((16, 1), np.float32), np.arange(16, dtype=np.int32)),
+        4, shuffle=False)
+    it.serialize(NpzDeserializer({}))  # empty snapshot: keep fresh state
+    assert it.epoch == 0
+    bx, by = it.next()
+    np.testing.assert_array_equal(by, np.arange(4))
+    it.finalize()
+
+
 def test_reset_drains_inflight_submissions():
     """reset() must discard batches already queued in the C++ FIFO —
     otherwise the post-reset stream serves the old schedule's batches
