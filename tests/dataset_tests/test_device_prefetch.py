@@ -106,6 +106,43 @@ def test_multithread_base_stream_and_resume():
         np.testing.assert_array_equal(a, b)
 
 
+def test_native_base_stream_and_resume():
+    """DevicePrefetchIterator stacked over a NativeBatchIterator base
+    (C++ gather + device feed): now that the native iterator serializes
+    at consumer granularity, the full composed pipeline must resume
+    bit-exactly too."""
+    import pytest
+
+    from chainermn_tpu.utils.native import load_library
+    if load_library() is None:
+        pytest.skip("native loader unavailable")
+    from chainermn_tpu.dataset import TupleDataset
+    from chainermn_tpu.dataset.native_iterator import NativeBatchIterator
+    xs = np.random.RandomState(0).normal(
+        0, 1, (24, 4)).astype(np.float32)
+    ys = np.arange(24, dtype=np.int32)
+
+    def build():
+        return DevicePrefetchIterator(
+            NativeBatchIterator(TupleDataset(xs, ys), 4, shuffle=True,
+                                seed=3, n_prefetch=2), size=2)
+
+    it = build()
+    for _ in range(5):
+        it.next()
+    s = DictionarySerializer()
+    it.serialize(s)
+    cont = [np.asarray(it.next()[1]) for _ in range(6)]
+    it.finalize()
+
+    it2 = build()
+    it2.serialize(NpzDeserializer(s.target))
+    resumed = [np.asarray(it2.next()[1]) for _ in range(6)]
+    it2.finalize()
+    for a, b in zip(cont, resumed):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_non_repeating_drains():
     data = _dataset(8)
     pref = DevicePrefetchIterator(
