@@ -144,7 +144,8 @@ _DEFAULT_FINGERPRINTS = {
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
                     "n_vocab": DEFAULT_TF_VOCAB, "heads": 0,
-                    "remat": False, "n_steps": DEFAULT_TF_STEPS},
+                    "remat": False, "remat_policy": "",
+                    "n_steps": DEFAULT_TF_STEPS},
 }
 
 
@@ -188,6 +189,7 @@ def _config_fingerprint(model=None):
             "n_vocab": _env_int("BENCH_VOCAB", DEFAULT_TF_VOCAB),
             "heads": _env_int("BENCH_HEADS", 0),
             "remat": os.environ.get("BENCH_REMAT", "0") == "1",
+            "remat_policy": os.environ.get("BENCH_REMAT_POLICY", ""),
             "n_steps": _env_int("BENCH_STEPS", DEFAULT_TF_STEPS),
         }
     return {
@@ -250,6 +252,7 @@ def _cacheable(result):
             and result.get("n_vocab", DEFAULT_TF_VOCAB)
             == DEFAULT_TF_VOCAB
             and not result.get("remat", False)
+            and result.get("remat_policy", "") == ""
             and result.get("n_steps", DEFAULT_TF_STEPS) == DEFAULT_TF_STEPS
             and DEFAULT_TF_BS // 4 <= result.get("per_chip_batch", 0)
             <= DEFAULT_TF_BS)
@@ -440,6 +443,15 @@ def _run_bench_transformer():
                                   str(DEFAULT_TF_LAYERS)))
     n_vocab = int(os.environ.get("BENCH_VOCAB", str(DEFAULT_TF_VOCAB)))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # BENCH_REMAT_POLICY ("dots", "full", or a jax.checkpoint_policies
+    # name): what the per-block remat recomputes — meaningless without
+    # BENCH_REMAT=1, and silently ignoring it would mislabel a no-remat
+    # measurement as a policy run (models/transformer.py · _remat_policy)
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "")
+    if remat_policy and not remat:
+        raise ValueError("BENCH_REMAT_POLICY is set but BENCH_REMAT is "
+                         "not 1 — the policy would not be applied")
+    remat_arg = (remat_policy or True) if remat else False
     n_heads = int(os.environ.get("BENCH_HEADS", "0")) or max(1, d_model // 64)
     if d_model % n_heads:
         raise ValueError(f"BENCH_D_MODEL={d_model} is not divisible by "
@@ -465,6 +477,7 @@ def _run_bench_transformer():
             "n_layers": n_layers,
             "n_vocab": n_vocab,
             "remat": remat,
+            "remat_policy": remat_policy,
             "n_steps": n_steps,
             "compile_s": round(compile_s, 1),
         }
@@ -481,7 +494,7 @@ def _run_bench_transformer():
                                       allreduce_grad_dtype="bfloat16")
         model = TransformerLM(n_vocab=n_vocab, d_model=d_model,
                               n_heads=n_heads, n_layers=n_layers,
-                              max_len=seq_len, seed=0, remat=remat,
+                              max_len=seq_len, seed=0, remat=remat_arg,
                               compute_dtype=jnp.bfloat16)
         comm.bcast_data(model)
         inner = Adam(alpha=3e-4)

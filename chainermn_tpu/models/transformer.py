@@ -86,13 +86,39 @@ class TransformerBlock(Chain):
         return h + m.reshape(B, T, D)
 
 
+def _remat_policy(remat):
+    """Map the ``remat`` knob to a ``jax.checkpoint`` policy.
+
+    ``True``/``"full"`` — save nothing (maximal memory saving, full
+    recompute; the plain long-context lever).  ``"dots"`` — save
+    weight-GEMM outputs, recompute elementwise/attention
+    (``dots_with_no_batch_dims_saveable``: the transformer-standard
+    trade — backward skips re-running the big MXU GEMMs at a modest
+    activation-memory cost, typically better MFU at long sequence than
+    full remat).  Any other string resolves as an attribute of
+    ``jax.checkpoint_policies``."""
+    if remat in (True, "full"):
+        return None
+    if remat == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    policy = getattr(jax.checkpoint_policies, str(remat), None)
+    if policy is None:
+        raise ValueError(
+            f"unknown remat policy {remat!r}; use True/'full', 'dots', "
+            "or a jax.checkpoint_policies attribute name")
+    return policy
+
+
 class TransformerLM(Chain):
     """Causal LM.  ``sequence_parallel``: pass ``sp_comm`` and call inside
     a program sharding the T dimension over its axis.  Position ids are
     supplied automatically when the axis is bound: contiguous offsets for
     ``sp_mode="ring"``/``"ulysses"`` (rank · T_local), the two-half-chunk
     layout for ``sp_mode="zigzag"`` (the balanced causal ring — shard
-    inputs/targets with ``parallel.zigzag_shard`` along T)."""
+    inputs/targets with ``parallel.zigzag_shard`` along T).
+
+    ``remat``: ``False`` | ``True``/``"full"`` | ``"dots"`` | any
+    ``jax.checkpoint_policies`` name — see :func:`_remat_policy`."""
 
     def __init__(self, n_vocab, d_model=128, n_heads=4, n_layers=2,
                  max_len=2048, seed=0, sp_comm=None, sp_mode="ring",
@@ -141,8 +167,12 @@ class TransformerLM(Chain):
                 # per-block rematerialization: backward recomputes the
                 # block, trading FLOPs for activation memory — the lever
                 # for long contexts (blocks hold no persistent state, so
-                # closing over bound params is safe)
-                h = jax.checkpoint(lambda hh, blk=block: blk(hh))(h)
+                # closing over bound params is safe).  The policy decides
+                # WHAT to recompute (see _remat_policy): "dots" keeps the
+                # GEMM outputs so the backward re-runs only the cheap
+                # elementwise/attention tail.
+                h = jax.checkpoint(lambda hh, blk=block: blk(hh),
+                                   policy=_remat_policy(self.remat))(h)
             else:
                 h = block(h)
         return self.ln_f(h)

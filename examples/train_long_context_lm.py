@@ -27,6 +27,11 @@ def main():
                         default="ring",
                         help="'zigzag' = causally balanced ring schedule "
                              "(inputs are zigzag-sharded along T)")
+    parser.add_argument("--remat", default=None,
+                        help="per-block rematerialization: 'full' "
+                             "(save nothing), 'dots' (keep GEMM outputs"
+                             " — the better-MFU long-context trade), or"
+                             " any jax.checkpoint_policies name")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--simulate-devices", type=int, default=0)
     args = parser.parse_args()
@@ -53,7 +58,8 @@ def main():
     model = TransformerLM(args.vocab, d_model=args.d_model,
                           n_heads=args.n_heads, n_layers=args.n_layers,
                           max_len=args.seq_len, sp_comm=comm,
-                          sp_mode=args.sp_mode)
+                          sp_mode=args.sp_mode,
+                          remat=args.remat or False)
     state = extract_state(model)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randint(0, args.vocab,

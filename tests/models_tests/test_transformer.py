@@ -187,12 +187,24 @@ def test_transformer_remat_matches():
     from chainermn_tpu.core.optimizer import SGD
     x, t = _lm_data(B=2, T=16, seed=10)
     losses = {}
-    for remat in (False, True):
+    for remat in (False, True, "dots", "everything_saveable"):
         m = TransformerLM(50, d_model=32, n_heads=2, n_layers=2, seed=13,
                           remat=remat)
         opt = SGD(lr=0.1).setup(m)
         losses[remat] = [float(opt.update(m, x, t)) for _ in range(3)]
-    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+    for variant in (True, "dots", "everything_saveable"):
+        np.testing.assert_allclose(losses[variant], losses[False],
+                                   rtol=1e-5,
+                                   err_msg=f"remat={variant!r} diverged")
+
+
+def test_transformer_remat_rejects_unknown_policy():
+    import pytest
+    m = TransformerLM(50, d_model=32, n_heads=2, n_layers=1, seed=13,
+                      remat="not_a_policy")
+    x, t = _lm_data(B=1, T=8, seed=1)
+    with pytest.raises(ValueError, match="remat policy"):
+        m(x, t)
 
 
 def test_generate_kv_cache_matches_full_forward():
