@@ -23,7 +23,7 @@ from ..core import reporter
 from ..nn import functions as F
 from ..nn import links as L
 from .. import functions as mnfn
-from .transformer import MultiHeadAttention, _axis_bound
+from .transformer import MultiHeadAttention, _axis_bound, _remat_policy
 
 __all__ = ["MoEFeedForward", "MoETransformerBlock", "MoETransformerLM"]
 
@@ -115,10 +115,20 @@ class MoETransformerLM(Chain):
 
     def __init__(self, n_vocab, ep_comm, d_model=128, n_heads=4,
                  n_layers=2, d_ff=None, max_len=2048, seed=0,
-                 aux_weight=0.01, capacity_factor=1.25):
+                 aux_weight=0.01, capacity_factor=1.25,
+                 compute_dtype=None, remat=False):
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.aux_weight = aux_weight
+        # same knobs as TransformerLM: bf16 MXU compute with fp32
+        # params/statistics, and per-block remat with jax.checkpoint
+        # POLICIES (True/"full"/"dots"/...).  Remat caveat specific to
+        # MoE: the block's all_to_all expert exchange is recomputed in
+        # the backward under full remat — "dots" keeps the expert GEMM
+        # outputs but still re-runs the exchange; policy choice trades
+        # a2a traffic against activation memory.
+        self.compute_dtype = compute_dtype
+        self.remat = remat
         with self.init_scope():
             self.embed = L.EmbedID(n_vocab, d_model, seed=seed)
             self.pos_embed = L.EmbedID(max_len, d_model, seed=seed + 1)
@@ -135,10 +145,28 @@ class MoETransformerLM(Chain):
         B, T = x.shape
         pos = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
         h = self.embed(x) + self.pos_embed(jnp.broadcast_to(pos, (B, T)))
+        if self.compute_dtype is not None:
+            h = h.astype(self.compute_dtype)
         aux_sink = []
         for block in self.blocks:
-            h = block(h, aux_sink=aux_sink)
+            if self.remat:
+                # aux outputs must cross the checkpoint boundary as
+                # explicit results (appending to a closed-over list
+                # inside the remat region would leak tracers)
+                def run(hh, blk=block):
+                    sink = []
+                    out = blk(hh, aux_sink=sink)
+                    return out, sink[0]
+                h, aux = jax.checkpoint(
+                    run, policy=_remat_policy(self.remat))(h)
+                aux_sink.append(aux)
+            else:
+                h = block(h, aux_sink=aux_sink)
         h = self.ln_f(h)
+        # head GEMM stays in the compute dtype (large-vocab GEMMs are
+        # exactly where bf16 MXU rate matters); softmax_cross_entropy
+        # upcasts the logits to fp32 internally — same discipline as
+        # TransformerLM
         logits = self.head(h.reshape(B * T, -1))
         loss = F.softmax_cross_entropy(logits, t.reshape(-1),
                                        ignore_label=-1)
