@@ -96,6 +96,29 @@ def test_sequence_parallel_zigzag_matches_single_device():
                                rtol=5e-4, atol=5e-4)
 
 
+def test_sequence_parallel_remat_policy_matches():
+    """remat × SP composition: jax.checkpoint (incl. the dots policy)
+    wrapped around blocks whose attention carries ppermute collectives
+    must not change the sharded forward."""
+    x, _ = _lm_data(B=2, seed=3)
+    single = TransformerLM(50, d_model=32, n_heads=2, n_layers=2, seed=7)
+    ref = single.logits(x)
+    for remat in (True, "dots"):
+        sp = TransformerLM(50, d_model=32, n_heads=2, n_layers=2,
+                           seed=7, sp_comm=COMM, sp_mode="ring",
+                           remat=remat)
+        state = extract_state(sp)
+        out_sp = jax.jit(jax.shard_map(
+            lambda p, s, x: sp_hidden(sp, p, s, x),
+            mesh=COMM.mesh,
+            in_specs=(P(), P(), P(None, "lm_seq")),
+            out_specs=P(None, "lm_seq"),
+            check_vma=False))(state["params"], state["state"], x)
+        np.testing.assert_allclose(np.asarray(out_sp), np.asarray(ref),
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"remat={remat!r}")
+
+
 def test_sequence_parallel_gradients_match(subtests=None):
     x, _ = _lm_data(B=2, seed=4)
     # equal valid-token count per shard: pmean of per-shard mean losses
