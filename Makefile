@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch watch-status dryrun examples clean
+.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -49,6 +49,12 @@ watch-status:     ## round-start checklist: watcher liveness + probe + queue sta
 	@if [ -s tpu_recovery_run.log ]; then \
 	  echo "recovery queue log tail:"; tail -3 tpu_recovery_run.log; \
 	else echo "recovery queue has NOT fired"; fi
+
+probe-input:      ## host input-pipeline bandwidth at flagship scale (no chip)
+	PROBE=input_pipeline PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
+
+audit:            ## StableHLO dtype census, resnet + transformer (no chip)
+	PROBE=precision_audit $(PY) tools/probe_perf.py
 
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
