@@ -20,6 +20,7 @@ inner.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -256,12 +257,39 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=False):
-    """Fused attention via Pallas.  q/k/v: [B, H, T, D]."""
+def _flash_blocks(block_q=None, block_k=None):
+    """Resolve kernel tile sizes: explicit arguments win, else the
+    CHAINERMN_TPU_FLASH_BLOCK_Q/K env knobs (so an on-chip session can
+    A/B block shapes through the flashcmp probe without code edits),
+    else the tested 128×128 default.  Env changes only affect programs
+    traced AFTERWARDS — jit caches are not keyed on them, so run each
+    configuration in a fresh process (the probe does).  Values must be
+    positive multiples of 8 (Mosaic sublane tiling)."""
+    out = []
+    for name, given in (("CHAINERMN_TPU_FLASH_BLOCK_Q", block_q),
+                        ("CHAINERMN_TPU_FLASH_BLOCK_K", block_k)):
+        if given is None:
+            raw = os.environ.get(name, "128")
+            try:
+                given = int(raw)
+            except ValueError:
+                raise ValueError(f"{name}={raw!r} is not an integer")
+            if given <= 0 or given % 8:
+                raise ValueError(
+                    f"{name}={given} invalid: flash block sizes must be "
+                    "positive multiples of 8 (128 recommended)")
+        out.append(given)
+    return tuple(out)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=False):
+    """Fused attention via Pallas.  q/k/v: [B, H, T, D].  Default block
+    sizes come from :func:`_flash_blocks` (env-tunable)."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q, block_k = _flash_blocks(block_q, block_k)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     if Tq % block_q or Tk % block_k:
@@ -290,12 +318,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     return out.reshape(B, H, Tq, D)
 
 
-def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
-                        block_k=128, interpret=False):
+def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=None,
+                        block_k=None, interpret=False):
     """Forward kernel returning (out, lse [B, H, Tq])."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q, block_k = _flash_blocks(block_q, block_k)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     qr = q.reshape(B * H, Tq, D)
@@ -326,7 +355,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=128,
 
 
 def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
-                        block_q=128, block_k=128, interpret=False,
+                        block_q=None, block_k=None, interpret=False,
                         g_lse=None):
     """Backward kernels: (dq, dk, dv) with flash memory behavior.
 
@@ -339,6 +368,7 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    block_q, block_k = _flash_blocks(block_q, block_k)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     qr = q.reshape(B * H, Tq, D)
@@ -406,24 +436,31 @@ def _flash_diff(q, k, v, causal, scale, interpret):
 
 def _flash_diff_fwd(q, k, v, causal, scale, interpret):
     Tq, Tk = q.shape[2], k.shape[2]
-    if Tq % min(128, Tq) or Tk % min(128, Tk):
+    bq, bk = _flash_blocks()
+    if Tq % min(bq, Tq) or Tk % min(bk, Tk):
         # irregular shapes: XLA fallback for both directions
         out = xla_attention(q, k, v, causal=causal, scale=scale)
-        return out, (q, k, v, None, None)
+        return out, (q, k, v, None, None, None)
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   block_q=bq, block_k=bk,
                                    interpret=interpret)
-    return out, (q, k, v, out, lse)
+    # carry the block config in the residuals: the backward must use the
+    # EXACT tiles the forward was validated with (re-reading the env
+    # there would silently corrupt gradients if it changed mid-process)
+    return out, (q, k, v, out, lse, (bq, bk))
 
 
 def _flash_diff_bwd(causal, scale, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, blocks = res
     if lse is None:
         _, vjp = jax.vjp(
             lambda q, k, v: xla_attention(q, k, v, causal=causal,
                                           scale=scale), q, k, v)
         return vjp(g)
+    bq, bk = blocks
     return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
-                               scale=scale, interpret=interpret)
+                               scale=scale, block_q=bq, block_k=bk,
+                               interpret=interpret)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -507,17 +544,21 @@ def _flash_lse_diff(q, k, v, causal, scale, interpret):
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, interpret):
+    bq, bk = _flash_blocks()
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                   block_q=bq, block_k=bk,
                                    interpret=interpret)
-    return (out, lse), (q, k, v, out, lse)
+    # same residual-carried block config as _flash_diff: backward must
+    # tile exactly as the forward did
+    return (out, lse), (q, k, v, out, lse, (bq, bk))
 
 
 def _flash_lse_bwd(causal, scale, interpret, res, cots):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, (bq, bk) = res
     g, g_lse = cots
     return flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
-                               scale=scale, interpret=interpret,
-                               g_lse=g_lse)
+                               scale=scale, block_q=bq, block_k=bk,
+                               interpret=interpret, g_lse=g_lse)
 
 
 _flash_lse_diff.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -536,8 +577,9 @@ def attention_with_lse(q, k, v, causal=False, scale=None):
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
+    bq, bk = _flash_blocks()
     if (jax.default_backend() in ("tpu", "axon")
-            and Tq % min(128, Tq) == 0 and Tk % min(128, Tk) == 0):
+            and Tq % min(bq, Tq) == 0 and Tk % min(bk, Tk) == 0):
         return _flash_lse_diff(q, k, v, causal, scale, False)
     return _blockwise_attention_lse_jnp(q, k, v, causal, scale)
 
