@@ -11,6 +11,7 @@ from __future__ import annotations
 from ..core.link import Chain
 from ..nn import functions as F
 from ..nn import links as L
+from .resnet import input_norm_consts, normalize_input
 
 __all__ = ["AlexNet", "NIN", "VGG16", "GoogLeNet"]
 
@@ -20,8 +21,9 @@ class AlexNet(Chain):
 
     insize = 227
 
-    def __init__(self, n_classes=1000, seed=0):
+    def __init__(self, n_classes=1000, seed=0, input_norm=None):
         super().__init__()
+        self._in_consts = input_norm_consts(input_norm)
         s = lambda k: seed + k
         with self.init_scope():
             self.conv1 = L.Convolution2D(3, 96, 11, stride=4, seed=s(0))
@@ -34,6 +36,7 @@ class AlexNet(Chain):
             self.fc8 = L.Linear(4096, n_classes, seed=s(7))
 
     def forward(self, x):
+        x = normalize_input(x, self._in_consts, "NCHW", None)
         h = F.max_pooling_2d(F.local_response_normalization(
             F.relu(self.conv1(x))), 3, stride=2)
         h = F.max_pooling_2d(F.local_response_normalization(
@@ -51,8 +54,9 @@ class NIN(Chain):
 
     insize = 227
 
-    def __init__(self, n_classes=1000, seed=0):
+    def __init__(self, n_classes=1000, seed=0, input_norm=None):
         super().__init__()
+        self._in_consts = input_norm_consts(input_norm)
         s = lambda k: seed + k
 
         def mlpconv(in_ch, out_ch, ksize, stride, pad, k):
@@ -80,6 +84,7 @@ class NIN(Chain):
         return h
 
     def forward(self, x):
+        x = normalize_input(x, self._in_consts, "NCHW", None)
         h = F.max_pooling_2d(self._mlp("mlp1", x), 3, stride=2)
         h = F.max_pooling_2d(self._mlp("mlp2", h), 3, stride=2)
         h = F.max_pooling_2d(self._mlp("mlp3", h), 3, stride=2)
@@ -94,8 +99,9 @@ class VGG16(Chain):
 
     insize = 224
 
-    def __init__(self, n_classes=1000, seed=0):
+    def __init__(self, n_classes=1000, seed=0, input_norm=None):
         super().__init__()
+        self._in_consts = input_norm_consts(input_norm)
         cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
                (128, 256), (256, 256), (256, 256), "M",
                (256, 512), (512, 512), (512, 512), "M",
@@ -118,7 +124,7 @@ class VGG16(Chain):
             self.fc8 = L.Linear(4096, n_classes, seed=seed + 102)
 
     def forward(self, x):
-        h = x
+        h = normalize_input(x, self._in_consts, "NCHW", None)
         for item in self._plan:
             if item == "M":
                 h = F.max_pooling_2d(h, 2, stride=2, cover_all=False)
@@ -180,9 +186,11 @@ class GoogLeNet(Chain):
 
     insize = 224
 
-    def __init__(self, n_classes=1000, seed=0, aux_heads=True):
+    def __init__(self, n_classes=1000, seed=0, aux_heads=True,
+                 input_norm=None):
         super().__init__()
         self.aux_heads = aux_heads
+        self._in_consts = input_norm_consts(input_norm)
         s = lambda k: seed + 1000 * k
         with self.init_scope():
             if aux_heads:
@@ -204,6 +212,7 @@ class GoogLeNet(Chain):
             self.fc = L.Linear(1024, n_classes, seed=s(13))
 
     def _features(self, x):
+        x = normalize_input(x, self._in_consts, "NCHW", None)
         h = F.max_pooling_2d(F.relu(self.conv1(x)), 3, stride=2, pad=1,
                              cover_all=False)
         h = F.relu(self.conv2(F.relu(self.conv2r(h))))

@@ -35,7 +35,8 @@ from ..nn import functions as F
 from ..nn import links as L
 
 __all__ = ["ResNet50", "ResNet18", "ResNet101", "BottleneckBlock",
-           "BasicBlock", "IMAGENET_MEAN", "IMAGENET_STD"]
+           "BasicBlock", "IMAGENET_MEAN", "IMAGENET_STD",
+           "input_norm_consts", "normalize_input"]
 
 # ImageNet channel statistics in 0-1 scale (the standard ImageNet
 # normalization the reference's example pipeline applies on HOST per
@@ -44,10 +45,13 @@ IMAGENET_MEAN = (0.485, 0.456, 0.406)
 IMAGENET_STD = (0.229, 0.224, 0.225)
 
 
-def _input_norm_consts(input_norm):
+def input_norm_consts(input_norm):
     """(scale, bias) folding 0-255→0-1 and channel standardization into
     one multiply-add: y = x·scale + bias ≡ (x/255 − mean)/std.  Returns
-    None for ``input_norm=None`` (inputs already normalized floats)."""
+    None for ``input_norm=None`` (inputs already normalized floats).
+    Shared input-norm infrastructure: every ImageNet model family
+    (ResNet here, the classic convnets in ``convnets.py``) consumes
+    these two helpers — treat their contract as public."""
     if input_norm is None:
         return None
     if isinstance(input_norm, str):
@@ -63,7 +67,7 @@ def _input_norm_consts(input_norm):
     return 1.0 / (255.0 * std), -mean / std
 
 
-def _normalize_input(x, consts, layout, compute_dtype):
+def normalize_input(x, consts, layout, compute_dtype):
     """Cast + (optionally) standardize on DEVICE, inside the compiled
     step: constants fold, XLA fuses the multiply-add into the first
     conv's input, and uint8 host→device transfers stay uint8.  The
@@ -180,7 +184,7 @@ class ResNet(Chain):
         self.remat = remat
         self.layout = layout
         self.input_norm = input_norm
-        self._in_consts = _input_norm_consts(input_norm)
+        self._in_consts = input_norm_consts(input_norm)
         with self.init_scope():
             self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed,
                                 layout=layout)
@@ -223,7 +227,7 @@ class ResNet(Chain):
         return out
 
     def forward(self, x):
-        x = _normalize_input(x, self._in_consts, self.layout,
+        x = normalize_input(x, self._in_consts, self.layout,
                              self.compute_dtype)
         h = self.conv1(x)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False,
@@ -258,7 +262,7 @@ class ResNet18(Chain):
         super().__init__()
         self.compute_dtype = compute_dtype
         self.input_norm = input_norm
-        self._in_consts = _input_norm_consts(input_norm)
+        self._in_consts = input_norm_consts(input_norm)
         cfg = [(64, 64, 1), (64, 128, 2), (128, 256, 2), (256, 512, 2)]
         with self.init_scope():
             self.conv1 = ConvBN(3, 64, 7, stride=2, pad=3, seed=seed)
@@ -272,7 +276,7 @@ class ResNet18(Chain):
             self.fc = L.Linear(512, n_classes, seed=seed + 999)
 
     def forward(self, x):
-        x = _normalize_input(x, self._in_consts, "NCHW",
+        x = normalize_input(x, self._in_consts, "NCHW",
                              self.compute_dtype)
         h = self.conv1(x)
         h = F.max_pooling_2d(h, 3, stride=2, pad=1, cover_all=False)

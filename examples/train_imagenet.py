@@ -64,7 +64,7 @@ def main():
                              "trajectory as plain DP, 1/n state memory")
     parser.add_argument("--uint8-input", action="store_true",
                         help="ship raw uint8 pixels and normalize "
-                             "IN-GRAPH on device (resnet50 only) — the "
+                             "IN-GRAPH on device (any arch) — the "
                              "measured input-pipeline fix: host f32 "
                              "casting caps at ~2.6k img/s on one core, "
                              "uint8 gather sustains ~9k (BENCH_NOTES r5)")
@@ -74,9 +74,6 @@ def main():
                              "--uint8-input for the full measured-fast "
                              "host pipeline")
     args = parser.parse_args()
-    if args.uint8_input and args.arch != "resnet50":
-        parser.error("--uint8-input requires --arch resnet50 "
-                     "(in-graph input_norm)")
 
     if args.simulate_devices:
         from chainermn_tpu.utils import simulate_devices
@@ -87,12 +84,14 @@ def main():
 
     comm = ct.create_communicator(args.communicator,
                                   allreduce_grad_dtype=args.grad_dtype)
+    inorm = "imagenet" if args.uint8_input else None
     archs = {"resnet50": lambda: ResNet50(
                  compute_dtype=jnp.bfloat16, remat=args.remat,
-                 layout=args.layout,
-                 input_norm="imagenet" if args.uint8_input else None),
-             "alex": AlexNet, "nin": NIN, "vgg16": VGG16,
-             "googlenet": GoogLeNet}
+                 layout=args.layout, input_norm=inorm),
+             "alex": lambda: AlexNet(input_norm=inorm),
+             "nin": lambda: NIN(input_norm=inorm),
+             "vgg16": lambda: VGG16(input_norm=inorm),
+             "googlenet": lambda: GoogLeNet(input_norm=inorm)}
     nhwc = args.arch == "resnet50" and args.layout == "NHWC"
     model = Classifier(archs[args.arch]())
     if args.mnbn:

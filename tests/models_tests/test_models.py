@@ -79,6 +79,25 @@ def test_resnet50_uint8_input_norm_matches_host_normalized():
         ResNet50(n_classes=10, input_norm="ImageNet")
 
 
+def test_classic_convnets_input_norm_matches_host_normalized():
+    """input_norm='imagenet' on the classic ImageNet archs equals the
+    same weights fed host-normalized float32 (NIN: deterministic
+    forward, no dropout on the conv path)."""
+    from chainermn_tpu.models import NIN
+    from chainermn_tpu.models.resnet import IMAGENET_MEAN, IMAGENET_STD
+
+    rng = np.random.RandomState(0)
+    x8 = rng.randint(0, 256, (2, 3, 64, 64)).astype(np.uint8)
+    mean = np.asarray(IMAGENET_MEAN, np.float32).reshape(1, 3, 1, 1)
+    std = np.asarray(IMAGENET_STD, np.float32).reshape(1, 3, 1, 1)
+    xf = (x8.astype(np.float32) / 255.0 - mean) / std
+    m_u8 = NIN(n_classes=10, seed=0, input_norm="imagenet")
+    m_f = NIN(n_classes=10, seed=0)
+    np.testing.assert_allclose(np.asarray(m_u8(jnp.asarray(x8))),
+                               np.asarray(m_f(jnp.asarray(xf))),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_resnet18_trains_on_synthetic_cifar():
     model = Classifier(ResNet18(n_classes=10, seed=0))
     opt = Adam().setup(model)
