@@ -145,7 +145,8 @@ _DEFAULT_FINGERPRINTS = {
                     "n_layers": DEFAULT_TF_LAYERS,
                     "n_vocab": DEFAULT_TF_VOCAB, "heads": 0,
                     "remat": False, "remat_policy": "",
-                    "n_steps": DEFAULT_TF_STEPS},
+                    "n_steps": DEFAULT_TF_STEPS,
+                    "flash_blocks": ":"},
 }
 
 
@@ -191,6 +192,13 @@ def _config_fingerprint(model=None):
             "remat": os.environ.get("BENCH_REMAT", "0") == "1",
             "remat_policy": os.environ.get("BENCH_REMAT_POLICY", ""),
             "n_steps": _env_int("BENCH_STEPS", DEFAULT_TF_STEPS),
+            # the Pallas attention tile knobs change the compiled
+            # program: a block-sweep run must not be cacheable as the
+            # flagship datum ("" = kernel default)
+            "flash_blocks":
+                os.environ.get("CHAINERMN_TPU_FLASH_BLOCK_Q", "")
+                + ":"
+                + os.environ.get("CHAINERMN_TPU_FLASH_BLOCK_K", ""),
         }
     return {
         "model": "resnet50",

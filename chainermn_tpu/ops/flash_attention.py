@@ -257,27 +257,54 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_blocks(block_q=None, block_k=None):
+# Adaptive-default tile candidates, largest first.  The round-5 on-chip
+# sweep (tools/flash_block_sweep.py, BENCH_NOTES r5): 128×128 tiles
+# serialize the online-softmax loop into too-small MXU dots — 1024-wide
+# tiles ran the same fwd+bwd 2.85× faster at T=8192 (11.2 → 31.8
+# TFLOP/s) and lifted the end-to-end seq-1024 transformer step 1.40×
+# (74.1k → 103.4k tokens/sec/chip, MFU 29.1% → 40.6%).  VMEM cost at
+# 1024: the f32 score/probability tiles are 4 MB each — comfortably
+# inside the kernel's 100 MB scoped-VMEM cap with whole-T K/V staging
+# up to T≈64k.
+_BLOCK_CANDIDATES = (1024, 512, 256, 128)
+
+
+def _adaptive_block(t):
+    """Largest candidate tile that divides T (so the grid stays exact);
+    falls back to the legacy 128 (clamped to T by the callers) when T
+    is not a multiple of any candidate — e.g. T=64 keeps the old
+    min(128, T) behavior, odd T keeps its XLA-fallback path."""
+    if t is not None:
+        for b in _BLOCK_CANDIDATES:
+            if t % b == 0:
+                return b
+    return 128
+
+
+def _flash_blocks(block_q=None, block_k=None, tq=None, tk=None):
     """Resolve kernel tile sizes: explicit arguments win, else the
     CHAINERMN_TPU_FLASH_BLOCK_Q/K env knobs (so an on-chip session can
-    A/B block shapes through the flashcmp probe without code edits),
-    else the tested 128×128 default.  Env changes only affect programs
-    traced AFTERWARDS — jit caches are not keyed on them, so run each
-    configuration in a fresh process (the probe does).  Values must be
-    positive multiples of 8 (Mosaic sublane tiling)."""
+    A/B block shapes without code edits), else the shape-adaptive
+    default (:func:`_adaptive_block` over the given Tq/Tk).  Env changes
+    only affect programs traced AFTERWARDS — jit caches are not keyed on
+    them, so run each configuration in a fresh process (the probe does).
+    Values must be positive multiples of 8 (Mosaic sublane tiling)."""
     out = []
-    for name, given in (("CHAINERMN_TPU_FLASH_BLOCK_Q", block_q),
-                        ("CHAINERMN_TPU_FLASH_BLOCK_K", block_k)):
+    for name, given, t in (("CHAINERMN_TPU_FLASH_BLOCK_Q", block_q, tq),
+                           ("CHAINERMN_TPU_FLASH_BLOCK_K", block_k, tk)):
         if given is None:
-            raw = os.environ.get(name, "128")
-            try:
-                given = int(raw)
-            except ValueError:
-                raise ValueError(f"{name}={raw!r} is not an integer")
-            if given <= 0 or given % 8:
-                raise ValueError(
-                    f"{name}={given} invalid: flash block sizes must be "
-                    "positive multiples of 8 (128 recommended)")
+            raw = os.environ.get(name)
+            if raw is None:
+                given = _adaptive_block(t)
+            else:
+                try:
+                    given = int(raw)
+                except ValueError:
+                    raise ValueError(f"{name}={raw!r} is not an integer")
+                if given <= 0 or given % 8:
+                    raise ValueError(
+                        f"{name}={given} invalid: flash block sizes must "
+                        "be positive multiples of 8")
         out.append(given)
     return tuple(out)
 
@@ -289,7 +316,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    block_q, block_k = _flash_blocks(block_q, block_k)
+    block_q, block_k = _flash_blocks(block_q, block_k, tq=Tq, tk=Tk)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     if Tq % block_q or Tk % block_k:
@@ -324,7 +351,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    block_q, block_k = _flash_blocks(block_q, block_k)
+    block_q, block_k = _flash_blocks(block_q, block_k, tq=Tq, tk=Tk)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     qr = q.reshape(B * H, Tq, D)
@@ -368,7 +395,7 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    block_q, block_k = _flash_blocks(block_q, block_k)
+    block_q, block_k = _flash_blocks(block_q, block_k, tq=Tq, tk=Tk)
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     qr = q.reshape(B * H, Tq, D)
@@ -436,7 +463,7 @@ def _flash_diff(q, k, v, causal, scale, interpret):
 
 def _flash_diff_fwd(q, k, v, causal, scale, interpret):
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _flash_blocks()
+    bq, bk = _flash_blocks(tq=Tq, tk=Tk)
     if Tq % min(bq, Tq) or Tk % min(bk, Tk):
         # irregular shapes: XLA fallback for both directions
         out = xla_attention(q, k, v, causal=causal, scale=scale)
@@ -544,7 +571,7 @@ def _flash_lse_diff(q, k, v, causal, scale, interpret):
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, interpret):
-    bq, bk = _flash_blocks()
+    bq, bk = _flash_blocks(tq=q.shape[2], tk=k.shape[2])
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                    block_q=bq, block_k=bk,
                                    interpret=interpret)
@@ -577,7 +604,7 @@ def attention_with_lse(q, k, v, causal=False, scale=None):
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
-    bq, bk = _flash_blocks()
+    bq, bk = _flash_blocks(tq=Tq, tk=Tk)
     if (jax.default_backend() in ("tpu", "axon")
             and Tq % min(bq, Tq) == 0 and Tk % min(bk, Tk) == 0):
         return _flash_lse_diff(q, k, v, causal, scale, False)

@@ -192,3 +192,29 @@ def test_flash_bf16_matches_fp32_reference():
     for g, r in ((gq, rq), (gk, rk), (gv, rv)):
         np.testing.assert_allclose(np.asarray(g, dtype=np.float32),
                                    np.asarray(r), rtol=0.1, atol=0.05)
+
+
+def test_adaptive_block_defaults(monkeypatch):
+    """Round-5 on-chip sweep: tile defaults are shape-adaptive (largest
+    candidate dividing T), env still pins, explicit args still win."""
+    from chainermn_tpu.ops.flash_attention import _adaptive_block, \
+        _flash_blocks
+
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BLOCK_K", raising=False)
+    assert _adaptive_block(8192) == 1024
+    assert _adaptive_block(1024) == 1024
+    assert _adaptive_block(1536) == 512   # 1536 % 1024 != 0
+    assert _adaptive_block(384) == 128
+    assert _adaptive_block(64) == 128     # legacy clamp path (min(b, T))
+    assert _adaptive_block(None) == 128   # no shape info: legacy default
+    assert _flash_blocks(tq=2048, tk=8192) == (1024, 1024)
+    assert _flash_blocks(256, None, tq=2048, tk=1536) == (256, 512)
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BLOCK_Q", "64")
+    assert _flash_blocks(tq=2048, tk=2048) == (64, 1024)
+
+def test_adaptive_block_invalid_env(monkeypatch):
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BLOCK_K", "70")
+    from chainermn_tpu.ops.flash_attention import _flash_blocks
+    with pytest.raises(ValueError):
+        _flash_blocks(tq=2048, tk=2048)

@@ -37,15 +37,24 @@ def sync(x):
     return float(jnp.asarray(jax.tree.leaves(x)[0]).ravel()[0])
 
 
-def timeit(fn, *args, trials=3):
+def timeit(fn, *args, trials=3, reps=1):
+    """Best-of-`trials` wall time of `fn(*args)`, amortized over `reps`
+    enqueued calls per sync.  reps=1 includes one full dispatch+fetch
+    round-trip (~50-130 ms through this box's relay) in EVERY sample —
+    fine for multi-second workloads, but it swamps fast kernels: the
+    round-5 flash sweep measured the same attention fwd+bwd at 14.9 ms
+    with reps=10 that reps=1 had reported as 143 ms.  Use reps >> 1 for
+    anything faster than ~1 s; device execution is FIFO, so syncing the
+    last output bounds all enqueued work."""
     fn(*args)  # compile
     sync(fn(*args))
     best = None
     for _ in range(trials):
         t0 = time.perf_counter()
-        out = fn(*args)
+        for _ in range(reps):
+            out = fn(*args)
         sync(out)
-        dt = time.perf_counter() - t0
+        dt = (time.perf_counter() - t0) / reps
         best = dt if best is None else min(best, dt)
     return best
 
@@ -401,7 +410,10 @@ def probe_flashcmp():
 
             grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
             try:
-                dt = timeit(lambda a, b, c: grad(a, b, c)[0], q, k, v)
+                # reps amortizes the per-sync relay round-trip; the
+                # r4-era reps=1 numbers overstated both sides ~10x
+                dt = timeit(lambda a, b, c: grad(a, b, c)[0], q, k, v,
+                            reps=10)
                 row[f"{name}_fwd_bwd_ms"] = round(dt * 1e3, 2)
             except Exception as e:  # e.g. HBM OOM for xla at T=8192
                 row[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]

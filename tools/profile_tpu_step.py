@@ -41,12 +41,21 @@ def main():
                     default=None,
                     help="offline per-op diff of two existing traces "
                          "(no jax import, no device touch)")
+    ap.add_argument("--roofline", metavar="DIR", default=None,
+                    help="offline roofline table of an existing trace: "
+                         "per-op achieved FLOP/s vs the HBM/MXU bound "
+                         "implied by its bytes_accessed (no device touch)")
+    ap.add_argument("--steps-hint", type=int, default=8,
+                    help="steps the trace window covered (per-step math)")
     ap.add_argument("--platform", default=None,
                     help="override platform (cpu for a smoke run)")
     args = ap.parse_args()
 
     if args.compare:
         compare(*args.compare)
+        return
+    if args.roofline:
+        roofline(args.roofline, steps=args.steps_hint)
         return
 
     import jax
@@ -158,13 +167,42 @@ def _walk_fields(buf):
             return
 
 
-def _collect(out_dir):
+def _parse_meta_entry(v):
+    """Parse one map<int64, X{Event,Stat}Metadata> entry -> (id, name).
+
+    Entry: key(1) varint, value(2) submessage.  XEventMetadata carries
+    name(2) and display_name(4) — TPU device planes put the HLO op name
+    in `name`; prefer it, fall back to display_name.  XStatMetadata has
+    name(2) only.
+    """
+    k, meta_name, disp_name = None, "", ""
+    for f2, w2, v2 in _walk_fields(v):
+        if f2 == 1 and w2 == 0:
+            k = v2
+        elif f2 == 2 and w2 == 2:
+            for f3, w3, v3 in _walk_fields(v2):
+                if f3 == 2 and w3 == 2:
+                    meta_name = v3.decode(errors="replace")
+                elif f3 == 4 and w3 == 2:
+                    disp_name = v3.decode(errors="replace")
+    return k, (meta_name or disp_name)
+
+
+def _collect(out_dir, by_category=False):
     """Parse the trace into {plane_name: {op_name: total_ps}}.
 
     XSpace: planes(1) -> XPlane{name(2), lines(3) -> XLine{events(4) ->
-    XEvent{metadata_id(1), duration_ps(3)}}, event_metadata(5) map<id,
-    XEventMetadata{id(1), name(2)}>}.  Prefers device planes (TPU);
-    falls back to the host CPU plane for smoke runs.
+    XEvent{metadata_id(1), duration_ps(3), stats(4)}},
+    event_metadata(4) map<id, XEventMetadata{id(1), name(2),
+    display_name(4), stats(5)}>, stat_metadata(5) map<id,
+    XStatMetadata>}.  (Round-5 fix: event names live in plane field 4 —
+    the old parser read field 5, i.e. STAT metadata, so HLO program ops
+    printed as bare numeric ids.)  Prefers device planes (TPU); falls
+    back to the host CPU plane for smoke runs.
+
+    by_category=True groups by the op's `hlo_category` stat (e.g.
+    "convolution", "convolution fusion") instead of individual op name;
+    per-op XStats live on the event METADATA's stats(5) for TPU planes.
     """
     paths = glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"),
                       recursive=True)
@@ -188,26 +226,45 @@ def _collect(out_dir):
     result = {}
     for plane in chosen:
         name = ""
-        metadata = {}
+        metadata = {}        # event metadata id -> op name
+        stat_names = {}      # stat metadata id -> stat name
+        raw_event_meta = {}  # event metadata id -> raw submessage
         lines = []
         for f, w, v in _walk_fields(plane):
             if f == 2 and w == 2:
                 name = v.decode(errors="replace")
             elif f == 3 and w == 2:
                 lines.append(v)
-            elif f == 5 and w == 2:
-                # map entry: key(1) varint, value(2) XEventMetadata
-                k = None
-                meta_name = ""
-                for f2, w2, v2 in _walk_fields(v):
-                    if f2 == 1 and w2 == 0:
-                        k = v2
-                    elif f2 == 2 and w2 == 2:
-                        for f3, w3, v3 in _walk_fields(v2):
-                            if f3 == 2 and w3 == 2:
-                                meta_name = v3.decode(errors="replace")
+            elif f == 4 and w == 2:
+                k, nm = _parse_meta_entry(v)
                 if k is not None:
-                    metadata[k] = meta_name
+                    metadata[k] = nm
+                    for f2, w2, v2 in _walk_fields(v):
+                        if f2 == 2 and w2 == 2:
+                            raw_event_meta[k] = v2
+            elif f == 5 and w == 2:
+                k, nm = _parse_meta_entry(v)
+                if k is not None:
+                    stat_names[k] = nm
+        categories = {}
+        if by_category:
+            # XEventMetadata.stats(5) -> XStat{metadata_id(1),
+            # str_value(5)/ref_value(7)}
+            for mid, raw in raw_event_meta.items():
+                for f2, w2, v2 in _walk_fields(raw):
+                    if f2 != 5 or w2 != 2:
+                        continue
+                    sid, sval = None, None
+                    for f3, w3, v3 in _walk_fields(v2):
+                        if f3 == 1 and w3 == 0:
+                            sid = v3
+                        elif f3 == 5 and w3 == 2:
+                            sval = v3.decode(errors="replace")
+                        elif f3 == 7 and w3 == 0:
+                            sval = stat_names.get(v3, str(v3))
+                    if sid is not None \
+                            and stat_names.get(sid) == "hlo_category":
+                        categories[mid] = sval or "uncategorized"
         totals = {}
         for line in lines:
             for f, w, v in _walk_fields(line):
@@ -219,7 +276,11 @@ def _collect(out_dir):
                         elif f2 == 3 and w2 == 0:
                             dur = v2
                     if mid is not None:
-                        key = metadata.get(mid, str(mid))
+                        if by_category:
+                            key = categories.get(
+                                mid, metadata.get(mid, str(mid)))
+                        else:
+                            key = metadata.get(mid, str(mid))
                         totals[key] = totals.get(key, 0) + dur
         if totals:
             result[name] = totals
@@ -227,7 +288,9 @@ def _collect(out_dir):
 
 
 def summarize(out_dir, top=25):
-    """Print per-op self-time aggregated from the device XPlane."""
+    """Print per-op self-time aggregated from the device XPlane, then
+    the same events grouped by `hlo_category` (conv/fusion/allreduce...)
+    — the category view is what the MFU decision tree reads."""
     collected = _collect(out_dir)
     if collected is None:
         print("no xplane.pb found (trace not written?)")
@@ -241,6 +304,186 @@ def summarize(out_dir, top=25):
         print(f"\n== plane: {name} — total {total_ps/1e12:.3f} s of events")
         for op, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
             print(f"  {ps/1e9:10.3f} ms  {100*ps/total_ps:5.1f}%  {op[:90]}")
+    by_cat = _collect(out_dir, by_category=True) or {}
+    for name, totals in by_cat.items():
+        total_ps = sum(totals.values())
+        print(f"\n== plane: {name} — by hlo_category")
+        for op, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"  {ps/1e9:10.3f} ms  {100*ps/total_ps:5.1f}%  {op[:90]}")
+
+
+def _collect_op_stats(out_dir):
+    """Join device-plane event durations with their metadata's XStats.
+
+    Returns {op_name: {"ps": total_ps, "n": events, "flops": f,
+    "bytes": b, "category": c, "source": s}} — flops/bytes are PER
+    EXECUTION (XLA cost-model numbers stamped on the op), so achieved
+    FLOP/s = flops * n / ps.  Only ops carrying a flops or
+    bytes_accessed stat are returned (i.e. real program ops, not step
+    markers or async DMA span bookkeeping).
+    """
+    import struct
+    paths = glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        return None
+    data = open(max(paths, key=os.path.getmtime), "rb").read()
+    planes = [v for f, w, v in _walk_fields(data) if f == 1 and w == 2]
+    result = {}
+    for plane in planes:
+        pname = ""
+        stat_names = {}
+        metas = {}   # mid -> raw XEventMetadata
+        lines = []
+        for f, w, v in _walk_fields(plane):
+            if f == 2 and w == 2:
+                pname = v.decode(errors="replace")
+            elif f == 3 and w == 2:
+                lines.append(v)
+            elif f == 4 and w == 2:
+                k = None
+                raw = None
+                for f2, w2, v2 in _walk_fields(v):
+                    if f2 == 1 and w2 == 0:
+                        k = v2
+                    elif f2 == 2 and w2 == 2:
+                        raw = v2
+                if k is not None and raw is not None:
+                    metas[k] = raw
+            elif f == 5 and w == 2:
+                k, nm = _parse_meta_entry(v)
+                if k is not None:
+                    stat_names[k] = nm
+        if "TPU" not in pname:
+            continue
+        info = {}
+        for mid, raw in metas.items():
+            nm = ""
+            st = {}
+            for f2, w2, v2 in _walk_fields(raw):
+                if f2 == 2 and w2 == 2:
+                    nm = v2.decode(errors="replace")
+                elif f2 == 5 and w2 == 2:
+                    # XStat value oneof: double_value=2 (fixed64),
+                    # uint64_value=3 / int64_value=4 / ref_value=7
+                    # (varint), str_value=5 (len-delimited).  This
+                    # profiler stamps flops/bytes_accessed via the
+                    # int64_value field.
+                    sid, val = None, None
+                    for f3, w3, v3 in _walk_fields(v2):
+                        if f3 == 1 and w3 == 0:
+                            sid = v3
+                        elif f3 == 2 and w3 == 1:
+                            val = struct.unpack("<d", v3)[0]
+                        elif f3 in (3, 4) and w3 == 0:
+                            val = v3
+                        elif f3 == 5 and w3 == 2:
+                            val = v3.decode(errors="replace")
+                        elif f3 == 7 and w3 == 0:
+                            # interned string stat: resolve the ref
+                            val = stat_names.get(v3, str(v3))
+                    if sid is not None:
+                        st[stat_names.get(sid, sid)] = val
+            info[mid] = (nm, st)
+        durs = {}
+        for line in lines:
+            for f, w, v in _walk_fields(line):
+                if f == 4 and w == 2:
+                    mid, dur = None, 0
+                    for f2, w2, v2 in _walk_fields(v):
+                        if f2 == 1 and w2 == 0:
+                            mid = v2
+                        elif f2 == 3 and w2 == 0:
+                            dur = v2
+                    if mid is not None:
+                        a = durs.setdefault(mid, [0, 0])
+                        a[0] += dur
+                        a[1] += 1
+        for mid, (ps, n) in durs.items():
+            nm, st = info.get(mid, (str(mid), {}))
+            flops = st.get("flops") or st.get("model_flops") or 0
+            nbytes = st.get("bytes_accessed") or 0
+            if not flops and not nbytes:
+                continue
+            # the same op name can recur across planes (multi-core) or
+            # metadata ids — SUM, don't overwrite (cf. compare()'s merge)
+            prev = result.get(nm)
+            if prev is None:
+                result[nm] = {"ps": ps, "n": n, "flops": flops,
+                              "bytes": nbytes,
+                              "category": st.get("hlo_category", ""),
+                              "source": st.get("source", "")}
+            else:
+                # flops/bytes are per-execution costs: keep them, sum
+                # the observed time/executions
+                prev["ps"] += ps
+                prev["n"] += n
+    return result
+
+
+def roofline(out_dir, steps=8, peak_tflops=197.0, peak_hbm_gbs=819.0,
+             top=20):
+    """Offline roofline: which bound (MXU flops vs HBM bytes) each op
+    sits against, from the trace's own per-op cost stats.
+
+    For each op: achieved = flops*n/ps; bound = min(peak_tflops,
+    intensity * peak_hbm_gbs) where intensity = flops/bytes.  An op
+    near its bandwidth bound but far from peak flops is HBM-bound —
+    no amount of MXU scheduling recovers it.  Prints per-category
+    aggregates then the top ops by total time.  Pure parsing — safe
+    while a chip session is live.  Peaks: v5e bf16 defaults,
+    override via BENCH_PEAK_TFLOPS / BENCH_PEAK_HBM_GBS env.
+    """
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", peak_tflops))
+    peak_hbm_gbs = float(os.environ.get("BENCH_PEAK_HBM_GBS",
+                                        peak_hbm_gbs))
+    ops = _collect_op_stats(out_dir)
+    if not ops:
+        print("no per-op cost stats found in trace")
+        return
+    cats = {}
+    for nm, d in ops.items():
+        c = cats.setdefault(d["category"] or "uncategorized",
+                            [0, 0, 0])
+        c[0] += d["ps"]
+        c[1] += d["flops"] * d["n"]
+        c[2] += d["bytes"] * d["n"]
+    tot_ps = sum(c[0] for c in cats.values())
+    tot_fl = sum(c[1] for c in cats.values())
+    tot_by = sum(c[2] for c in cats.values())
+    print(f"trace {out_dir}: {tot_ps/1e12:.3f} s of costed-op time, "
+          f"{tot_fl/1e12:.2f} TFLOP, {tot_by/1e9:.2f} GB accessed "
+          f"(/{steps} steps: {tot_fl/steps/1e9:.1f} GFLOP, "
+          f"{tot_by/steps/1e9:.2f} GB per step)")
+    print(f"peaks: {peak_tflops:.0f} TFLOP/s bf16, "
+          f"{peak_hbm_gbs:.0f} GB/s HBM "
+          f"(ridge {peak_tflops*1e3/peak_hbm_gbs:.0f} FLOP/byte)")
+    print(f"\n{'category':<28}{'ms/step':>9}{'TFLOP/s':>9}"
+          f"{'GB/s':>8}{'int.':>7}  bound")
+    for cat, (ps, fl, by) in sorted(cats.items(), key=lambda kv:
+                                    -kv[1][0]):
+        if ps == 0:
+            continue
+        tfs = fl / ps * 1e12 / 1e12 if ps else 0.0
+        gbs = by / ps * 1e12 / 1e9 if ps else 0.0
+        inten = fl / by if by else float("inf")
+        bw_bound = inten * peak_hbm_gbs / 1e3   # TFLOP/s cap from HBM
+        bound = ("HBM" if bw_bound < peak_tflops else "MXU")
+        util = (gbs / peak_hbm_gbs if bound == "HBM"
+                else tfs / peak_tflops)
+        print(f"{cat:<28}{ps/1e9/steps:>9.3f}{tfs:>9.1f}{gbs:>8.0f}"
+              f"{inten:>7.0f}  {bound} ({100*util:.0f}% of its bound)")
+    print(f"\ntop ops by time ({'ms/step':>7}, achieved TFLOP/s, GB/s, "
+          "bound):")
+    for nm, d in sorted(ops.items(), key=lambda kv: -kv[1]["ps"])[:top]:
+        ps, fl, by = d["ps"], d["flops"] * d["n"], d["bytes"] * d["n"]
+        tfs = fl / ps * 1e12 / 1e12 if ps else 0.0
+        gbs = by / ps * 1e12 / 1e9 if ps else 0.0
+        inten = fl / by if by else float("inf")
+        bound = ("HBM" if inten * peak_hbm_gbs / 1e3 < peak_tflops
+                 else "MXU")
+        print(f"  {ps/1e9/steps:7.3f} {tfs:7.1f} {gbs:6.0f} {bound:>4}"
+              f"  {nm[:70]}")
 
 
 def compare(dir_a, dir_b, top=30):
