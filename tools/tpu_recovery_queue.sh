@@ -61,15 +61,21 @@ run_one "resnet bs64 real input pipeline (uint8 native gather)" \
   BENCH_INPUT_PIPELINE=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+# seq-8192 remat rows LAST among the benches, with compile headroom:
+# the round-5 session saw this config exceed a 900 s deadline with the
+# adaptive (1024-wide) attention tiles, and the deadline exit abandoned
+# an in-flight remote-compile RPC, wedging the relay for the cheap rows
+# that would have followed.  1800 s lets a slow Mosaic/remat compile
+# finish instead of being abandoned mid-RPC.
 run_one "transformer bs2 seq8192 remat (full)" \
   BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 \
-  BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+  BENCH_DEADLINE_S=1800 BENCH_TRIALS=3
 # same long-context config under the dots policy (keep GEMM outputs,
 # recompute elementwise/attention): the delta vs the full-remat row is
 # the policy's MFU payoff on chip
 run_one "transformer bs2 seq8192 remat (dots policy)" \
   BENCH_MODEL=transformer BENCH_BS=2 BENCH_SEQ=8192 BENCH_REMAT=1 \
-  BENCH_REMAT_POLICY=dots BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+  BENCH_REMAT_POLICY=dots BENCH_DEADLINE_S=1800 BENCH_TRIALS=3
 
 # Fold THIS run's authoritative JSON lines into BENCH_NOTES so the round
 # records the on-chip numbers even if nobody is awake to do it manually.
