@@ -79,6 +79,19 @@ DEFAULT_TF_VOCAB = 32768
 
 _CACHE_PATH = os.environ.get("BENCH_CACHE_PATH",
                              "/tmp/chainermn_tpu_last_bench.json")
+# Repo-committed fallback slot for the same cache: /tmp is wiped by
+# machine restarts (round 5 saw the restart that HEALED the relay also
+# destroy the freshly recorded flagship datum), so every successful
+# flagship run mirrors its entry here too.  The builder commits the
+# file; a wedged driver run on a fresh /tmp can then still re-serve a
+# fingerprint-matched real-TPU datum — marked stale, with its original
+# run_id/saved_at — instead of failing empty.  Read goes through the
+# same `_cacheable`/fingerprint gates as the primary slot.  Empty
+# string disables.
+_REPO_CACHE_PATH = os.environ.get(
+    "BENCH_REPO_CACHE_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_last_good.json"))
 # Touched after a successful real-accelerator trial: signals the
 # persistent XLA compile cache is warm.  Per MODEL family (resnet50 /
 # transformer compile different programs — a warm transformer cache says
@@ -224,17 +237,26 @@ def _cacheable(result):
     that predate fingerprint storage.  Round-3 postmortem: a 32×32/bs-2
     CPU smoke persisted by a harness test was re-emitted under the
     headline TPU metric when the relay wedged."""
-    if result.get("value") is None or result.get("stale") \
-            or result.get("error"):
-        return False
-    if result.get("platform") in (None, "cpu", "cpu_fallback"):
-        return False
     metric = result.get("metric")
     model = _METRIC_TO_MODEL.get(metric)
     if model is None:
         return False
     if _config_fingerprint(model) != _DEFAULT_FINGERPRINTS[model]:
         return False  # this process requested a non-flagship config
+    # value/stale/error/platform sanity lives in the shared payload
+    # helper (one copy — it doubles as the cross-slot write screen)
+    return _payload_flagship_ok(model, result)
+
+
+def _payload_flagship_ok(model, result):
+    """The payload half of `_cacheable`'s gates — result-field sanity
+    checks that need no environment, shared with the cross-slot write
+    screen (`_entry_shape_ok`) so a fingerprint-less planted entry
+    cannot bypass them."""
+    if result.get("value") is None or result.get("stale") \
+            or result.get("error") \
+            or result.get("platform") in (None, "cpu", "cpu_fallback"):
+        return False
     if model == "resnet50":
         # batch bounds: OOM backoff halves the requested batch at most
         # twice (lower bound); anything ABOVE the default batch is a
@@ -292,17 +314,30 @@ def _emit(result, persist=True):
     if not persist or not _cacheable(result):
         return
     try:
-        entries = {}
-        try:
-            with open(_CACHE_PATH) as f:
-                data = json.load(f)
-            entries = data.get("entries", {})
-            if not entries and data.get("result"):  # legacy single-slot
-                legacy_metric = data["result"].get("metric")
-                if legacy_metric:
-                    entries = {legacy_metric: data}
-        except Exception:
-            pass
+        # merge both slots (newest saved_at wins per metric: a stale
+        # local /tmp entry must not overwrite a newer repo-committed
+        # one, nor vice versa) so a restart-wiped /tmp does not drop
+        # the OTHER metric's entry on the next write; screen every
+        # carried entry so transient /tmp poison (the round-3 plant
+        # vector) cannot be promoted into the committed repo file
+        # where it would outlive restarts
+        # screen FIRST: a poison entry must be dropped before the
+        # newest-wins arbitration, or its (arbitrary) saved_at could
+        # displace a valid older entry from the other slot.  Repo
+        # entries this version cannot judge (a newer branch's metric or
+        # fingerprint schema) are preserved verbatim — the screens
+        # protect the slots we understand, they must not DELETE durable
+        # committed data we don't.  /tmp entries we cannot judge are
+        # NEVER promoted into the committed slot: transient state earns
+        # durability only by passing the screens.
+        entries = {m: e for m, e
+                   in _read_cache_entries(_REPO_CACHE_PATH).items()
+                   if not _judgeable(m, e) or _entry_shape_ok(m, e)}
+        for m, e in _read_cache_entries(_CACHE_PATH).items():
+            if not _judgeable(m, e) or not _entry_shape_ok(m, e):
+                continue
+            if m not in entries or _saved_at(e) >= _saved_at(entries[m]):
+                entries[m] = e
         # one slot per metric: a transformer run must not destroy the
         # last-good resnet datum (the recovery queue interleaves both)
         entries[result["metric"]] = {
@@ -313,12 +348,94 @@ def _emit(result, persist=True):
         # atomic replace: the multi-entry file must not be left truncated
         # by a supervisor SIGKILL mid-write (that would destroy BOTH
         # metrics' last-good data)
-        tmp = _CACHE_PATH + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"entries": entries}, f)
-        os.replace(tmp, _CACHE_PATH)
+        for path in (_CACHE_PATH, _REPO_CACHE_PATH):
+            if not path:
+                continue
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"entries": entries}, f)
+                os.replace(tmp, path)
+            except Exception:
+                pass  # a read-only repo must not break the /tmp slot
     except Exception:
         pass
+
+
+def _saved_at(entry):
+    """Numeric saved_at for merge arbitration; malformed → 0."""
+    ts = entry.get("saved_at", 0)
+    return ts if isinstance(ts, (int, float)) else 0
+
+
+def _backfill_fp(model, fp):
+    """Stored fingerprint completed with the flagship defaults for keys
+    a pre-schema-bump writer didn't know.  ONE copy — used by both the
+    write screen and the read gate, so they cannot desync."""
+    default = _DEFAULT_FINGERPRINTS[model]
+    return {**{k: v for k, v in default.items() if k not in fp}, **fp}
+
+
+def _judgeable(metric, entry):
+    """Can THIS version meaningfully validate the entry?  False for a
+    metric we don't know, or a fingerprint carrying keys a NEWER
+    branch's schema added (backfill only works forward).  Screening
+    what we can't judge would delete durable committed data, so the
+    repo-slot merge preserves such entries verbatim — while `_load_cache`
+    still refuses to SERVE them (its gates require a judgeable match)
+    and the /tmp→repo promotion path drops them entirely."""
+    if metric not in _METRIC_TO_MODEL:
+        return False
+    if not isinstance(entry, dict):
+        return True  # malformed shapes ARE judgeable (and rejected)
+    fp = entry.get("fingerprint")
+    if isinstance(fp, dict) and set(fp) - set(
+            _DEFAULT_FINGERPRINTS[_METRIC_TO_MODEL[metric]]):
+        return False
+    return True
+
+
+def _read_cache_entries(path):
+    """entries dict from one cache file, {} on any problem; tolerates the
+    legacy single-slot format."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries", {})
+        if not entries and isinstance(data.get("result"), dict):
+            legacy_metric = data["result"].get("metric")  # single-slot
+            if legacy_metric:
+                entries = {legacy_metric: data}
+        return entries if isinstance(entries, dict) else {}
+    except Exception:
+        return {}
+
+
+def _entry_shape_ok(metric, entry):
+    """Defensive screen for a cache entry carried across slots or read
+    back for re-serve: a hand-edited/truncated/planted file must never
+    crash the harness (the stale path is documented 'never raises') nor
+    have a non-flagship payload promoted into the committed repo slot.
+    Checks shape plus the STORED fingerprint against the flagship
+    default (env-fingerprint and payload gates are the reader's job)."""
+    if not isinstance(entry, dict):
+        return False
+    result = entry.get("result")
+    if not isinstance(result, dict) or result.get("metric") != metric:
+        return False
+    model = _METRIC_TO_MODEL.get(metric)
+    if model is None:
+        return False
+    fp = entry.get("fingerprint")
+    if fp is not None:
+        if not isinstance(fp, dict):
+            return False
+        if _backfill_fp(model, fp) != _DEFAULT_FINGERPRINTS[model]:
+            return False
+    # payload gates apply to fingerprint-less (legacy/planted) entries
+    # too: without this, a non-flagship /tmp payload passes the screen
+    # and gets promoted into the committed repo slot
+    return _payload_flagship_ok(model, result)
 
 
 def _load_cache(metric):
@@ -329,23 +446,41 @@ def _load_cache(metric):
     ADDED fingerprint key (e.g. n_steps) is backfilled with that key's
     default — mirroring the payload checks' legacy tolerance, so a
     fingerprint-schema bump cannot orphan a valid flagship datum
-    mid-outage."""
-    try:
-        with open(_CACHE_PATH) as f:
-            data = json.load(f)
-        if "entries" in data:
-            entry = data["entries"].get(metric) or {}
-        elif data.get("result", {}).get("metric") == metric:
-            entry = data
-        else:
-            entry = {}
-        fp = entry.get("fingerprint")
-        if fp is not None:
-            default = _DEFAULT_FINGERPRINTS.get(fp.get("model"), {})
-            fp = {**{k: v for k, v in default.items() if k not in fp}, **fp}
-        return entry.get("run_id"), entry.get("result"), fp
-    except Exception:
-        return None, None, None
+    mid-outage.  Falls back to the repo-committed slot when /tmp has no
+    SERVABLE entry for the metric: an entry the downstream gates would
+    refuse (malformed shape, wrong fingerprint, non-flagship payload)
+    must not mask a valid datum one slot further down — serving nothing
+    because /tmp held poison is the exact outcome the repo slot was
+    added to prevent.  Never raises (the stale path's contract)."""
+    best = None  # (entry, backfilled_fp) — newest saved_at wins, the
+    # same arbitration `_emit` applies on write: a valid-but-older /tmp
+    # entry must not shadow a newer committed repo datum
+    for path in (_CACHE_PATH, _REPO_CACHE_PATH):
+        if not path:
+            continue
+        try:
+            entry = _read_cache_entries(path).get(metric)
+            if not _entry_shape_ok(metric, entry):
+                continue
+            model = _METRIC_TO_MODEL[metric]  # non-None per shape check
+            fp = entry.get("fingerprint")
+            if fp is not None:
+                # backfill from the METRIC's model (matching the shape
+                # check), not fp's own "model" key: a schema-bump entry
+                # lacking that key must still resolve to its defaults
+                fp = _backfill_fp(model, fp)
+                if fp != _config_fingerprint(model):
+                    continue  # current process requests another config
+            if not _cacheable(entry["result"]):
+                continue
+            if best is None or _saved_at(entry) > _saved_at(best[0]):
+                best = (entry, fp)
+        except Exception:
+            continue
+    if best is not None:
+        entry, fp = best
+        return entry.get("run_id"), entry["result"], fp
+    return None, None, None
 
 
 def _resnet50_train_flops_per_image(image_size):
@@ -693,11 +828,12 @@ def _emit_stale_or_error(err):
     non-accelerator payload under the flagship metric is worse than
     ``value: null`` — it reads as a (terrible) datum."""
     metric, unit = _err_metric()
+    # _load_cache is the single authoritative gate: it returns ONLY an
+    # entry that passed the shape screen, the stored-vs-requested
+    # fingerprint match, and `_cacheable`'s env+payload checks — or
+    # (None, None, None)
     run_id, cached, fp = _load_cache(metric)
-    model = _METRIC_TO_MODEL.get(metric)
-    fp_ok = fp is None or (model and fp == _config_fingerprint(model))
-    if cached and cached.get("metric") == metric and fp_ok \
-            and _cacheable(cached):
+    if cached:
         out = dict(cached)
         if run_id != os.environ["BENCH_RUN_ID"]:
             out["stale"] = True  # measured by an earlier bench invocation
