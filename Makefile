@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test bench bench-smoke bench-prewarm scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
+.PHONY: test bench bench-smoke bench-prewarm bench-status scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -38,6 +38,14 @@ watch:            ## start the detached TPU relay recovery watcher (idempotent)
 	@pgrep -f "[t]pu_relay_watch.sh" > /dev/null && echo "watcher already running:" || \
 	  (setsid nohup bash tools/tpu_relay_watch.s''h > /tmp/tpu_watch.log 2>&1 < /dev/null &) ; \
 	sleep 1; pgrep -f "[t]pu_relay_watch.sh"
+
+bench-status:     ## last-good cache slots + detached-children registry
+	@echo "== /tmp cache slot =="
+	@python3 -c "import json; d=json.load(open('/tmp/chainermn_tpu_last_bench.json')); [print(' ', m, e['result'].get('value'), e['result'].get('unit','')) for m, e in d['entries'].items()]" 2>/dev/null || echo "  (absent -- wiped by restart?)"
+	@echo "== committed repo slot (bench_last_good.json) =="
+	@python3 -c "import json; d=json.load(open('bench_last_good.json')); [print(' ', m, e['result'].get('value'), e['result'].get('unit','')) for m, e in d['entries'].items()]" 2>/dev/null || echo "  (absent)"
+	@echo "== detached bench children (pid starttime) =="
+	@cat /tmp/chainermn_tpu_bench_detached.pids 2>/dev/null || echo "  (none)"
 
 watch-status:     ## round-start checklist: watcher liveness + probe + queue state
 	@pgrep -af "[t]pu_relay_watch.sh" || echo "WATCHER DEAD -- run: make watch"
