@@ -387,6 +387,12 @@ def probe_flashcmp():
     default_t = "256" if interp else "2048,8192"
     seqs = tuple(int(t) for t in
                  os.environ.get("PROBE_T", default_t).split(","))
+    if interp:
+        # clamp REQUESTED lengths too, not just the default: interpret-
+        # mode grad at long T is effectively unbounded and xla's [T,T]
+        # fp32 scores exhaust host RAM — an unattended queue run that
+        # silently fell back to cpu must not wedge the box
+        seqs = tuple(t for t in seqs if t <= 512) or (256,)
     scale = 1.0 / (D ** 0.5)
 
     def flash_loss(q, k, v):

@@ -91,9 +91,13 @@ run_one "transformer bs2 seq8192 remat (dots policy)" \
   echo '```'
 } >> "$NOTES"
 
-echo "--- flash vs xla attention T=2048/8192 (unsupervised: may wedge) ---"
+echo "--- flash vs xla attention T=1024/2048/4096/8192 (unsupervised: may wedge) ---"
 stepf=$STEPDIR/step_flashcmp.log
-PROBE=flashcmp python tools/probe_perf.py > "$stepf" 2>&1 || true
+# T=1024 decides whether flash should defer to XLA at the flagship
+# seq; 4096 anchors the speedup curve's midpoint (2.40x when measured
+# by hand on Jul 31); 8192 is the XLA-cannot-compile feasibility row
+PROBE=flashcmp PROBE_T=1024,2048,4096,8192 \
+  python tools/probe_perf.py > "$stepf" 2>&1 || true
 cat "$stepf"
 if grep -q '^{' "$stepf"; then
   {
