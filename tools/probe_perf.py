@@ -393,6 +393,11 @@ def probe_flashcmp():
         # fp32 scores exhaust host RAM — an unattended queue run that
         # silently fell back to cpu must not wedge the box
         seqs = tuple(t for t in seqs if t <= 512) or (256,)
+        print(json.dumps({"probe": "flash_vs_xla_attention",
+                          "warning": "cpu interpret mode: requested "
+                          "PROBE_T clamped; timings validate mechanics "
+                          "only, not perf", "seqs": list(seqs)}),
+              flush=True)
     scale = 1.0 / (D ** 0.5)
 
     def flash_loss(q, k, v):
@@ -412,6 +417,8 @@ def probe_flashcmp():
                    for i in range(3))
         row = {"probe": "flash_vs_xla_attention", "B": B, "H": H, "T": T,
                "D": D}
+        if interp:
+            row["interpreted"] = True  # mechanics smoke, not perf
         for name, loss in (("flash", flash_loss), ("xla", xla_loss)):
 
             grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))

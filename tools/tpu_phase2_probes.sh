@@ -1,0 +1,30 @@
+#!/bin/bash
+# Optional phase-2 on-chip probes — run MANUALLY after the recovery
+# queue's matrix completes, never unattended.  Encodes the seq8192-bs4
+# postmortem (BENCH_NOTES r5): heavy-compile configs are probed with a
+# BENCH_STEPS=1 compile-only run first; the full measurement only
+# happens if the probe produced a real datum.  With the detach-at-
+# deadline harness a failed probe cannot wedge the relay, but it can
+# leave a draining child — the guard also avoids starting a full row
+# that would be marked contended against it.
+cd "$(dirname "$0")/.."
+
+run() { desc=$1; shift; echo "--- $desc ---" >&2; env "$@" python bench.py 2>/dev/null | grep '^{' | tail -1; }
+
+# 16k-token end-to-end training step: the flash kernel is the only
+# attention that compiles at this T on this backend (queue flashcmp),
+# so a recorded tokens/sec at seq 16384 is a capability XLA attention
+# cannot reach here at all.
+probe=$(run "tfm seq16384 bs1 remat COMPILE PROBE (1 step)" \
+  BENCH_MODEL=transformer BENCH_BS=1 BENCH_SEQ=16384 BENCH_REMAT=1 \
+  BENCH_STEPS=1 BENCH_TRIALS=1 BENCH_DEADLINE_S=1800)
+echo "$probe"
+case "$probe" in
+  *'"value": null'*|"")
+    echo "compile probe failed — do NOT run the full row (a detached" \
+         "child may still be draining; check make bench-status)" >&2
+    exit 1;;
+esac
+run "tfm seq16384 bs1 remat (full row)" \
+  BENCH_MODEL=transformer BENCH_BS=1 BENCH_SEQ=16384 BENCH_REMAT=1 \
+  BENCH_DEADLINE_S=1800 BENCH_TRIALS=2
