@@ -52,6 +52,29 @@ The training step is the framework's real data-parallel path:
 all available chips (one on this box), bf16 conv compute, bf16 gradient
 compression — the TPU translation of the reference's flagship
 ``pure_nccl`` fp16 configuration (SURVEY §2.1 pure_nccl).
+
+Env knobs (defaults = the flagship config; any deviation makes the run
+a variant that is excluded from the last-good cache):
+
+  measurement   BENCH_MODEL (resnet50|transformer), BENCH_BS,
+                BENCH_SIZE, BENCH_LAYOUT (NHWC|NCHW), BENCH_SCAN,
+                BENCH_REMAT, BENCH_INPUT_PIPELINE — resnet;
+                BENCH_SEQ, BENCH_D_MODEL, BENCH_LAYERS, BENCH_VOCAB,
+                BENCH_HEADS, BENCH_REMAT_POLICY — transformer;
+                BENCH_STEPS (steps/trial), BENCH_TRIALS,
+                BENCH_PEAK_TFLOPS (MFU denominator override)
+  deadline      BENCH_DEADLINE_S (else 270 s warm / 480 s first
+                contact per model, via BENCH_PREWARM_SENTINEL)
+  cache slots   BENCH_CACHE_PATH (/tmp), BENCH_REPO_CACHE_PATH
+                (committed bench_last_good.json; "" disables)
+  detach        BENCH_DETACH_REGISTRY (lingering-children registry),
+                BENCH_START_STAMP (cross-run contention detection)
+  internal      BENCH_SUPERVISED / BENCH_RUN_ID / BENCH_STALE_FP /
+                BENCH_CONTENDED (set by the supervisor),
+                BENCH_NO_SUPERVISE (child only — deadline becomes
+                cooperative-only), BENCH_NO_FALLBACK (disable the CPU
+                fallback re-exec), BENCH_BS_CPU (fallback batch),
+                BENCH_TEST_WEDGE (fault injection for tests)
 """
 
 import json
