@@ -25,33 +25,79 @@ from __future__ import annotations
 
 import jax
 
+from ._host_channel import (ChannelError, ChannelTimeoutError, PeerLostError,
+                            HostChannel, HeartbeatMonitor)
 from .communicator_base import CommunicatorBase
 from .debug_communicator import DebugCommunicator
 from .dummy_communicator import DummyCommunicator
+from .fault_injection_communicator import (FaultInjectionCommunicator,
+                                           bind_host_channel)
+from .fault_schedule import (FaultSchedule, FaultSpec, InjectedFault,
+                             schedule_from_env)
 from .mesh_communicator import MeshCommunicator
 
 __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
-           "DummyCommunicator", "DebugCommunicator"]
+           "DummyCommunicator", "DebugCommunicator",
+           "FaultInjectionCommunicator", "FaultSchedule", "FaultSpec",
+           "InjectedFault", "bind_host_channel", "schedule_from_env",
+           "ChannelError", "ChannelTimeoutError", "PeerLostError",
+           "HostChannel", "HeartbeatMonitor"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
-          "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug")
+          "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug",
+          "fault")
 
 
 def create_communicator(communicator_name="jax_ici", devices=None,
                         axis_name="mn_world", allreduce_grad_dtype=None,
-                        batch_collectives=None, **kwargs):
+                        batch_collectives=None, fault_schedule=None,
+                        **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
     (reference fp16 path; bf16 recommended on TPU).  ``devices``: subset of
-    ``jax.devices()`` (default all).
+    ``jax.devices()`` (default all).  ``fault_schedule`` (``fault`` name
+    only): a :class:`FaultSchedule` or spec dict; defaults to
+    ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the environment — the chaos
+    harness's entry point (see ``docs/resilience.md``).
     """
     name = communicator_name
     if name not in _NAMES:
         raise ValueError(
             f"unknown communicator {name!r}; choose from {_NAMES}")
+    if fault_schedule is not None and name != "fault":
+        raise ValueError(
+            f"fault_schedule= is only honored by the 'fault' "
+            f"communicator, not {name!r} — a silently dropped schedule "
+            f"would make a chaos run pass vacuously")
     if name == "dummy":
         return DummyCommunicator()
+    if name == "fault":
+        schedule = fault_schedule if fault_schedule is not None \
+            else schedule_from_env()
+        if schedule is None:
+            raise ValueError(
+                "communicator 'fault' needs fault_schedule= or the "
+                "CHAINERMN_TPU_FAULT_SCHEDULE env var")
+        if isinstance(schedule, dict):
+            schedule = FaultSchedule.from_dict(schedule)
+        base = create_communicator(
+            "jax_ici", devices=devices, axis_name=axis_name,
+            allreduce_grad_dtype=allreduce_grad_dtype,
+            batch_collectives=batch_collectives, **kwargs)
+        # the hc.* transport hook gets its own schedule CLONE (same
+        # specs + seed, separate RNG stream/counters): transport call
+        # counts are inherently per-rank asymmetric (root puts,
+        # non-root gets, retries), and sharing one RNG stream would let
+        # that asymmetry desync the communicator-surface draws across
+        # ranks — breaking the lock-step same-call-site guarantee the
+        # wrapper documents.  hc faults are recorded on the clone.
+        comm = FaultInjectionCommunicator(base, schedule)
+        channel = base._host_channel()
+        if channel is not None:
+            comm.hc_schedule = bind_host_channel(
+                channel, FaultSchedule.from_dict(schedule.to_dict()))
+        return comm
     if name == "debug":
         return DebugCommunicator(devices=devices, axis_name=axis_name,
                                  allreduce_grad_dtype=allreduce_grad_dtype,

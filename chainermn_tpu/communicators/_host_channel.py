@@ -20,18 +20,70 @@ Design:
   epoch-counted: SPMD lock-step call order is the correctness contract,
   the same invariant the reference inherits from MPI.
 * Keys are deleted by their *reader(s)* once consumed (last reader for
-  collectives), so the store does not grow with training time.
+  collectives) — and, since the resilience pass, by their *writer* in a
+  ``finally`` when a collective fails partway, so an exception can never
+  strand chunk/seq keys that would poison the next matched op.
+
+Failure semantics (see ``docs/resilience.md``):
+
+* Every op runs under a **per-op deadline** (``op_timeouts``) with
+  **bounded retry + exponential backoff** for transient transport
+  errors; exhaustion raises :class:`ChannelTimeoutError` (typed, carries
+  op + key) instead of a bare runtime error after one flat 600 s wait.
+* An optional **heartbeat monitor** posts this process's liveness to the
+  store and audits peers' beats while blocked in a get, converting a
+  peer-stall hang into :class:`PeerLostError` carrying the suspected
+  rank — the detection half of the fail-stop contract.
+* All keys live under a **generation** prefix; ``bump_generation()``
+  (called by the recovery supervisor) rotates it and re-arms sequence/
+  epoch counters, so keys stranded by a fault can never match ops issued
+  by the recovered incarnation.
+* **Fault hook points** (``set_fault_hook``) let the chaos harness
+  inject transport faults — lost chunk, stale meta key, straggle,
+  transient raise — at the exact put/get/barrier sites a real multi-host
+  failure would hit, without a real multi-host run.
 """
 
 from __future__ import annotations
 
 import pickle
 import threading
+import time
 
-__all__ = ["HostChannel", "get_host_channel"]
+__all__ = ["HostChannel", "HeartbeatMonitor", "get_host_channel",
+           "reset_host_channel", "ChannelError", "ChannelTimeoutError",
+           "PeerLostError"]
 
 _DEFAULT_CHUNK = 1 << 20  # 1 MiB
 _DEFAULT_TIMEOUT_MS = 600_000
+
+
+class ChannelError(RuntimeError):
+    """Base class for typed host-channel transport failures."""
+
+
+class ChannelTimeoutError(ChannelError):
+    """An op exhausted its deadline/retry budget.  Carries op and key."""
+
+    def __init__(self, op, key, timeout_ms, attempts):
+        self.op = op
+        self.key = key
+        self.timeout_ms = timeout_ms
+        self.attempts = attempts
+        super().__init__(
+            f"host-channel {op!r} timed out on {key!r} after "
+            f"{attempts} attempt(s) within {timeout_ms} ms")
+
+
+class PeerLostError(ChannelError):
+    """A peer's heartbeat went stale while we were blocked on it."""
+
+    def __init__(self, rank, stale_s):
+        self.rank = rank
+        self.stale_s = stale_s
+        super().__init__(
+            f"peer process {rank} presumed lost: heartbeat stale for "
+            f"{stale_s:.1f}s")
 
 
 def _kv_client():
@@ -43,51 +95,277 @@ def _kv_client():
         return None
 
 
+class HeartbeatMonitor:
+    """Liveness over the KV store: each process posts a beat token under
+    its rank; ``check()`` raises :class:`PeerLostError` for a peer whose
+    token has not *changed* for longer than ``stall_s``.
+
+    Staleness is measured entirely on the observer's clock — the time
+    since this process last saw the peer's token change — never by
+    differencing two hosts' wall clocks, so cross-host clock skew cannot
+    fabricate a lost peer.
+
+    A peer that has *never* beaten is not accused — processes may enable
+    heartbeats at different times, and absence of the key is
+    indistinguishable from "not enabled".  Detection therefore needs one
+    observed beat from the peer, after which frozen silence is evidence.
+
+    Without the background ``thread``, beats are only posted from inside
+    blocked channel gets — a peer busy in a long compile/compute stretch
+    would go stale and be falsely accused.  Production use should keep
+    the daemon beater (the ``enable_heartbeat`` default); thread-less
+    mode exists for deterministic fake-clock tests, where ``stall_s``
+    must exceed the longest legitimate beat gap.
+    """
+
+    def __init__(self, channel, interval_s=2.0, stall_s=None,
+                 wall=time.time):
+        self._ch = channel
+        self.interval_s = float(interval_s)
+        self.stall_s = float(stall_s) if stall_s is not None \
+            else 5.0 * self.interval_s
+        self._wall = wall
+        self._last_beat = float("-inf")
+        self._beat_counter = 0
+        self._seen = {}  # rank -> (token, observer-local first-seen time)
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start_thread(self):
+        """Daemon beater: posts liveness every ``interval_s`` regardless
+        of what the main thread is doing (compiles, compute), so only a
+        truly dead/hung *process* ever goes stale."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.beat(force=True)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="cmn-heartbeat")
+        self._thread.start()
+
+    def stop_thread(self):
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=self.interval_s + 1.0)
+            self._thread = None
+
+    def _key(self, rank):
+        return f"{self._ch._prefix()}/hb/{rank}"
+
+    def beat(self, force=False):
+        now = self._wall()
+        if not force and now - self._last_beat < self.interval_s:
+            return
+        self._last_beat = now
+        self._beat_counter += 1
+        try:
+            # the value is an opaque change-token, never compared to any
+            # clock: the counter guarantees every beat is a fresh value
+            self._ch._client.key_value_set(
+                self._key(self._ch.process_id),
+                f"{self._beat_counter}:{now!r}")
+        except Exception:
+            pass  # liveness posting must never take the poster down
+
+    def check(self):
+        now = self._wall()
+        for rank in range(self._ch.num_processes):
+            if rank == self._ch.process_id:
+                continue
+            try:
+                raw = self._ch._client.key_value_try_get(self._key(rank))
+            except Exception:
+                raw = None
+            if raw is None:
+                continue
+            prev = self._seen.get(rank)
+            if prev is None or prev[0] != raw:
+                self._seen[rank] = (raw, now)  # fresh token: alive
+                continue
+            stale = now - prev[1]
+            if stale > self.stall_s:
+                raise PeerLostError(rank, stale)
+
+
 class HostChannel:
     """Pickled-object transport between controller processes.
 
     One instance per (communicator, namespace).  All methods are
     host-side and blocking; they must be called in SPMD lock-step where
     documented (allgather/bcast/barrier), mirroring MPI semantics.
+
+    ``op_timeouts`` maps op families (``"p2p"``, ``"allgather"``,
+    ``"bcast"``, ``"barrier"``) to per-op deadlines in ms (default:
+    ``timeout_ms``).  ``max_retries``/``backoff_base_s``/``backoff_max_s``
+    bound the transient-error retry loop.  ``clock``/``sleep`` are
+    injectable for deterministic tests (fake clock).
     """
 
     def __init__(self, namespace="cmn", client=None,
                  chunk_bytes=_DEFAULT_CHUNK,
-                 timeout_ms=_DEFAULT_TIMEOUT_MS):
-        import jax
+                 timeout_ms=_DEFAULT_TIMEOUT_MS,
+                 op_timeouts=None, max_retries=3,
+                 backoff_base_s=0.05, backoff_max_s=2.0,
+                 clock=time.monotonic, sleep=time.sleep,
+                 process_id=None, num_processes=None):
         self._client = client if client is not None else _kv_client()
         self._ns = namespace
         self._chunk = int(chunk_bytes)
         self._timeout_ms = int(timeout_ms)
+        self._op_timeouts = dict(op_timeouts or {})
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._clock = clock
+        self._sleep = sleep
         self._send_seq = {}
         self._recv_seq = {}
         self._epoch = 0
+        self._generation = 0
         self._lock = threading.Lock()
-        self.process_id = jax.process_index()
-        self.num_processes = jax.process_count()
+        self._fault_hook = None
+        self.monitor = None
+        self.stats = {"retries": 0, "timeouts": 0, "cleaned_keys": 0}
+        if process_id is not None and num_processes is not None:
+            self.process_id = int(process_id)
+            self.num_processes = int(num_processes)
+        else:
+            import jax
+            self.process_id = jax.process_index()
+            self.num_processes = jax.process_count()
 
     @property
     def available(self):
         return self._client is not None and self.num_processes > 1
 
+    # -- resilience plumbing -------------------------------------------------
+    def _prefix(self):
+        return f"{self._ns}/g{self._generation}"
+
+    @property
+    def generation(self):
+        return self._generation
+
+    def bump_generation(self):
+        """Rotate the key namespace after a failure: sequence and epoch
+        counters re-arm and stranded keys from the failed incarnation can
+        never match ops issued by the recovered one.  Lock-step: every
+        surviving process must bump together (the recovery supervisor
+        does this before its consensus resume)."""
+        with self._lock:
+            self._generation += 1
+            self._send_seq = {}
+            self._recv_seq = {}
+            self._epoch = 0
+        return self._generation
+
+    def set_fault_hook(self, hook):
+        """Install ``hook(event, ctx)`` called at transport hook points
+        (``hc.put``, ``hc.chunk``, ``hc.get``, ``hc.barrier``).  The hook
+        may raise (transient transport error — exercised against the
+        retry loop) or mutate the store through ``ctx['client']``
+        (lost-chunk / stale-key faults).  ``None`` uninstalls."""
+        self._fault_hook = hook
+
+    def _fault(self, event, **ctx):
+        if self._fault_hook is not None:
+            ctx.setdefault("client", self._client)
+            self._fault_hook(event, ctx)
+
+    def enable_heartbeat(self, interval_s=2.0, stall_s=None, wall=time.time,
+                         thread=True):
+        """Attach a :class:`HeartbeatMonitor`; blocked gets then audit
+        peers' liveness, raising :class:`PeerLostError` on a stalled peer
+        instead of hanging to the full deadline.  ``thread=True``
+        (default) starts the daemon beater so our own liveness survives
+        long compute/compile stretches; pass ``thread=False`` only in
+        deterministic fake-clock tests."""
+        if self.monitor is not None:  # re-arm: never leak the old beater
+            self.monitor.stop_thread()
+        self.monitor = HeartbeatMonitor(self, interval_s=interval_s,
+                                        stall_s=stall_s, wall=wall)
+        self.monitor.beat(force=True)
+        if thread:
+            self.monitor.start_thread()
+        return self.monitor
+
+    def _op_timeout_ms(self, op):
+        return int(self._op_timeouts.get(op, self._timeout_ms))
+
+    def _n_chunks(self, payload):
+        """Chunk count _put will write for this payload — cleanup paths
+        compute it from the bytes in hand (never probed from the meta
+        key, which a pre-publish failure never wrote)."""
+        return max(1, (len(payload) + self._chunk - 1) // self._chunk)
+
+    def _retrying(self, op, key, fn):
+        """Run one transport attempt under the op deadline, absorbing
+        transient errors with exponential backoff up to ``max_retries``.
+
+        Non-retriable: :class:`PeerLostError` (the peer is gone — more
+        attempts cannot help) and the posted-abort RuntimeError (fail-stop
+        must win).  Everything else is treated as transient until the
+        retry/deadline budget runs out, then surfaces as
+        :class:`ChannelTimeoutError` chained to the last failure.
+        """
+        timeout_ms = self._op_timeout_ms(op)
+        deadline = self._clock() + timeout_ms / 1000.0
+        attempts = 0
+        last_exc = None
+        while True:
+            remaining_ms = int((deadline - self._clock()) * 1000)
+            if remaining_ms <= 0 or attempts > self.max_retries:
+                self.stats["timeouts"] += 1
+                raise ChannelTimeoutError(op, key, timeout_ms,
+                                          attempts) from last_exc
+            attempts += 1
+            try:
+                return fn(remaining_ms)
+            except (PeerLostError, _AbortedError):
+                raise
+            except Exception as e:
+                last_exc = e
+                if attempts > self.max_retries \
+                        or self._clock() >= deadline:
+                    continue  # decided: raise above without a dead pause
+                self.stats["retries"] += 1
+                pause = min(self.backoff_base_s * (2 ** (attempts - 1)),
+                            self.backoff_max_s)
+                self._sleep(pause)
+
     # -- low-level chunked put/get ------------------------------------------
-    def _put(self, key, payload: bytes):
+    def _put(self, key, payload: bytes, published=None):
+        """Chunked write; ``published`` (a mutable list, optional) gains
+        an entry the moment the meta key — the publish point — lands, so
+        callers can tell a pre-publish failure (rollback safe) from a
+        post-publish one (message live; a consumer may already have it)
+        WITHOUT probing the store, where a fast reader's key deletion
+        would masquerade as never-published."""
         c = self._client
-        n_chunks = max(1, (len(payload) + self._chunk - 1) // self._chunk)
+        n_chunks = self._n_chunks(payload)
         for i in range(n_chunks):
+            self._fault("hc.chunk", key=key, chunk=i)
             c.key_value_set_bytes(
                 f"{key}/c{i}", payload[i * self._chunk:(i + 1) * self._chunk])
         # meta last: its presence means every chunk is readable
         c.key_value_set(f"{key}/meta", f"{n_chunks}:{len(payload)}")
+        if published is not None:
+            published.append(True)
+        self._fault("hc.put", key=key)
 
-    def _blocking_get_or_abort(self, key):
-        """Blocking get that polls the job-abort flag: when a peer's
-        except hook posts an abort (fail-stop, SURVEY §5), waiting ranks
-        raise instead of hanging until the full timeout — the KV analog
-        of MPI_Abort killing ranks blocked in a recv."""
-        import time
+    def _blocking_get_or_abort(self, key, timeout_ms):
+        """Blocking get that polls the job-abort flag and the heartbeat
+        monitor: when a peer's except hook posts an abort (fail-stop,
+        SURVEY §5) waiting ranks raise instead of hanging until the full
+        timeout — the KV analog of MPI_Abort killing ranks blocked in a
+        recv — and a peer whose heartbeat stalls raises
+        :class:`PeerLostError` with the suspected rank."""
         c = self._client
-        deadline = time.monotonic() + self._timeout_ms / 1000.0
+        deadline = self._clock() + timeout_ms / 1000.0
         while True:
             reason = None
             try:
@@ -95,32 +373,49 @@ class HostChannel:
             except Exception:
                 pass  # no abort posted
             if reason is not None:
-                raise RuntimeError(
+                raise _AbortedError(
                     f"distributed job aborted by a peer: {reason}")
-            slice_ms = int(min(2000, max(1, (deadline - time.monotonic())
+            if self.monitor is not None:
+                self.monitor.beat()
+                self.monitor.check()
+            slice_ms = int(min(2000, max(1, (deadline - self._clock())
                                          * 1000)))
             try:
                 return c.blocking_key_value_get(key, slice_ms)
             except Exception:
-                if time.monotonic() >= deadline:
+                if self._clock() >= deadline:
                     raise
 
     def post_abort(self, reason="unknown"):
         """Fail-stop broadcast: unblocks every peer waiting in a channel
-        get (they raise) — called by the global except hook."""
+        get (they raise) — called by the global except hook.  Posted at
+        the namespace root (generation-independent) so it reaches peers
+        regardless of which incarnation they are blocked in."""
         try:
             self._client.key_value_set(f"{self._ns}/abort", str(reason))
         except Exception:
             pass
 
-    def _get(self, key, delete=True):
+    def clear_abort(self):
+        """Recovery-side reset of a posted abort flag (lock-step with
+        ``bump_generation`` in the supervisor)."""
+        try:
+            self._client.key_value_delete(f"{self._ns}/abort")
+        except Exception:
+            pass
+
+    def _get_once(self, key, timeout_ms):
         c = self._client
-        meta = self._blocking_get_or_abort(f"{key}/meta")
+        self._fault("hc.get", key=key)
+        meta = self._blocking_get_or_abort(f"{key}/meta", timeout_ms)
         n_chunks, total = (int(v) for v in meta.split(":"))
-        parts = [c.blocking_key_value_get_bytes(f"{key}/c{i}",
-                                                self._timeout_ms)
+        parts = [c.blocking_key_value_get_bytes(f"{key}/c{i}", timeout_ms)
                  for i in range(n_chunks)]
-        payload = b"".join(parts)[:total]
+        return b"".join(parts)[:total], n_chunks
+
+    def _get(self, key, delete=True, op="p2p"):
+        payload, n_chunks = self._retrying(op, key, lambda rem:
+                                           self._get_once(key, rem))
         if delete:
             self.delete(key, n_chunks)
         return payload
@@ -134,6 +429,7 @@ class HostChannel:
             for i in range(n_chunks):
                 c.key_value_delete(f"{key}/c{i}")
             c.key_value_delete(f"{key}/meta")
+            self.stats["cleaned_keys"] += 1
         except Exception:
             pass  # best-effort GC; unread keys die with the coordinator
 
@@ -150,9 +446,28 @@ class HostChannel:
         with self._lock:
             seq = self._send_seq.get((dest_process, tag), 0)
             self._send_seq[(dest_process, tag)] = seq + 1
-        key = (f"{self._ns}/p2p/{self.process_id}-{dest_process}"
+        key = (f"{self._prefix()}/p2p/{self.process_id}-{dest_process}"
                f"/t{tag}/s{seq}")
-        self._put(key, pickle.dumps(obj))
+        payload = pickle.dumps(obj)
+        n_chunks = self._n_chunks(payload)
+        published = []
+        try:
+            self._put(key, payload, published=published)
+        except Exception:
+            # Rollback ONLY if the message never became visible.  A
+            # fault after publish (e.g. an injected hc.put raise) must
+            # leave the message alone — the receiver may already have
+            # consumed it (deleting the keys, so probing the store here
+            # would lie) and advanced its sequence; deleting and
+            # re-sequencing would desync the matched stream.
+            # Unpublished: scrub the chunks and roll the send sequence
+            # back so a retried send re-matches.
+            if not published:
+                self.delete(key, n_chunks)
+                with self._lock:
+                    if self._send_seq.get((dest_process, tag)) == seq + 1:
+                        self._send_seq[(dest_process, tag)] = seq
+            raise
 
     def recv_obj(self, source_process, tag=0):
         """Blocking matched receive (reference: ``recv_obj``): order per
@@ -166,9 +481,9 @@ class HostChannel:
                 f"p2p addresses controller processes")
         with self._lock:
             seq = self._recv_seq.get((source_process, tag), 0)
-        key = (f"{self._ns}/p2p/{source_process}-{self.process_id}"
+        key = (f"{self._prefix()}/p2p/{source_process}-{self.process_id}"
                f"/t{tag}/s{seq}")
-        obj = pickle.loads(self._get(key))
+        obj = pickle.loads(self._get(key, op="p2p"))
         with self._lock:
             self._recv_seq[(source_process, tag)] = seq + 1
         return obj
@@ -181,39 +496,90 @@ class HostChannel:
 
     def allgather(self, obj):
         """All processes contribute one object; everyone gets the list in
-        process order.  Must be entered by every process (lock-step)."""
+        process order.  Must be entered by every process (lock-step).
+
+        Cleanup contract: this process's contribution (and, best-effort,
+        the ``done`` barrier key) is deleted in a ``finally`` — on the
+        success path only after the all-read barrier, on the failure path
+        immediately, so an exception cannot strand keys that would poison
+        the next epoch (or the next generation after recovery)."""
         e = self._next_epoch()
-        c = self._client
         me = self.process_id
         n = self.num_processes
-        prefix = f"{self._ns}/ag/{e}"
-        self._put(f"{prefix}/{me}", pickle.dumps(obj))
-        out = [pickle.loads(self._get(f"{prefix}/{i}", delete=False))
-               for i in range(n)]
-        # all processes must finish reading before anyone deletes
-        c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
-        self.delete(f"{prefix}/{me}")
-        return out
+        prefix = f"{self._prefix()}/ag/{e}"
+        payload = pickle.dumps(obj)
+        # chunk count computed from the payload, NOT probed from the
+        # meta key: a pre-publish put failure never wrote meta, and the
+        # cleanup below must still reach the chunks already written
+        my_chunks = self._n_chunks(payload)
+        try:
+            self._put(f"{prefix}/{me}", payload)
+            out = [pickle.loads(self._get(f"{prefix}/{i}", delete=False,
+                                          op="allgather"))
+                   for i in range(n)]
+            # all processes must finish reading before anyone deletes
+            self._barrier_wait(f"{prefix}/done", op="allgather")
+            return out
+        finally:
+            self.delete(f"{prefix}/{me}", my_chunks)
+            self._delete_barrier_key(f"{prefix}/done")
 
     def bcast(self, obj, root=0):
-        """Root's object on every process (lock-step entry)."""
+        """Root's object on every process (lock-step entry).  Root-side
+        cleanup of the value key runs in a ``finally`` (see
+        :meth:`allgather` for the contract)."""
         e = self._next_epoch()
-        prefix = f"{self._ns}/bc/{e}"
-        c = self._client
+        prefix = f"{self._prefix()}/bc/{e}"
         if self.process_id == root:
-            self._put(f"{prefix}/v", pickle.dumps(obj))
-            out = obj
-            c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
-            self.delete(f"{prefix}/v")
-        else:
-            out = pickle.loads(self._get(f"{prefix}/v", delete=False))
-            c.wait_at_barrier(f"{prefix}/done", self._timeout_ms)
+            payload = pickle.dumps(obj)
+            my_chunks = self._n_chunks(payload)
+            try:
+                self._put(f"{prefix}/v", payload)
+                out = obj
+                self._barrier_wait(f"{prefix}/done", op="bcast")
+            finally:
+                # chunk count from the payload: cleanup must work even
+                # when the put failed before publishing meta
+                self.delete(f"{prefix}/v", my_chunks)
+                self._delete_barrier_key(f"{prefix}/done")
+            return out
+        out = pickle.loads(self._get(f"{prefix}/v", delete=False,
+                                     op="bcast"))
+        self._barrier_wait(f"{prefix}/done", op="bcast")
         return out
+
+    def _barrier_wait(self, barrier_id, op="barrier"):
+        self._fault("hc.barrier", key=barrier_id)
+        try:
+            self._client.wait_at_barrier(barrier_id,
+                                         self._op_timeout_ms(op))
+        except (_AbortedError, PeerLostError):
+            raise
+        except Exception as e:
+            self.stats["timeouts"] += 1
+            raise ChannelTimeoutError(op, barrier_id,
+                                      self._op_timeout_ms(op), 1) from e
+
+    def _delete_barrier_key(self, barrier_id):
+        # coordination-service barriers are opaque server state; some
+        # backends (and the test fake) expose them as plain keys — scrub
+        # best-effort so a failed epoch leaves nothing matchable behind
+        try:
+            self._client.key_value_delete(barrier_id)
+        except Exception:
+            pass
 
     def barrier(self, name=None):
         e = self._next_epoch()
-        self._client.wait_at_barrier(name or f"{self._ns}/bar/{e}",
-                                     self._timeout_ms)
+        barrier_id = name or f"{self._prefix()}/bar/{e}"
+        try:
+            self._barrier_wait(barrier_id, op="barrier")
+        finally:
+            self._delete_barrier_key(barrier_id)
+
+
+class _AbortedError(RuntimeError):
+    """A peer posted the fail-stop abort flag (not retriable)."""
 
 
 _channel = None
@@ -231,3 +597,13 @@ def get_host_channel():
                 return None
             _channel = ch
         return _channel
+
+
+def reset_host_channel():
+    """Drop the process-global channel (tests / full teardown), stopping
+    its heartbeat beater so the dead incarnation stops posting liveness."""
+    global _channel
+    with _channel_lock:
+        if _channel is not None and _channel.monitor is not None:
+            _channel.monitor.stop_thread()
+        _channel = None

@@ -532,7 +532,7 @@ class MeshCommunicator(CommunicatorBase):
         leading axis (one slice per rank); pass ``P()`` in ``in_specs``/
         ``out_specs`` for replicated values.
         """
-        from jax import shard_map
+        from chainermn_tpu.utils.compat import shard_map
         axis = self.axis_name
         if self._axis_in_scope():
             # already inside a shard_map binding this axis (e.g. the

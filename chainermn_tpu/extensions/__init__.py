@@ -1,6 +1,7 @@
 """Distributed trainer extensions (reference: ``chainermn.extensions``)."""
 
 from .checkpoint import create_multi_node_checkpointer, _MultiNodeCheckpointer
+from .failure_recovery import FailureRecovery, RecoveryGivingUp
 from .observation_aggregator import ObservationAggregator
 
 try:
@@ -9,4 +10,5 @@ except Exception:  # pragma: no cover - orbax optional
     OrbaxCheckpointer = None
 
 __all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer",
+           "FailureRecovery", "RecoveryGivingUp",
            "ObservationAggregator", "OrbaxCheckpointer"]

@@ -7,17 +7,32 @@ call stack §3.5): every rank snapshots its own trainer state
 garbage-collected, and ``maybe_load`` allgathers each rank's available
 snapshot iterations, picks the newest iteration present on *all* ranks,
 and resumes everyone consistently — the fail-stop recovery contract
-(crash → relaunch → converge on the newest common checkpoint).
+(crash → relaunch → converge on the newest common checkpoint).  The same
+consensus is the convergence step of the in-place recovery supervisor
+(``extensions.FailureRecovery`` + ``Trainer.run``; ``docs/resilience.md``
+documents the full inject → detect → recover → converge machinery).
 
 Single-controller translation: one snapshot per *host* (``comm.inter_rank``
 — this process drives all its devices' state); the consensus allgather
 runs over the object channel (DCN multi-host, loopback single-host).
 Device-sharded arrays are pulled to host by the npz serializer; for
 pod-scale sharded state see ``chainermn_tpu.extensions.orbax_checkpoint``.
+
+Integrity (see ``docs/resilience.md``): snapshots are written atomically
+(tmp + rename) and paired with a SHA-256 sidecar (``<file>.sum``) written
+*before* the data rename, so a snapshot torn by a crash or an injected
+fault either never becomes visible or fails verification — and
+``_scan``/``maybe_load`` only offer *verified* iterations to the
+consensus vote, so a corrupt snapshot can never win it.  The generation a
+consensus resume restored from is pinned against GC
+(``_protected_iteration``): a rank that runs ahead can never sweep the
+newest *common* generation while a peer may still be resuming from it.
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import os
 import re
 import tempfile
@@ -27,6 +42,17 @@ from ..serializers.npz import load_npz, save_npz
 from ..training.trainer import Extension
 
 __all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer"]
+
+
+def _sha256_file(path, bufsize=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(bufsize)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
 
 
 def create_multi_node_checkpointer(comm, name="", cp_interval=5,
@@ -50,8 +76,17 @@ class _MultiNodeCheckpointer(Extension):
         self.cp_interval = cp_interval
         self.gc_interval = gc_interval
         self.path = path
-        self.stats = {"snapshots": 0, "gc": 0, "save_time": 0.0}
+        self.stats = {"snapshots": 0, "gc": 0, "save_time": 0.0,
+                      "verify_failures": 0}
         self._files = []
+        # the iteration the last consensus resume loaded: pinned against
+        # GC so a rank running ahead cannot sweep the newest COMMON
+        # generation while a peer may still be resuming from it
+        self._protected_iteration = None
+        # test seam: called with (tmp_path, final_name) between the
+        # serialized write and the atomic publish — the chaos harness
+        # raises here to model a crash mid-checkpoint-write
+        self._write_fault_hook = None
 
     @property
     def rank(self):
@@ -74,19 +109,51 @@ class _MultiNodeCheckpointer(Extension):
         self.save(trainer, trainer.updater.iteration)
 
     def save(self, trainer, iteration):
+        """Atomic, checksummed snapshot write.
+
+        Order matters: serialize to a tmp file, write the SHA-256
+        sidecar (itself tmp + rename), then rename the data into place.
+        A crash or injected fault at ANY point leaves either no visible
+        snapshot (tmp files are scrubbed / never scanned — the ``\\.``
+        in the name pattern cannot match ``mkstemp`` suffixes) or a
+        visible snapshot whose sidecar was already durable — never a
+        torn file that could win the consensus vote (``_scan`` refuses
+        unverifiable files).
+        """
         start = time.time()
         out = self._dir(trainer)
         os.makedirs(out, exist_ok=True)
         fname = self._filename(iteration)
-        fd, tmp = tempfile.mkstemp(prefix=fname, dir=out)
+        fd, tmp = tempfile.mkstemp(prefix=fname + ".tmp", dir=out)
         os.close(fd)
+        sum_tmp = None
         try:
-            save_npz(tmp, trainer)
+            # serialize once to memory: the digest comes from the bytes
+            # in hand (no read-back of the file we just wrote — zipfile
+            # seeks during write, so hash-while-writing would be wrong)
+            buf = io.BytesIO()
+            save_npz(buf, trainer)
+            data = buf.getbuffer()  # zero-copy view: one snapshot in RAM
+            with open(tmp, "wb") as f:
+                f.write(data)
+            if self._write_fault_hook is not None:
+                self._write_fault_hook(tmp, fname)
+            digest = hashlib.sha256(data).hexdigest()
+            fd, sum_tmp = tempfile.mkstemp(prefix=fname + ".sum.tmp",
+                                           dir=out)
+            with os.fdopen(fd, "w") as f:
+                f.write(digest)
+            os.replace(sum_tmp, os.path.join(out, fname + ".sum"))
+            sum_tmp = None
+            os.replace(tmp, os.path.join(out, fname))
         except Exception:
-            os.remove(tmp)
+            for leftover in (tmp, sum_tmp):
+                if leftover is not None and os.path.exists(leftover):
+                    os.remove(leftover)
             raise
-        os.replace(tmp, os.path.join(out, fname))
-        self._files.append(fname)
+        if fname not in self._files:  # re-crossed after a rollback: one
+            self._files.append(fname)  # entry, or _gc's keep/stale split
+            # would count the generation twice and delete a kept file
         self.stats["snapshots"] += 1
         self.stats["save_time"] += time.time() - start
         if len(self._files) >= self.cp_interval + self.gc_interval:
@@ -96,13 +163,30 @@ class _MultiNodeCheckpointer(Extension):
         keep = sorted(self._files,
                       key=lambda f: int(self._pattern.match(f).group(1)))
         stale, keep = keep[: -self.cp_interval], keep[-self.cp_interval:]
+        protected = []
         for fname in stale:
+            # never sweep the generation the last consensus resumed
+            # from: a peer may still be loading it, and after a crash it
+            # is the newest iteration guaranteed present on ALL ranks
+            if self._protected_iteration is not None and \
+                    int(self._pattern.match(fname).group(1)) == \
+                    self._protected_iteration:
+                protected.append(fname)
+                continue
             try:
                 os.remove(os.path.join(out, fname))
                 self.stats["gc"] += 1
             except OSError:
+                # data survived: keep its sidecar (or the file would
+                # re-enter the vote unverifiable-but-admitted) and keep
+                # tracking it so the next gc retries the removal
+                protected.append(fname)
+                continue
+            try:
+                os.remove(os.path.join(out, fname + ".sum"))
+            except OSError:
                 pass
-        self._files = keep
+        self._files = protected + keep
 
     # -- consensus resume ---------------------------------------------------
     def maybe_load(self, trainer, optimizer=None, path=None):
@@ -111,6 +195,13 @@ class _MultiNodeCheckpointer(Extension):
         Reference semantics: local scan → allgather of iteration sets →
         max of the intersection → ``load_npz`` on each rank's own file.
         Returns the resumed iteration or None.
+
+        Only *verified* snapshots enter the vote: ``_scan`` drops files
+        whose SHA-256 sidecar mismatches, so a torn/corrupted snapshot on
+        any rank excludes that iteration from the consensus globally
+        (every rank intersects the same sets) and the vote falls back to
+        the newest intact common generation.  The resumed iteration is
+        then pinned against GC (see ``_gc``).
         """
         out = path or self._dir(trainer)
         local = self._scan(out)
@@ -124,17 +215,38 @@ class _MultiNodeCheckpointer(Extension):
         load_npz(os.path.join(out, self._filename(iteration)), trainer,
                  strict=False)
         self._files = [self._filename(i) for i in sorted(local)]
+        self._protected_iteration = iteration
         return iteration
 
     def _scan(self, out):
+        """Local snapshot census: iterations of this rank whose files
+        verify against their checksum sidecar.  Sidecar-less files are
+        admitted (snapshots written before the integrity pass); files
+        with a mismatching sidecar are excluded and counted in
+        ``stats['verify_failures']``."""
         iterations = set()
         if not os.path.isdir(out):
             return iterations
         for fname in os.listdir(out):
             m = self._pattern.match(fname)
-            if m and int(m.group(2)) == self.rank:
-                iterations.add(int(m.group(1)))
+            if not (m and int(m.group(2)) == self.rank):
+                continue
+            if not self._verify(os.path.join(out, fname)):
+                self.stats["verify_failures"] += 1
+                continue
+            iterations.add(int(m.group(1)))
         return iterations
+
+    def _verify(self, path):
+        sum_path = path + ".sum"
+        if not os.path.exists(sum_path):
+            return True  # pre-integrity-pass snapshot: no sidecar to check
+        try:
+            with open(sum_path) as f:
+                expect = f.read().strip()
+            return _sha256_file(path) == expect
+        except OSError:
+            return False
 
     def finalize(self):
         pass

@@ -29,7 +29,15 @@ _hook_installed = False
 
 
 def add_hook():
-    """Install the except hook (idempotent)."""
+    """Install the except hook (idempotent).
+
+    Chains: any previously-installed excepthook (a test harness's
+    capture hook, a logging framework's reporter) runs BEFORE the abort
+    machinery, and stderr is flushed before the hard exit — so an
+    injected-fault traceback can never be lost in buffered pipes
+    (pytest capture, subprocess PIPEs) when ``os._exit`` skips the
+    interpreter's normal flush-at-exit.
+    """
     global _hook_installed
     if _hook_installed:
         return
@@ -47,6 +55,19 @@ def add_hook():
             f"aborting the distributed job (fail-stop)\n")
         traceback.print_exception(exc_type, exc_value, exc_traceback)
         sys.stderr.flush()
+        # chain to whatever hook was installed before ours (never the
+        # abort path's job to silence other tooling; a failing chained
+        # hook must not stop the abort).  The interpreter default is
+        # skipped — we already printed the traceback above
+        if original is not None and original is not _hook \
+                and original is not sys.__excepthook__:
+            try:
+                original(exc_type, exc_value, exc_traceback)
+            except BaseException:
+                # BaseException: a chained hook ending in sys.exit()
+                # raises SystemExit, which must not skip the abort
+                # broadcast below and leave peers hanging
+                pass
         try:
             # unblock peers waiting in host-channel receives (fail-stop:
             # the KV analog of MPI_Abort) before tearing down our client
@@ -64,8 +85,12 @@ def add_hook():
         except Exception:
             pass
         if exc_type is KeyboardInterrupt:
-            original(exc_type, exc_value, exc_traceback)
-            return
+            return  # the chained hook already reported it; no abort exit
+        try:
+            sys.stderr.flush()
+            sys.stdout.flush()
+        except Exception:
+            pass
         os._exit(1)
 
     sys.excepthook = _hook

@@ -34,10 +34,26 @@ __all__ = ["attention", "flash_attention", "xla_attention"]
 # (batch*head, block) pair).  vmem_limit_bytes raises Mosaic's scoped-VMEM
 # cap from its 16 MB default: at long T, XLA can place whole kernel
 # outputs in VMEM (observed OOM on v5e at T=8192 with the default).
-_COMPILER_PARAMS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel"),
-    vmem_limit_bytes=100 * 1024 * 1024,
-)
+def _make_compiler_params():
+    # pallas renamed TPUCompilerParams -> CompilerParams across jax
+    # releases; accept either (and run parameter-less if the kwargs
+    # themselves ever change — the kernel is correct without them, the
+    # params only lift the scoped-VMEM cap / mark grid parallelism).
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:
+        return None
+    try:
+        return cls(dimension_semantics=("parallel", "parallel"),
+                   vmem_limit_bytes=100 * 1024 * 1024)
+    except TypeError:
+        try:
+            return cls()
+        except Exception:
+            return None
+
+
+_COMPILER_PARAMS = _make_compiler_params()
 
 
 def xla_attention(q, k, v, causal=False, scale=None):

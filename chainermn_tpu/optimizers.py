@@ -254,7 +254,7 @@ class _MultiNodeOptimizer:
         return zero_update
 
     def _make_zero_step(self, lossfun, ex_args, ex_kwargs):
-        from jax import shard_map
+        from chainermn_tpu.utils.compat import shard_map
         from .core.optimizer import make_loss_and_grad
         comm = self.communicator
         actual = self.actual_optimizer
@@ -321,7 +321,7 @@ class _MultiNodeOptimizer:
             f"as 0-d arrays)")
 
     def _make_step(self, lossfun, ex_args, ex_kwargs):
-        from jax import shard_map
+        from chainermn_tpu.utils.compat import shard_map
         from .core.optimizer import (apply_transform_update,
                                      make_loss_and_grad)
         comm = self.communicator
@@ -451,7 +451,7 @@ class _MultiNodeOptimizer:
         return losses
 
     def _make_scan_step(self, lossfun, ex_args, ex_kwargs, n_steps):
-        from jax import shard_map
+        from chainermn_tpu.utils.compat import shard_map
         from .core.optimizer import (apply_transform_update,
                                      make_loss_and_grad)
         comm = self.communicator
@@ -514,7 +514,7 @@ class _MultiNodeOptimizer:
         params (ONE buffer, exactly as per-step ZeRO keeps one gathered
         copy live) plus the sharded flat opt state; each scan iteration
         is the full reduce-scatter → chunk update → all-gather step."""
-        from jax import shard_map
+        from chainermn_tpu.utils.compat import shard_map
         from .core.optimizer import make_loss_and_grad
         comm = self.communicator
         actual = self.actual_optimizer

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import collections
 import os
+import sys
 import time
 import traceback
 import warnings
@@ -120,7 +121,39 @@ class Trainer:
     def get_extension(self, name):
         return self._extensions[name].extension
 
+    def _fire_on_error(self, extensions, exc, tb):
+        """Fire every extension's ``on_error`` (recovery prologue and
+        crash epilogue alike).  A faulty handler must not mask the
+        original failure or abort recovery, so handler exceptions are
+        reported and swallowed."""
+        for entry in extensions:
+            on_error = getattr(entry.extension, "on_error", None)
+            if on_error:
+                try:
+                    on_error(self, exc, tb)
+                except Exception as handler_exc:
+                    print(f"Exception in on_error of extension "
+                          f"{entry.name}: {handler_exc}", file=sys.stderr)
+
+    def _find_recovery(self, extensions):
+        for entry in extensions:
+            ext = entry.extension
+            if hasattr(ext, "can_recover") and hasattr(ext, "recover"):
+                return ext
+        return None
+
     def run(self, show_loop_exception_msg=True):
+        """Run the training loop until ``stop_trigger`` fires.
+
+        Supervisor semantics (see ``docs/resilience.md``): if a
+        :class:`~chainermn_tpu.extensions.FailureRecovery` extension is
+        registered and the escaping exception is one it can recover, the
+        trainer fires ``on_error`` on all extensions, hands the failure
+        to the recovery extension (consensus checkpoint resume +
+        transport quiesce + optional communicator rebuild), and re-enters
+        the loop.  Unrecoverable failures keep the reference fail-stop
+        path: ``on_error`` fan-out, then raise.
+        """
         if self._done:
             raise RuntimeError("cannot run training loop multiple times")
         os.makedirs(self.out, exist_ok=True)
@@ -135,23 +168,32 @@ class Trainer:
             if getattr(entry, "call_before_training", False):
                 entry.extension(self)
         update = self.updater.update
+        recovery = self._find_recovery(extensions)
         try:
-            while not self.stop_trigger(self):
-                self.observation = {}
-                with self.reporter.scope(self.observation):
-                    update()
-                    for entry in extensions:
-                        if entry.trigger is None or entry.trigger(self):
-                            entry.extension(self)
-        except Exception as e:
-            if show_loop_exception_msg:
-                print("Exception in main training loop:", e)
-                traceback.print_exc()
-            for entry in extensions:
-                on_error = getattr(entry.extension, "on_error", None)
-                if on_error:
-                    on_error(self, e, None)
-            raise
+            while True:
+                try:
+                    while not self.stop_trigger(self):
+                        self.observation = {}
+                        with self.reporter.scope(self.observation):
+                            update()
+                            for entry in extensions:
+                                if entry.trigger is None \
+                                        or entry.trigger(self):
+                                    entry.extension(self)
+                    break
+                except Exception as e:
+                    tb = e.__traceback__
+                    self._fire_on_error(extensions, e, tb)
+                    if recovery is not None and recovery.can_recover(e):
+                        if show_loop_exception_msg:
+                            print("Recoverable exception in main training "
+                                  "loop:", e, file=sys.stderr)
+                        recovery.recover(self, e)
+                        continue
+                    if show_loop_exception_msg:
+                        print("Exception in main training loop:", e)
+                        traceback.print_exc()
+                    raise
         finally:
             for entry in extensions:
                 finalize = getattr(entry.extension, "finalize", None)

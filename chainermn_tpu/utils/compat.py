@@ -1,0 +1,32 @@
+"""JAX version-compat shims.
+
+The framework targets current jax (``from jax import shard_map`` with a
+``check_vma`` kwarg); older releases ship the same callable at
+``jax.experimental.shard_map`` under the pre-rename ``check_rep`` kwarg.
+Code imports :func:`shard_map` from here so one site absorbs the API
+move — the same discipline as the pallas ``CompilerParams`` rename gate
+in ``ops/flash_attention.py``.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6-era export
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever name the installed jax uses (``check_vma`` ⇄ ``check_rep``)."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)

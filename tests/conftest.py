@@ -20,3 +20,10 @@ os.environ["XLA_FLAGS"] = (
     + os.environ.get("XLA_FLAGS", ""))
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Tests target current jax (`jax.shard_map`, check_vma=); older installs
+# ship it under jax.experimental with the pre-rename check_rep= kwarg.
+# Route through the same compat shim the framework uses.
+if not hasattr(jax, "shard_map"):
+    from chainermn_tpu.utils.compat import shard_map
+    jax.shard_map = shard_map

@@ -63,8 +63,13 @@ def test_checkpoint_gc_keeps_cp_interval(tmp_path):
     cp = ct.create_multi_node_checkpointer(comm, name="g", cp_interval=3)
     trainer.extend(cp, trigger=(1, "epoch"))
     trainer.run()
-    files = [f for f in os.listdir(out) if f.startswith("g.")]
+    files = [f for f in os.listdir(out)
+             if f.startswith("g.") and not f.endswith(".sum")]
     assert len(files) <= 3 + 1  # kept generations (+1 transient tolerance)
+    # every surviving snapshot keeps its checksum sidecar (and GC removed
+    # the stale generations' sidecars along with their data)
+    sums = [f for f in os.listdir(out) if f.endswith(".sum")]
+    assert {f + ".sum" for f in files} == set(sums)
     assert cp.stats["snapshots"] == 8
     assert cp.stats["gc"] >= 4
 

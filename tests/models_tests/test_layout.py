@@ -7,6 +7,7 @@ anchor before any MFU claim built on it counts).
 """
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -14,6 +15,10 @@ import chainermn_tpu as ct
 from chainermn_tpu import F
 from chainermn_tpu.core.optimizer import SGD
 from chainermn_tpu.models import Classifier, ResNet50
+
+# ResNet50 forward/backward compiles for minutes on the simulated CPU
+# mesh: slow-marked so tier-1 stays inside its wall-clock budget
+pytestmark = pytest.mark.slow
 
 
 def _nhwc(x):

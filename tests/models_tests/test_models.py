@@ -3,6 +3,10 @@
 import numpy as np
 import pytest
 
+# multi-minute compile-heavy suite (ResNets, model-parallel seq2seq):
+# slow-marked so tier-1 stays inside its wall-clock budget
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

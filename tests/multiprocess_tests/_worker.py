@@ -39,6 +39,8 @@ def main():
         run_split_groups(pid, nprocs)
     elif scenario == "crash":
         run_crash(pid, nprocs)
+    elif scenario == "chaos_recovery":
+        run_chaos_recovery(pid, nprocs, tmpdir)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 
@@ -471,6 +473,154 @@ def run_split_groups(pid, nprocs):
     assert mine[0] != other[0], \
         "groups share params: split leaked collectives across groups"
     _ok("split_groups_isolated")
+
+    print("ALL_OK", flush=True)
+
+
+def run_chaos_recovery(pid, nprocs, tmpdir):
+    """End-to-end chaos over REAL 2-process gloo transport: faults at a
+    collective (shared seeded schedule → both ranks raise at the same
+    call site), at a host-channel op (transient, absorbed by bounded
+    retry), and mid-checkpoint-write (both ranks) — each recovered via
+    the consensus resume, with the run converging to the fault-free
+    baseline's final iteration and loss.  A deliberately corrupted
+    snapshot is then proven excluded from a fresh consensus vote on BOTH
+    ranks."""
+    import os
+
+    import numpy as np
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import (FaultInjectionCommunicator,
+                                             FaultSchedule,
+                                             bind_host_channel)
+    from chainermn_tpu.communicators.fault_schedule import InjectedFault
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.dataset import SerialIterator, TupleDataset
+    from chainermn_tpu.extensions import FailureRecovery
+    from chainermn_tpu.models import MLP, Classifier
+    from chainermn_tpu.training import StandardUpdater, Trainer
+    from chainermn_tpu.training.trainer import Extension
+
+    # identical global batch stream on every process (multi-controller
+    # SPMD contract; see run_dp_step)
+    rng = np.random.RandomState(11)
+    x = rng.normal(0, 1, (64, 12)).astype(np.float32)
+    t = rng.randint(0, 3, 64).astype(np.int32)
+
+    class _Beacon(Extension):
+        """Per-iteration control-plane bcast over the REAL KV channel —
+        the injection site for the collective fault."""
+        trigger = (1, "iteration")
+        priority = 400
+
+        def __init__(self, comm):
+            self.comm = comm
+
+        def __call__(self, trainer):
+            out = self.comm.bcast_obj(
+                {"iteration": trainer.updater.iteration}, root=0)
+            assert out["iteration"] == trainer.updater.iteration
+
+    def run_training(out, schedule=None, hc_specs=None, write_fault=None):
+        comm = ct.create_communicator("jax_ici")
+        if hc_specs is not None:
+            bind_host_channel(comm._host_channel(),
+                              FaultSchedule(hc_specs, seed=1))
+        if schedule is not None:
+            comm = FaultInjectionCommunicator(comm, schedule)
+        model = Classifier(MLP(n_units=8, n_out=3, seed=0))
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.05, momentum=0.9), comm).setup(model)
+        it = SerialIterator(TupleDataset(x, t), 8, shuffle=False)
+        trainer = Trainer(StandardUpdater(it, opt), (10, "iteration"),
+                          out=out)
+        trainer.extend(_Beacon(comm))
+        cp = ct.create_multi_node_checkpointer(comm, name="cz", path=out)
+        trainer.extend(cp, trigger=(3, "iteration"))
+        recovery = FailureRecovery(checkpointer=cp, verbose=False)
+        trainer.extend(recovery)
+        if write_fault is not None:
+            cp._write_fault_hook = write_fault
+        trainer.run()
+        # bit-identical params ⇒ identical loss; the digest is the
+        # strictest form of the "same final loss" acceptance check
+        digest = [np.asarray(p.array).tobytes() for p in model.params()]
+        # uninstall: the channel outlives this run
+        comm._host_channel().set_fault_hook(None)
+        return trainer, cp, recovery, model, digest
+
+    # -- fault-free baseline ------------------------------------------------
+    base_out = os.path.join(tmpdir, "base")
+    b_trainer, b_cp, b_rec, b_model, b_digest = run_training(base_out)
+    assert b_trainer.updater.iteration == 10
+    assert b_rec.stats["recoveries"] == 0
+    _ok("chaos_baseline")
+
+    # -- faulted run --------------------------------------------------------
+    # shared seeded schedule: BOTH ranks raise at bcast_obj call #5
+    sched = FaultSchedule([dict(op="bcast_obj", nth=5)], seed=1234)
+    # transient host-channel fault on the non-root reader only: absorbed
+    # by the bounded retry, training never notices
+    hc_specs = [dict(op="hc.get", nth=3)] if pid == 1 else []
+    fired = []
+
+    def write_fault(tmp, fname):
+        # both ranks tear checkpoint generation 9 (same call site)
+        if ".9." in fname and not fired:
+            fired.append(fname)
+            raise InjectedFault("checkpoint.save", 1, "torn write")
+
+    chaos_out = os.path.join(tmpdir, "chaos")
+    trainer, cp, recovery, model, digest = run_training(
+        chaos_out, schedule=sched, hc_specs=hc_specs,
+        write_fault=write_fault)
+
+    assert recovery.stats["recoveries"] == 2, recovery.stats
+    assert recovery.stats["resumed_iterations"] == [3, 6], recovery.stats
+    assert fired, "checkpoint write fault never fired"
+    _ok("chaos_recovered_twice")
+
+    # NOTE: communicator construction is a collective (hostname
+    # allgather) — read the channel singleton directly so this check
+    # stays one-sided-safe
+    from chainermn_tpu.communicators._host_channel import get_host_channel
+    if pid == 1:
+        assert get_host_channel().stats["retries"] >= 1, \
+            get_host_channel().stats
+    _ok("chaos_transient_retry_absorbed")
+
+    # -- convergence: same final iteration count and state as baseline -----
+    assert trainer.updater.iteration == b_trainer.updater.iteration == 10
+    for a, b in zip(digest, b_digest):
+        assert a == b, "faulted run diverged from the fault-free baseline"
+    _ok("chaos_final_matches_baseline")
+
+    # -- corrupted snapshot provably excluded from the consensus vote -------
+    if pid == 0:  # tear rank 0's newest snapshot only
+        newest = os.path.join(chaos_out, "cz.9.0")
+        with open(newest, "r+b") as f:
+            f.seek(12)
+            f.write(b"\xde\xad\xbe\xef")
+    comm2 = ct.create_communicator("jax_ici")
+    comm2._host_channel().barrier()  # corruption durable before the vote
+    model2 = Classifier(MLP(n_units=8, n_out=3, seed=0))
+    opt2 = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.05, momentum=0.9), comm2).setup(model2)
+    it2 = SerialIterator(TupleDataset(x, t), 8, shuffle=False)
+    trainer2 = Trainer(StandardUpdater(it2, opt2), (10, "iteration"),
+                       out=os.path.join(tmpdir, f"resume{pid}"))
+    cp2 = ct.create_multi_node_checkpointer(comm2, name="cz",
+                                            path=chaos_out)
+    resumed = cp2.maybe_load(trainer2, path=chaos_out)
+    # rank 0's iteration 9 failed verification → excluded GLOBALLY: every
+    # rank falls back to the newest intact common generation
+    assert resumed == 6, (pid, resumed)
+    assert trainer2.updater.iteration == 6
+    if pid == 0:
+        assert cp2.stats["verify_failures"] == 1
+    _ok("chaos_corrupt_excluded")
 
     print("ALL_OK", flush=True)
 

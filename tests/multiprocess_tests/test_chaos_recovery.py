@@ -1,0 +1,28 @@
+"""End-to-end chaos: inject → detect → recover → converge over REAL
+2-process gloo transport (the acceptance test of the resilience
+subsystem — see docs/resilience.md).
+
+Faults exercised in one training run: an injected collective fault (both
+ranks, same seeded call site), a transient host-channel transport fault
+(absorbed by bounded retry), and a torn checkpoint write — recovered via
+the checkpointer's consensus resume to the exact fault-free trajectory.
+Finally, a deliberately corrupted snapshot is proven excluded from a
+fresh consensus vote on both ranks."""
+
+import pytest
+
+from .test_two_process import _launch
+
+pytestmark = pytest.mark.chaos
+
+
+def test_two_process_chaos_recovery(tmp_path):
+    outs = _launch("chaos_recovery", 2, tmp_path, timeout=300)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
+        assert "ALL_OK" in out, out[-4000:]
+    for name in ("chaos_baseline", "chaos_recovered_twice",
+                 "chaos_transient_retry_absorbed",
+                 "chaos_final_matches_baseline", "chaos_corrupt_excluded"):
+        for rc, out in outs:
+            assert f"PASS {name}" in out, (name, out[-4000:])

@@ -1,0 +1,156 @@
+"""Checkpoint integrity: atomic+checksummed writes, corrupt-snapshot
+exclusion from the consensus vote, and GC protection of the generation a
+consensus resume restored from."""
+
+import os
+
+import pytest
+
+import chainermn_tpu as ct
+from chainermn_tpu import F, L
+from chainermn_tpu.core.optimizer import SGD
+from chainermn_tpu.dataset import SerialIterator, get_mnist
+from chainermn_tpu.training import StandardUpdater, Trainer
+
+pytestmark = pytest.mark.chaos
+
+
+class _MLP(ct.Chain):
+    def __init__(self):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(784, 8, seed=3)
+            self.l2 = L.Linear(8, 10, seed=4)
+
+    def forward(self, x, t):
+        return F.softmax_cross_entropy(self.l2(F.relu(self.l1(x))), t)
+
+
+def _make_trainer(out, iters=12):
+    model = _MLP()
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+    train, _ = get_mnist(n_train=64, n_test=8)
+    it = SerialIterator(train, 8 * comm.size, shuffle=False)
+    return model, comm, Trainer(StandardUpdater(it, opt),
+                                (iters, "iteration"), out=out)
+
+
+def _run_with_checkpoints(out, iters=12, trigger=(3, "iteration"), **kw):
+    model, comm, trainer = _make_trainer(out, iters)
+    cp = ct.create_multi_node_checkpointer(comm, name="c", **kw)
+    trainer.extend(cp, trigger=trigger)
+    trainer.run()
+    return model, comm, cp
+
+
+def test_snapshots_carry_verifying_sidecars(tmp_path):
+    out = str(tmp_path / "run")
+    _, _, cp = _run_with_checkpoints(out)
+    files = [f for f in os.listdir(out) if f.startswith("c.")
+             and not f.endswith(".sum")]
+    assert files
+    for f in files:
+        assert os.path.exists(os.path.join(out, f + ".sum"))
+        assert cp._verify(os.path.join(out, f))
+    assert cp.stats["verify_failures"] == 0
+
+
+def test_corrupt_snapshot_excluded_from_consensus(tmp_path):
+    out = str(tmp_path / "run")
+    _, _, _ = _run_with_checkpoints(out)  # snapshots at 3/6/9/12
+    # corrupt the NEWEST snapshot (flip bytes, keep length and sidecar)
+    newest = os.path.join(out, "c.12.0")
+    with open(newest, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")
+    model2, comm2, trainer2 = _make_trainer(out)
+    cp2 = ct.create_multi_node_checkpointer(comm2, name="c")
+    resumed = cp2.maybe_load(trainer2)
+    # the torn snapshot lost the vote: consensus fell back to 9
+    assert resumed == 9
+    assert trainer2.updater.iteration == 9
+    assert cp2.stats["verify_failures"] == 1
+
+
+def test_all_generations_corrupt_returns_none(tmp_path):
+    out = str(tmp_path / "run")
+    _run_with_checkpoints(out)
+    for f in os.listdir(out):
+        if f.startswith("c.") and not f.endswith(".sum"):
+            with open(os.path.join(out, f), "r+b") as fh:
+                fh.seek(4)
+                fh.write(b"\x00\x00\x00\x00")
+    model2, comm2, trainer2 = _make_trainer(out)
+    cp2 = ct.create_multi_node_checkpointer(comm2, name="c")
+    assert cp2.maybe_load(trainer2) is None
+    assert trainer2.updater.iteration == 0
+
+
+def test_sidecarless_legacy_snapshot_still_admitted(tmp_path):
+    out = str(tmp_path / "run")
+    _run_with_checkpoints(out)
+    for f in os.listdir(out):
+        if f.endswith(".sum"):
+            os.remove(os.path.join(out, f))
+    model2, comm2, trainer2 = _make_trainer(out)
+    cp2 = ct.create_multi_node_checkpointer(comm2, name="c")
+    assert cp2.maybe_load(trainer2) == 12  # pre-integrity-pass files load
+
+
+def test_gc_protects_consensus_resumed_generation(tmp_path):
+    out = str(tmp_path / "run")
+    # small retention so GC is aggressive: keep 2, collect every 2
+    _run_with_checkpoints(out, iters=6, trigger=(3, "iteration"),
+                          cp_interval=2, gc_interval=2)
+    model2, comm2, trainer2 = _make_trainer(out, iters=18)
+    cp2 = ct.create_multi_node_checkpointer(comm2, name="c",
+                                            cp_interval=2, gc_interval=2)
+    resumed = cp2.maybe_load(trainer2)
+    assert resumed == 6
+    assert cp2._protected_iteration == 6
+    trainer2.extend(cp2, trigger=(3, "iteration"))
+    trainer2.run()  # saves 9/12/15/18 → GC pressure well past the budget
+    files = [f for f in os.listdir(out) if f.startswith("c.")
+             and not f.endswith(".sum")]
+    # newest cp_interval generations kept AND the consensus generation
+    # survived every sweep
+    assert "c.6.0" in files, \
+        "GC must never delete the generation consensus resumed from"
+    assert "c.18.0" in files and "c.15.0" in files
+    # everything else was collected
+    assert len(files) == 3
+
+
+def test_resave_after_rollback_keeps_one_entry_per_generation(tmp_path):
+    """Re-crossing a saved iteration after a consensus rollback must not
+    duplicate the retention entry (a duplicate would make _gc's
+    keep/stale split delete a file the keep list still holds)."""
+    out = str(tmp_path / "run")
+    model, comm, trainer = _make_trainer(out, iters=3)
+    cp = ct.create_multi_node_checkpointer(comm, name="c", cp_interval=2,
+                                           gc_interval=2)
+    cp.save(trainer, 3)
+    cp.save(trainer, 3)  # same generation re-saved (post-rollback path)
+    assert cp._files.count("c.3.0") == 1
+    cp.save(trainer, 6)
+    cp.save(trainer, 9)
+    cp.save(trainer, 12)  # triggers GC (4 entries ≥ cp+gc)
+    assert os.path.exists(os.path.join(out, "c.9.0"))
+    assert os.path.exists(os.path.join(out, "c.12.0"))
+
+
+def test_write_fault_leaves_no_visible_snapshot(tmp_path):
+    out = str(tmp_path / "run")
+    model, comm, trainer = _make_trainer(out, iters=3)
+    cp = ct.create_multi_node_checkpointer(comm, name="c")
+
+    def boom(tmp, fname):
+        raise OSError("disk gone mid-write")
+
+    cp._write_fault_hook = boom
+    with pytest.raises(OSError):
+        cp.save(trainer, 3)
+    leftovers = [f for f in os.listdir(out)] if os.path.isdir(out) else []
+    assert [f for f in leftovers if f.startswith("c.3")] == [], \
+        f"torn write left visible artifacts: {leftovers}"

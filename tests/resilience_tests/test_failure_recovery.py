@@ -1,0 +1,201 @@
+"""Inject → detect → recover → converge, single process, tier-1.
+
+A trainer with a per-iteration control-plane beacon (``bcast_obj`` — the
+same host-channel surface the multi-node iterator uses every batch) is
+driven into injected faults; :class:`FailureRecovery` must fire
+``on_error``, run the checkpointer's consensus ``maybe_load``, and resume
+to the same final state as the fault-free run."""
+
+import os
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as ct
+from chainermn_tpu import F, L
+from chainermn_tpu.communicators import (FaultInjectionCommunicator,
+                                         FaultSchedule, InjectedFault)
+from chainermn_tpu.core.optimizer import SGD
+from chainermn_tpu.dataset import SerialIterator, get_mnist
+from chainermn_tpu.extensions import FailureRecovery, RecoveryGivingUp
+from chainermn_tpu.training import StandardUpdater, Trainer
+from chainermn_tpu.training.trainer import Extension
+
+pytestmark = pytest.mark.chaos
+
+
+class _MLP(ct.Chain):
+    def __init__(self):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(784, 16, seed=7)
+            self.l2 = L.Linear(16, 10, seed=8)
+
+    def forward(self, x, t):
+        return F.softmax_cross_entropy(self.l2(F.relu(self.l1(x))), t)
+
+
+class _Beacon(Extension):
+    """Per-iteration host control-plane op (what the multi-node iterator
+    does for every batch): the fault-injection site for these tests."""
+
+    trigger = (1, "iteration")
+    priority = 400  # before everything, like batch broadcasting would be
+
+    def __init__(self, comm):
+        self.comm = comm
+        self.errors = []
+
+    def __call__(self, trainer):
+        self.comm.bcast_obj({"iteration": trainer.updater.iteration})
+
+    def on_error(self, trainer, exc, tb):
+        self.errors.append(type(exc).__name__)
+
+
+def _make_trainer(out, schedule=None, iters=12, cp_trigger=(3, "iteration"),
+                  max_recoveries=3):
+    model = _MLP()
+    comm = ct.create_communicator("jax_ici")
+    if schedule is not None:
+        comm = FaultInjectionCommunicator(comm, schedule)
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+    train, _ = get_mnist(n_train=64, n_test=8)
+    it = SerialIterator(train, 8 * comm.size, shuffle=False)
+    trainer = Trainer(StandardUpdater(it, opt), (iters, "iteration"),
+                      out=out)
+    beacon = _Beacon(comm)
+    trainer.extend(beacon)
+    cp = ct.create_multi_node_checkpointer(comm, name="rec")
+    trainer.extend(cp, trigger=cp_trigger)
+    recovery = FailureRecovery(checkpointer=cp, max_recoveries=max_recoveries,
+                               verbose=False)
+    trainer.extend(recovery)
+    return model, trainer, beacon, cp, recovery
+
+
+def _params(model):
+    return [np.asarray(p.array).copy() for p in model.params()]
+
+
+def test_recovers_from_injected_collective_fault(tmp_path):
+    # fault-free golden
+    gold_model, gold_trainer, _, _, _ = _make_trainer(
+        str(tmp_path / "gold"))
+    gold_trainer.run()
+    assert gold_trainer.updater.iteration == 12
+
+    # beacon's bcast_obj #8 raises on the faulted run
+    sched = FaultSchedule([dict(op="bcast_obj", nth=8)], seed=5)
+    model, trainer, beacon, cp, recovery = _make_trainer(
+        str(tmp_path / "run"), schedule=sched)
+    trainer.run()
+
+    assert recovery.stats["recoveries"] == 1
+    assert beacon.errors == ["InjectedFault"], \
+        "on_error must fire on extensions before recovery"
+    # consensus resume rolled back to the newest snapshot: beacon call #8
+    # faults right after update 8, when snapshots 3 and 6 exist
+    assert recovery.stats["resumed_iterations"] == [6]
+    assert trainer.updater.iteration == 12
+
+    # converged to the fault-free trajectory (deterministic data order +
+    # snapshot-exact resume ⇒ identical final params)
+    for a, b in zip(_params(gold_model), _params(model)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_recovers_from_fault_during_checkpoint_write(tmp_path):
+    """A fault mid-checkpoint-write: the torn snapshot never becomes
+    visible (atomic tmp+rename), recovery resumes from the previous
+    generation, and training still completes."""
+    model, trainer, beacon, cp, recovery = _make_trainer(
+        str(tmp_path / "run"))
+    fired = []
+
+    def write_fault(tmp, fname):
+        if fname.startswith("rec.6.") and not fired:
+            fired.append(fname)
+            raise InjectedFault("checkpoint.save", 1, "torn write")
+
+    cp._write_fault_hook = write_fault
+    trainer.run()
+    assert fired, "the write fault must have fired"
+    assert recovery.stats["recoveries"] == 1
+    # resumed from generation 3 — generation 6's write was the fault
+    assert recovery.stats["resumed_iterations"] == [3]
+    assert trainer.updater.iteration == 12
+    out = str(tmp_path / "run")
+    # no torn iteration-6 file from the faulted attempt is visible...
+    # (the retried save after recovery writes a fresh, verified one)
+    files = [f for f in os.listdir(out) if f.startswith("rec.")]
+    assert "rec.6.0" in files  # re-written post-recovery
+    assert cp._verify(os.path.join(out, "rec.6.0"))
+
+
+def test_unrecoverable_exception_still_fail_stops(tmp_path):
+    sched = FaultSchedule([dict(op="bcast_obj", nth=4, exc=ValueError)],
+                          seed=0)
+    model, trainer, beacon, cp, recovery = _make_trainer(
+        str(tmp_path / "run"), schedule=sched)
+    with pytest.raises(ValueError):
+        trainer.run(show_loop_exception_msg=False)
+    assert recovery.stats["recoveries"] == 0
+    assert beacon.errors == ["ValueError"]  # on_error fired on both paths
+
+
+def test_recovery_budget_exhaustion(tmp_path):
+    # beacon calls #5/#6/#7 all fault: budget of 2 recoveries burns out
+    # and the third fault fail-stops through RecoveryGivingUp, chaining
+    # the original fault (so 'gave up after N' is distinguishable from
+    # 'never recoverable' in the crash output)
+    sched = FaultSchedule([dict(op="bcast_obj", nth=5),
+                           dict(op="bcast_obj", nth=6),
+                           dict(op="bcast_obj", nth=7)], seed=0)
+    model, trainer, beacon, cp, recovery = _make_trainer(
+        str(tmp_path / "run"), schedule=sched, max_recoveries=2)
+    with pytest.raises(RecoveryGivingUp) as ei:
+        trainer.run(show_loop_exception_msg=False)
+    assert isinstance(ei.value.__cause__, InjectedFault)
+    assert recovery.stats["recoveries"] == 2
+
+
+def test_peer_lost_fail_stops_by_default(tmp_path):
+    """A dead peer can never answer the consensus allgather: in-place
+    recovery must NOT be attempted for PeerLostError unless the
+    deployment opts in (unrecoverable=())."""
+    from chainermn_tpu.communicators import PeerLostError
+    model, trainer, beacon, cp, recovery = _make_trainer(
+        str(tmp_path / "run"))
+    assert not recovery.can_recover(PeerLostError(1, 12.0))
+    assert recovery.can_recover(InjectedFault("bcast_obj", 1))
+    opt_in = FailureRecovery(checkpointer=cp, unrecoverable=())
+    assert opt_in.can_recover(PeerLostError(1, 12.0))
+
+
+def test_fault_schedule_rejected_for_non_fault_communicator():
+    for name in ("jax_ici", "dummy"):  # incl. the early-return branch
+        with pytest.raises(ValueError, match="only honored by the 'fault'"):
+            ct.create_communicator(
+                name, fault_schedule=FaultSchedule([], seed=0))
+
+
+def test_recovery_without_checkpointer_restarts_live(tmp_path):
+    """No checkpointer: recovery resumes from live in-memory state (no
+    rollback), still reaching the stop trigger."""
+    sched = FaultSchedule([dict(op="bcast_obj", nth=5)], seed=1)
+    model = _MLP()
+    comm = FaultInjectionCommunicator(ct.create_communicator("jax_ici"),
+                                      sched)
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+    train, _ = get_mnist(n_train=64, n_test=8)
+    it = SerialIterator(train, 8 * comm.size, shuffle=False)
+    trainer = Trainer(StandardUpdater(it, opt), (8, "iteration"),
+                      out=str(tmp_path / "run"))
+    trainer.extend(_Beacon(comm))
+    recovery = FailureRecovery(comm=comm, verbose=False)
+    trainer.extend(recovery)
+    trainer.run()
+    assert recovery.stats["recoveries"] == 1
+    assert recovery.stats["resumed_iterations"] == [None]
+    assert trainer.updater.iteration == 8

@@ -1,0 +1,166 @@
+"""FaultInjectionCommunicator: schedule-driven drop/delay/raise at the
+CommunicatorBase surface, transparent delegation otherwise."""
+
+import os
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as ct
+from chainermn_tpu.communicators import (FaultInjectionCommunicator,
+                                         FaultSchedule, InjectedFault)
+
+pytestmark = pytest.mark.chaos
+
+# `make chaos` rotates this (echoed in its output); tier-1 uses the fixed
+# default — the assertions below hold for ANY seed
+CHAOS_SEED = int(os.environ.get("CHAINERMN_TPU_CHAOS_SEED", "1234"))
+
+
+def _wrap(specs, seed=0, base=None, sleep=None):
+    sched = FaultSchedule(specs, seed=seed)
+    kwargs = {} if sleep is None else {"sleep": sleep}
+    return FaultInjectionCommunicator(base or ct.DummyCommunicator(),
+                                      sched, **kwargs), sched
+
+
+def test_raise_on_nth_collective():
+    comm, sched = _wrap([dict(op="allreduce", nth=2)])
+    np.testing.assert_array_equal(np.asarray(comm.allreduce(np.ones(3))),
+                                  np.ones(3))
+    with pytest.raises(InjectedFault):
+        comm.allreduce(np.ones(3))
+    # one-shot: the third call goes through
+    np.testing.assert_array_equal(np.asarray(comm.allreduce(np.ones(3))),
+                                  np.ones(3))
+    assert comm.injected == 1
+    assert sched.fired == [("allreduce", 2, "raise")]
+
+
+def test_drop_on_send_obj_loses_message():
+    comm, _ = _wrap([dict(op="send_obj", nth=1, action="drop")])
+    comm.send_obj({"lost": True}, dest=0)
+    comm.send_obj({"kept": True}, dest=0)
+    # only the second send ever reached the base communicator's mailbox
+    assert comm.recv_obj(source=0) == {"kept": True}
+
+
+def test_drop_on_collective_returns_input_unchanged():
+    comm, _ = _wrap([dict(op="allreduce", nth=1, action="drop")])
+    x = np.arange(4.0)
+    out = comm.allreduce(x)
+    assert out is x  # silently-no-op collective
+
+
+def test_drop_on_kwargs_invoked_collective_returns_input():
+    comm, _ = _wrap([dict(op="bcast_obj", nth=1, action="drop")])
+    payload = {"iteration": 7}
+    assert comm.bcast_obj(obj=payload) is payload  # keyword call
+
+
+def test_drop_without_silent_result_degrades_to_raise():
+    comm, _ = _wrap([dict(op="allgather_obj", nth=1, action="drop"),
+                     dict(op="scatter", nth=1, action="drop")])
+    with pytest.raises(InjectedFault):
+        comm.allgather_obj("x")
+    with pytest.raises(InjectedFault):
+        comm.scatter([1, 2, 3])
+
+
+def test_delay_uses_injected_sleep_then_executes():
+    slept = []
+    comm, _ = _wrap([dict(op="bcast_obj", nth=2, action="delay",
+                          delay_s=7.5)], sleep=slept.append)
+    assert comm.bcast_obj("a") == "a"
+    assert comm.bcast_obj("b") == "b"  # delayed but not dropped
+    assert slept == [7.5]
+
+
+def test_topology_and_delegation_transparent():
+    base = ct.DummyCommunicator()
+    comm, _ = _wrap([], base=base)
+    assert (comm.rank, comm.size) == (base.rank, base.size)
+    assert (comm.intra_rank, comm.intra_size) == (0, 1)
+    assert (comm.inter_rank, comm.inter_size) == (0, 1)
+    assert comm.split(0, 0) is base  # Dummy.split returns self
+    # non-intercepted attribute resolves through __getattr__
+    assert comm.name == "dummy"
+    assert comm.grad_transform()({"g": 1.0}) == {"g": 1.0}
+
+
+def test_shared_schedule_same_sites_across_ranks():
+    """The lock-step contract: two ranks driving identical op sequences
+    against schedules built from the same spec+seed inject at identical
+    call sites — the property that lets all ranks fail (and recover)
+    together."""
+    specs = [dict(op="allgather_obj", prob=0.25, count=None)]
+    ops = ["allgather_obj"] * 50 + ["bcast_obj"] * 10
+
+    def run(seed):
+        comm, sched = _wrap(specs, seed=seed)
+        for op in ops:
+            try:
+                getattr(comm, op)("payload")
+            except InjectedFault:
+                pass
+        return list(sched.fired)
+
+    assert run(CHAOS_SEED) == run(CHAOS_SEED)
+    assert run(CHAOS_SEED) != run(CHAOS_SEED + 1)
+
+
+def test_mesh_base_eager_collectives_still_work():
+    base = ct.create_communicator("jax_ici")
+    comm, _ = _wrap([dict(op="allreduce", nth=3)], base=base)
+    stacked = np.tile(np.arange(4.0), (base.size, 1))
+    out = np.asarray(comm.allreduce(stacked, op="mean"))
+    np.testing.assert_allclose(out, np.arange(4.0))
+    gathered = comm.allgather_obj("x")
+    assert gathered == ["x"] * base.size
+
+
+def test_finalize_unbinds_only_own_host_channel_hook():
+    from chainermn_tpu.communicators import bind_host_channel
+
+    class StubChannel:
+        _fault_hook = None
+
+        def set_fault_hook(self, hook):
+            self._fault_hook = hook
+
+    class StubBase(ct.DummyCommunicator):
+        def __init__(self, ch):
+            super().__init__()
+            self._ch = ch
+
+        def _host_channel(self):
+            return self._ch
+
+    ch = StubChannel()
+    sched = FaultSchedule([], seed=0)
+    bind_host_channel(ch, sched)
+    comm = FaultInjectionCommunicator(StubBase(ch), sched)
+    assert ch._fault_hook is not None
+    comm.finalize()
+    assert ch._fault_hook is None, \
+        "faults must not outlive the fault communicator"
+    # another owner's hook is left alone
+    def other_hook(event, ctx):
+        pass
+    ch.set_fault_hook(other_hook)
+    comm.finalize()
+    assert ch._fault_hook is other_hook
+
+
+def test_factory_fault_name(monkeypatch):
+    import json
+    monkeypatch.setenv(
+        "CHAINERMN_TPU_FAULT_SCHEDULE",
+        json.dumps({"seed": 3, "faults": [{"op": "allreduce", "nth": 1}]}))
+    comm = ct.create_communicator("fault")
+    assert isinstance(comm, FaultInjectionCommunicator)
+    with pytest.raises(InjectedFault):
+        comm.allreduce(np.ones((comm.size, 2)))
+    monkeypatch.delenv("CHAINERMN_TPU_FAULT_SCHEDULE")
+    with pytest.raises(ValueError):
+        ct.create_communicator("fault")

@@ -1,0 +1,138 @@
+"""Fault-schedule determinism: same specs + seed + call sequence →
+identical injected call sites (the replay property the whole chaos
+harness rests on).  Tier-1, no communicator required."""
+
+import os
+
+import pytest
+
+from chainermn_tpu.communicators.fault_schedule import (
+    FaultSchedule, FaultSpec, InjectedFault, schedule_from_env)
+
+# `make chaos` rotates this seed (echoed in its output for repro); the
+# deterministic tier-1 subset uses the fixed default
+CHAOS_SEED = int(os.environ.get("CHAINERMN_TPU_CHAOS_SEED", "1234"))
+
+
+def _drive(schedule, ops):
+    """Run an op-call sequence, recording what fired."""
+    for op in ops:
+        schedule.on_call(op)
+    return list(schedule.fired)
+
+
+pytestmark = pytest.mark.chaos
+
+
+def test_nth_spec_fires_on_exact_call():
+    s = FaultSchedule([dict(op="allreduce", nth=3)])
+    assert s.on_call("allreduce") is None
+    assert s.on_call("allreduce") is None
+    fault = s.on_call("allreduce")
+    assert fault is not None and fault.action == "raise"
+    with pytest.raises(InjectedFault) as ei:
+        raise fault.make_exception()
+    assert ei.value.op == "allreduce" and ei.value.call_index == 3
+    # count=1 default: armed once, never again
+    assert s.on_call("allreduce") is None
+
+
+def test_ops_counted_independently():
+    s = FaultSchedule([dict(op="bcast_obj", nth=2)])
+    assert s.on_call("allreduce") is None
+    assert s.on_call("bcast_obj") is None
+    assert s.on_call("allreduce") is None
+    assert s.on_call("bcast_obj").action == "raise"
+    assert s.calls("allreduce") == 2 and s.calls("bcast_obj") == 2
+
+
+def test_wildcard_and_count_budget():
+    s = FaultSchedule([dict(op="*", nth=None, prob=1.0, count=2)])
+    fired = _drive(s, ["a", "b", "c", "d"])
+    assert [(op, i) for op, i, _ in fired] == [("a", 1), ("b", 1)]
+
+
+def test_unbounded_count():
+    s = FaultSchedule([dict(op="x", prob=1.0, count=None)])
+    assert len(_drive(s, ["x"] * 5)) == 5
+
+
+def test_deterministic_replay_fixed_seed():
+    ops = (["allreduce", "bcast_obj", "barrier"] * 40)
+    specs = [dict(op="allreduce", prob=0.2, count=None),
+             dict(op="barrier", prob=0.1, count=None, action="delay",
+                  delay_s=0.5)]
+    a = _drive(FaultSchedule(specs, seed=CHAOS_SEED), ops)
+    b = _drive(FaultSchedule(specs, seed=CHAOS_SEED), ops)
+    assert a == b, "same schedule+seed+call sequence must replay exactly"
+    assert a, "prob=0.2 over 40 calls should fire at least once"
+
+
+def test_reset_rearms_exactly():
+    ops = ["op"] * 30
+    s = FaultSchedule([dict(op="op", prob=0.3, count=3)], seed=CHAOS_SEED)
+    first = _drive(s, ops)
+    s.reset()
+    assert _drive(s, ops) == first
+
+
+def test_different_seeds_diverge():
+    ops = ["op"] * 200
+    a = _drive(FaultSchedule([dict(op="op", prob=0.5, count=None)], seed=1),
+               ops)
+    b = _drive(FaultSchedule([dict(op="op", prob=0.5, count=None)], seed=2),
+               ops)
+    assert a != b
+
+
+def test_exhausted_prob_spec_still_consumes_draws():
+    """Spec exhaustion must not shift later specs' injection sites: a
+    schedule where spec A burns out early fires spec B at the same call
+    sites as a schedule that never had spec A's budget limit reached."""
+    ops = ["op"] * 100
+    both = FaultSchedule([dict(op="op", prob=0.99, count=2),
+                          dict(op="op", prob=0.05, count=None)],
+                         seed=CHAOS_SEED)
+    fired = _drive(both, ops)
+    # replay identically — the draw accounting is part of the replay law
+    again = FaultSchedule(both.to_dict()["faults"], seed=CHAOS_SEED)
+    assert _drive(again, ops) == fired
+
+
+def test_json_env_round_trip(monkeypatch, tmp_path):
+    s = FaultSchedule([FaultSpec(op="allreduce", nth=5, action="delay",
+                                 delay_s=1.5, count=2, note="straggler")],
+                      seed=77)
+    import json
+    text = json.dumps(s.to_dict())
+    monkeypatch.setenv("CHAINERMN_TPU_FAULT_SCHEDULE", text)
+    env_s = schedule_from_env()
+    assert env_s.seed == 77
+    assert env_s.specs[0].to_dict() == s.specs[0].to_dict()
+    # @file form
+    p = tmp_path / "sched.json"
+    p.write_text(text)
+    monkeypatch.setenv("CHAINERMN_TPU_FAULT_SCHEDULE", f"@{p}")
+    assert schedule_from_env().to_dict() == s.to_dict()
+    monkeypatch.delenv("CHAINERMN_TPU_FAULT_SCHEDULE")
+    assert schedule_from_env() is None
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", action="explode", nth=1)
+    with pytest.raises(ValueError):
+        FaultSpec(op="x")  # neither nth nor prob
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", nth=2, prob=0.5)  # both
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", nth=0)  # 1-based
+
+
+def test_custom_exception_type():
+    class MyFault(ConnectionError):
+        pass
+
+    s = FaultSchedule([dict(op="op", nth=1, exc=MyFault)])
+    fault = s.on_call("op")
+    assert isinstance(fault.make_exception(), MyFault)

@@ -1,0 +1,55 @@
+"""global_except_hook hardening: chaining to a previously-installed
+excepthook and flushing before the abort exit."""
+
+import sys
+
+import pytest
+
+from chainermn_tpu import global_except_hook
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def fresh_hook_state(monkeypatch):
+    """Run each test with the hook uninstalled and restore sys.excepthook."""
+    monkeypatch.setattr(global_except_hook, "_hook_installed", False)
+    original = sys.excepthook
+    yield
+    sys.excepthook = original
+
+
+def test_chains_previously_installed_hook(fresh_hook_state, monkeypatch):
+    seen = []
+    exits = []
+
+    def previous_hook(exc_type, exc_value, exc_tb):
+        seen.append((exc_type, str(exc_value)))
+
+    monkeypatch.setattr(sys, "excepthook", previous_hook)
+    import os
+    monkeypatch.setattr(os, "_exit", exits.append)
+    global_except_hook.add_hook()
+    assert sys.excepthook is not previous_hook
+    err = RuntimeError("boom")
+    sys.excepthook(RuntimeError, err, None)
+    assert seen == [(RuntimeError, "boom")], \
+        "previously-installed excepthook must still run"
+    assert exits == [1], "abort path must still hard-exit non-zero"
+
+
+def test_keyboard_interrupt_does_not_hard_exit(fresh_hook_state,
+                                              monkeypatch):
+    exits = []
+    import os
+    monkeypatch.setattr(os, "_exit", exits.append)
+    global_except_hook.add_hook()
+    sys.excepthook(KeyboardInterrupt, KeyboardInterrupt(), None)
+    assert exits == []
+
+
+def test_add_hook_idempotent(fresh_hook_state):
+    global_except_hook.add_hook()
+    installed = sys.excepthook
+    global_except_hook.add_hook()
+    assert sys.excepthook is installed
