@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos bench bench-smoke bench-prewarm bench-status scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
+.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -69,6 +69,9 @@ watch-status:     ## round-start checklist: watcher liveness + probe + queue sta
 
 probe-input:      ## host input-pipeline bandwidth at flagship scale (no chip)
 	PROBE=input_pipeline PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
+
+bench-input:      ## GIL-bound transform: MultiprocessIterator vs MultithreadIterator (no chip, no jax)
+	$(PY) tools/bench_input.py
 
 audit:            ## StableHLO dtype census, resnet + transformer (no chip)
 	PROBE=precision_audit $(PY) tools/probe_perf.py

@@ -77,6 +77,7 @@ a variant that is excluded from the last-good cache):
                 BENCH_TEST_WEDGE (fault injection for tests)
 """
 
+import fcntl
 import json
 import os
 import selectors
@@ -782,28 +783,38 @@ def _run_bench():
     n_steps = int(os.environ.get("BENCH_STEPS", str(DEFAULT_STEPS)))
     # BENCH_SCAN=K fuses K steps per dispatch via update_scan (one jit
     # containing a lax.scan) — isolates device throughput from host/relay
-    # dispatch latency; 0 = plain per-step update() dispatch
-    scan_k = int(os.environ.get("BENCH_SCAN", "0"))
+    # dispatch latency; 0 = plain per-step update() dispatch.  The
+    # input-pipeline mode defaults to K=4 (set BENCH_SCAN=0 to disable):
+    # overlapped host feed + multi-step fused dispatch is the composed
+    # configuration that mode exists to measure.
+    _scan_env = os.environ.get("BENCH_SCAN", "")
     # activation layout: NHWC is the TPU-native convolution layout
     layout = os.environ.get("BENCH_LAYOUT", "NHWC")
     # BENCH_INPUT_PIPELINE=1: feed each step from the REAL host pipeline
-    # (uint8 synthetic rows → NativeBatchIterator C++ gather →
-    # DevicePrefetchIterator async placement → in-graph input_norm cast)
-    # instead of one pre-staged device batch — measures on chip how much
-    # of the host feed the async dispatch actually hides (the
-    # delta vs the pre-staged flagship row is the exposed input cost)
+    # (uint8 synthetic rows → batch assembly in BENCH_ITERATOR workers →
+    # DevicePrefetchIterator overlapped placement → in-graph input_norm
+    # cast) instead of one pre-staged device batch — measures on chip how
+    # much of the host feed the overlapped dispatch actually hides (the
+    # delta vs the pre-staged flagship row is the exposed input cost,
+    # also reported directly as input_stall_ms).  Composes with
+    # BENCH_SCAN: K fed batches are stacked ON DEVICE per fused dispatch.
     input_pipeline = os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1"
-    if input_pipeline and scan_k:
-        raise ValueError("BENCH_INPUT_PIPELINE measures the per-step "
-                         "host feed; BENCH_SCAN pre-stacks batches — "
-                         "the two modes are mutually exclusive")
-    if input_pipeline:
+    scan_k = int(_scan_env) if _scan_env else (4 if input_pipeline else 0)
+    # BENCH_ITERATOR: which host iterator assembles batches —
+    # multiprocess (process pool + shared-memory slots, default),
+    # native (C++ gather engine), thread (GIL-bound prefetch thread)
+    iterator_kind = os.environ.get("BENCH_ITERATOR", "multiprocess")
+    if input_pipeline and iterator_kind not in ("multiprocess", "native",
+                                                "thread"):
+        raise ValueError(f"unknown BENCH_ITERATOR={iterator_kind!r} "
+                         "(multiprocess|native|thread)")
+    if input_pipeline and iterator_kind == "native":
         # fail fast: a missing native loader must not burn deadline
         # budget on the OOM-backoff loop's model rebuilds
         from chainermn_tpu.utils.native import load_library
         if load_library() is None:
             raise RuntimeError(
-                "BENCH_INPUT_PIPELINE=1 requires the native loader "
+                "BENCH_ITERATOR=native requires the native loader "
                 "(g++ toolchain) — unavailable on this host")
 
     devices = jax.devices()  # raises if the backend is unavailable
@@ -811,7 +822,7 @@ def _run_bench():
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
-    def mk_result(images_per_sec, compile_s, used_bs):
+    def mk_result(images_per_sec, compile_s, used_bs, feed_stats=None):
         per_chip = images_per_sec / n_devices
         result = {
             "metric": "resnet50_imagenet_train_throughput",
@@ -830,12 +841,46 @@ def _run_bench():
             "compile_s": round(compile_s, 1),
             "fused_steps_per_dispatch": scan_k or 1,
         }
+        if input_pipeline:
+            result["iterator_kind"] = iterator_kind
+            if feed_stats is not None:
+                # consumer time blocked on the host feed, normalized to
+                # one trial's worth of dispatches — 0 means the
+                # overlapped feed fully hid batch assembly + H2D behind
+                # device compute
+                result["input_stall_ms"] = round(feed_stats(), 1)
         peak = _peak_tflops(devices)
         if peak:
             flops = _resnet50_train_flops_per_image(image_size)
             result["mfu"] = round(per_chip * flops / (peak * 1e12), 4)
             result["peak_tflops_bf16"] = peak
         return result
+
+    def _make_input_feed(global_bs, shape, rng):
+        """The real host pipeline: uint8 rows → BENCH_ITERATOR batch
+        assembly → DevicePrefetchIterator overlapped H2D.  Returns the
+        device-feed iterator (finalize() it after timing)."""
+        from chainermn_tpu.dataset import (DevicePrefetchIterator,
+                                           MultiprocessIterator,
+                                           MultithreadIterator,
+                                           TupleDataset, concat_examples)
+        n_img = max(2 * global_bs * max(1, scan_k), 256)
+        xs = rng.randint(0, 256, (n_img,) + shape[1:], dtype=np.uint8)
+        ys = rng.randint(0, 1000, n_img).astype(np.int32)
+        converter = None
+        if iterator_kind == "native":
+            from chainermn_tpu.dataset import NativeBatchIterator
+            base = NativeBatchIterator((xs, ys), global_bs, seed=0)
+        elif iterator_kind == "thread":
+            base = MultithreadIterator(TupleDataset(xs, ys), global_bs,
+                                       seed=0)
+            converter = concat_examples
+        else:
+            base = MultiprocessIterator(
+                TupleDataset(xs, ys), global_bs, seed=0, as_arrays=True,
+                n_processes=_env_int("BENCH_LOADER_PROCS", 4),
+                n_prefetch=2)
+        return DevicePrefetchIterator(base, size=2, converter=converter)
 
     def run(per_chip_bs):
         global_bs = per_chip_bs * n_devices
@@ -854,16 +899,51 @@ def _run_bench():
         shape = ((global_bs, image_size, image_size, 3) if layout == "NHWC"
                  else (global_bs, 3, image_size, image_size))
 
+        it = None
+        feed_stats = None
         if input_pipeline:
-            from chainermn_tpu.dataset import (DevicePrefetchIterator,
-                                               NativeBatchIterator)
-            n_img = max(2 * global_bs, 256)
-            xs = rng.randint(0, 256, (n_img,) + shape[1:], dtype=np.uint8)
-            ys = rng.randint(0, 1000, n_img).astype(np.int32)
-            it = DevicePrefetchIterator(
-                NativeBatchIterator((xs, ys), global_bs, seed=0), size=2)
-            do_steps = lambda: opt.update(model, *it.next())
-            steps_per_call, calls = 1, n_steps
+            it = _make_input_feed(global_bs, shape, rng)
+            stall_base = [0.0]
+            dispatch_no = [0]
+            feed_calls = [1]  # timed dispatches per trial (set below)
+
+            def feed_stats():
+                # stall accumulates across ALL timed trials while the
+                # throughput is best-of-trials: normalize to one trial's
+                # worth of dispatches (timed dispatches = total - the 2
+                # compile/warmup calls) so BENCH_TRIALS>1 does not
+                # inflate the reported exposed input cost
+                timed = max(1, dispatch_no[0] - 2)
+                return (it.input_stall_ms - stall_base[0]) \
+                    * feed_calls[0] / timed
+
+            def _count_dispatch():
+                # rebase the stall baseline at the START of call 3 —
+                # after trace+compile (call 1) and warmup (call 2) have
+                # fully drained their cold-pipeline fill — so the
+                # emitted input_stall_ms covers only the timed trials'
+                # steady-state exposed input cost
+                dispatch_no[0] += 1
+                if dispatch_no[0] == 3:
+                    stall_base[0] = it.input_stall_ms
+            if scan_k:
+                # fused multi-step dispatch over the REAL feed: pull K
+                # batches (device-resident), stack on device, one
+                # update_scan dispatch — host feed and collective fusion
+                # compose instead of excluding each other
+                def do_steps():
+                    _count_dispatch()
+                    batches = [it.next() for _ in range(scan_k)]
+                    xs_ = jnp.stack([b[0] for b in batches])
+                    ts_ = jnp.stack([b[1] for b in batches])
+                    return opt.update_scan(model, xs_, ts_)[-1]
+                steps_per_call, calls = scan_k, max(1, n_steps // scan_k)
+            else:
+                def do_steps():
+                    _count_dispatch()
+                    return opt.update(model, *it.next())
+                steps_per_call, calls = 1, n_steps
+            feed_calls[0] = calls
         else:
             x = jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
             t = jnp.asarray(rng.randint(0, 1000, global_bs)
@@ -879,10 +959,20 @@ def _run_bench():
 
         def on_first(elapsed, compile_s):
             ips = calls * steps_per_call * global_bs / elapsed
-            _emit(mk_result(ips, compile_s, per_chip_bs))
+            _emit(mk_result(ips, compile_s, per_chip_bs, feed_stats))
 
-        best, compile_s = _timed_steps(do_steps, calls, on_first=on_first)
-        return calls * steps_per_call * global_bs / best, compile_s
+        try:
+            if feed_stats is not None:
+                # construction-time baseline; _count_dispatch refines it
+                # once compile+warmup have drained their cold fill
+                stall_base[0] = it.input_stall_ms
+            best, compile_s = _timed_steps(do_steps, calls,
+                                           on_first=on_first)
+            return (calls * steps_per_call * global_bs / best, compile_s,
+                    feed_stats)
+        finally:
+            if it is not None:
+                it.finalize()  # stop pool/threads before any OOM rebuild
 
     images_per_sec = None
     last_err = None
@@ -892,7 +982,7 @@ def _run_bench():
             break
         _check_compile_budget()
         try:
-            images_per_sec, compile_s = run(bs)
+            images_per_sec, compile_s, feed_stats = run(bs)
             used_bs = bs
             break
         except BenchDeadline:
@@ -901,7 +991,7 @@ def _run_bench():
             last_err = e
     if images_per_sec is None:
         raise last_err
-    return mk_result(images_per_sec, compile_s, used_bs)
+    return mk_result(images_per_sec, compile_s, used_bs, feed_stats)
 
 
 def _err_metric():
@@ -966,8 +1056,9 @@ def _child_main():
             time.sleep(3600)
 
     def on_term(signum, frame):
-        # only reachable via the supervisor's detach-cap fallback (or an
-        # external TERM): emit before dying if nothing was emitted yet
+        # only reachable via the supervisor's detach-cap fallback, the
+        # supervisor's TERM/INT forwarding, or an external TERM: emit
+        # before dying if nothing was emitted yet
         if _EMITTED[0] is None:
             _emit_stale_or_error("terminated by supervisor at deadline")
         os._exit(3)
@@ -976,6 +1067,14 @@ def _child_main():
         signal.signal(signal.SIGTERM, on_term)
     except Exception:
         pass  # non-main-thread / exotic platforms: supervisor still covers us
+
+    if os.environ.get("BENCH_TEST_WEDGE") == "sleep-obedient":
+        # fault injection: a child parked BEFORE any output but with the
+        # NORMAL TERM handler installed — exercises the supervisor's
+        # TERM/INT forwarding (the child must emit its terminated line
+        # and die when the supervisor receives a group-directed signal)
+        while True:
+            time.sleep(3600)
 
     transformer_mode = \
         os.environ.get("BENCH_MODEL", "resnet50") == "transformer"
@@ -1089,13 +1188,32 @@ def _read_detached_alive():
     return alive
 
 
+def _registry_locked():
+    """fcntl.flock guard for the registry's read-append-replace: two
+    concurrent supervisors must not each pass the cap check and then
+    have one os.replace drop the other's just-written entry (ADVICE r5).
+    Returns the open lock-file handle (unlocks on close), or None when
+    even the lock file can't be had — callers proceed unlocked rather
+    than fail (driver runs are mostly serialized anyway)."""
+    try:
+        f = open(_DETACH_REGISTRY + ".lock", "a+")
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        return f
+    except Exception:
+        return None
+
+
 def _register_detached(pid):
     """Record a child left running past its deadline (relay discipline:
     never kill a process that may hold an in-flight TPU RPC — every
     relay wedge in rounds 3-5 traced to an abandoned one).  Returns
     False when _DETACH_CAP still-alive lingering children already
     exist: at that point the relay is already in the restart-needed
-    state, and bounding host memory wins over the discipline."""
+    state, and bounding host memory wins over the discipline.  The
+    read-append-replace runs under an fcntl.flock; a failed write still
+    detaches (never force a kill) but says so on stderr — an unrecorded
+    child is invisible to the next run's contention wait."""
+    lock = _registry_locked()
     try:
         alive = _read_detached_alive()
         if len(alive) >= _DETACH_CAP:
@@ -1108,8 +1226,21 @@ def _register_detached(pid):
             f.write("".join(f"{p} {s}\n" for p, s in alive))
         os.replace(tmp, _DETACH_REGISTRY)
         return True
-    except Exception:
+    except Exception as e:
+        try:  # diagnostic, not silence: the child runs on unrecorded
+            print(f"bench: detached child pid={pid} could NOT be "
+                  f"recorded in {_DETACH_REGISTRY} "
+                  f"({type(e).__name__}: {e}); next run's contention "
+                  "wait will not see it", file=sys.stderr, flush=True)
+        except Exception:
+            pass
         return True  # registry trouble must not force a kill
+    finally:
+        if lock is not None:
+            try:
+                lock.close()
+            except Exception:
+                pass
 
 
 def _supervise():
@@ -1128,9 +1259,48 @@ def _supervise():
     child printed before wedging is still served as this run's
     authoritative result.  A cap on still-alive detached children
     (`_register_detached`) falls back to the old terminate→kill
-    escalation so repeated outage runs cannot exhaust host memory."""
+    escalation so repeated outage runs cannot exhaust host memory.
+
+    The child runs in its OWN session (start_new_session): a
+    group-directed signal — GNU ``timeout`` around the driver, Ctrl-C
+    on an interactive run, a CI group-kill — reaches only the
+    supervisor, so a detach stays a real detach (ADVICE r5).  To keep
+    interactive kill semantics, the supervisor forwards TERM/INT to the
+    still-supervised child as SIGTERM (whose handler emits before
+    dying); once detached, nothing is forwarded."""
     run_id = f"{os.getpid()}-{int(time.time())}"
     env = dict(os.environ, BENCH_SUPERVISED="1", BENCH_RUN_ID=run_id)
+    sig_state = {"proc": None, "detached": False}
+
+    def _forward_signal(signum, frame):
+        # non-timeout path only: after detach the child must survive
+        # exactly the signals this handler would forward
+        p = sig_state["proc"]
+        if p is not None and not sig_state["detached"] \
+                and p.poll() is None:
+            try:
+                os.kill(p.pid, signal.SIGTERM)
+            except Exception:
+                pass
+            # fall through: the read loop continues to EOF so the
+            # child's emit-before-death line is still served as the
+            # final result
+            return
+        # no supervised child to forward to (pre-spawn contention wait,
+        # or already detached): swallowing the signal would make the
+        # supervisor uninterruptible — restore the default disposition
+        # and re-deliver
+        try:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+        except Exception:
+            pass
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(_sig, _forward_signal)
+        except Exception:
+            pass
     # A detached child from an EARLIER run may still be draining on the
     # one chip: wait briefly for it to finish, and if it is still there,
     # mark this run contended — a time-shared measurement must not look
@@ -1155,7 +1325,9 @@ def _supervise():
     except Exception:
         pass
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            env=env, stdout=subprocess.PIPE)
+                            env=env, stdout=subprocess.PIPE,
+                            start_new_session=True)
+    sig_state["proc"] = proc
     sel = selectors.DefaultSelector()
     sel.register(proc.stdout, selectors.EVENT_READ)
     deadline = time.monotonic() + _DEADLINE_S
@@ -1173,6 +1345,7 @@ def _supervise():
             buf += chunk
     sel.close()
     if timed_out:
+        sig_state["detached"] = True  # TERM/INT no longer forwarded
         if not _register_detached(proc.pid):
             proc.terminate()  # cap reached; SIGTERM → handler emits
             try:
@@ -1205,7 +1378,22 @@ def _supervise():
         try:
             proc.wait(timeout=30)
         except subprocess.TimeoutExpired:
-            pass  # stdout closed but process lingering: leave it alone
+            # stdout closed but process lingering: leave it alone, but
+            # record it so the next run's contention wait can see it
+            # (ADVICE r5 low: the EOF-but-lingering child was the one
+            # detach path that stayed unregistered).  Cap-reached means
+            # it stays unrecorded — it closed stdout (exit imminent),
+            # so unlike the timeout path we don't escalate to kill,
+            # but the invisibility is at least said out loud.
+            sig_state["detached"] = True
+            if not _register_detached(proc.pid):
+                try:
+                    print(f"bench: EOF-lingering child pid={proc.pid} "
+                          "NOT recorded (detach cap reached); next "
+                          "run's contention wait cannot see it",
+                          file=sys.stderr, flush=True)
+                except Exception:
+                    pass
     out = buf.decode("utf-8", "replace")
     result = _parse_last_json_line(out)
     if result is None:

@@ -557,12 +557,15 @@ class MeshCommunicator(CommunicatorBase):
 
     def _axis_in_scope(self):
         """True when this communicator's mesh axis is bound by an
-        enclosing shard_map of the current trace."""
-        try:
-            lax.axis_index(self.axis_name)  # traced probe, discarded
-            return True
-        except Exception:
-            return False
+        enclosing shard_map of the current trace — an explicit
+        axis-environment query (``utils.compat.axis_env_contains``),
+        NOT a probe-``lax.axis_index``-and-catch: this check dispatches
+        between eager and traced collectives, and exception control
+        flow here would silently flip modes under a jax behavior change
+        (VERDICT open item 7; pinned by
+        ``tests/communicator_tests/test_axis_in_scope.py``)."""
+        from chainermn_tpu.utils.compat import axis_env_contains
+        return axis_env_contains(self.axis_name)
 
     # -- split ------------------------------------------------------------------------
     def split(self, color, key):

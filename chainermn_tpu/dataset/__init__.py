@@ -3,7 +3,9 @@ from .datasets import (DatasetMixin, TupleDataset, DictDataset, SubDataset,
                        split_dataset_random, get_mnist, get_cifar10,
                        get_synthetic_imagenet)
 from .iterators import (Iterator, SerialIterator, MultiprocessIterator,
-                        MultithreadIterator, DevicePrefetchIterator)
+                        MultithreadIterator, DevicePrefetchIterator,
+                        IteratorError, IteratorWorkerError,
+                        IteratorWorkerCrashed)
 from .convert import concat_examples, to_device, identity_converter
 from .image_dataset import ImageDataset, LabeledImageDataset
 

@@ -2,17 +2,27 @@
 
 Reference anchors: ``chainer/iterators/serial_iterator.py · SerialIterator``,
 ``multiprocess_iterator.py · MultiprocessIterator`` (SURVEY.md §2.8).
-``MultiprocessIterator`` is realized as a background-*thread* prefetcher:
-on TPU hosts the heavy lifting (decode/augment) releases the GIL inside
-numpy, and a thread avoids fork+pickle overhead while overlapping input
-prep with device compute; the C++ prefetch core (``chainermn_tpu.utils.
-native``) accelerates the copy path when built.
+Three prefetch tiers share one consumer contract:
+
+* ``MultithreadIterator`` — background-thread prefetch; right when the
+  per-example work releases the GIL (numpy decode/augment) and
+  fork+pickle overhead isn't worth paying;
+* ``MultiprocessIterator`` (``multiprocess_iterator.py``) — a real
+  process pool assembling batches into shared-memory ring slots; the
+  escape hatch for GIL-bound Python transforms;
+* ``NativeBatchIterator`` (``native_iterator.py``) — the C++ gather
+  engine for plain-array datasets.
+
+``DevicePrefetchIterator`` stacks over any of them and keeps batches
+already placed in device HBM, with the host-side convert + ``device_put``
+issued from a feeder thread so the H2D path overlaps device compute.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -164,6 +174,43 @@ class SerialIterator(Iterator):
             deserialize_rng(serializer, self._rng)
 
 
+def _make_shadow_pair(dataset, batch_size, repeat, shuffle, seed,
+                      from_state=None):
+    """(lead, shadow) `SerialIterator` pair shared by the prefetching
+    iterators: the lead runs ahead feeding the pipeline, the shadow
+    advances once per CONSUMED batch — the serializable consumer
+    position.  Both start from ``from_state`` when resuming."""
+    lead = SerialIterator(dataset, batch_size, repeat=repeat,
+                          shuffle=shuffle, seed=seed)
+    shadow = SerialIterator(dataset, batch_size, repeat=repeat,
+                            shuffle=shuffle, seed=seed)
+    if from_state is not None:
+        shadow._copy_state_from(from_state)
+        lead._copy_state_from(shadow)
+    else:
+        shadow._copy_state_from(lead)
+    return lead, shadow
+
+
+def _serialize_consumer_shadow(it, serializer):
+    """ONE copy of the consumer-shadow resume contract
+    (`MultithreadIterator` / `MultiprocessIterator` — their snapshots
+    stay interchangeable because this is the same code): the writer
+    snapshots the shadow; the reader restores it, then tears the
+    pipeline down and rebuilds from the restored position.  Snapshots
+    from before iterators serialized anything (KeyError) keep the
+    fresh stream."""
+    if serializer.is_writer:
+        it._state.serialize(serializer)
+        return
+    try:
+        it._state.serialize(serializer)
+    except KeyError:
+        return
+    it.finalize()
+    it._setup(from_state=it._state)
+
+
 class MultithreadIterator(Iterator):
     """Background-thread prefetching iterator.
 
@@ -183,20 +230,12 @@ class MultithreadIterator(Iterator):
         self._setup()
 
     def _setup(self, from_state=None):
-        self._base = SerialIterator(self.dataset, self.batch_size,
-                                    repeat=self._repeat, shuffle=self._shuffle,
-                                    seed=self._seed)
-        # consumer-side state shadow: tracks the position of batches the
-        # *consumer* has seen (the worker's `_base` runs ahead by up to
-        # n_prefetch batches), so `serialize` records a resumable position.
-        self._state = SerialIterator(self.dataset, self.batch_size,
-                                     repeat=self._repeat,
-                                     shuffle=self._shuffle, seed=self._seed)
-        if from_state is not None:
-            self._state._copy_state_from(from_state)
-            self._base._copy_state_from(self._state)
-        else:
-            self._state._copy_state_from(self._base)
+        # worker-side lead + consumer-side shadow (the worker's `_base`
+        # runs ahead by up to n_prefetch batches; `serialize` records
+        # the shadow's resumable position)
+        self._base, self._state = _make_shadow_pair(
+            self.dataset, self.batch_size, self._repeat, self._shuffle,
+            self._seed, from_state)
         self._queue: queue.Queue = queue.Queue(maxsize=self._n_prefetch)
         self._stop = threading.Event()
         # worker state is bound as arguments: a not-yet-stopped old worker
@@ -206,6 +245,8 @@ class MultithreadIterator(Iterator):
             target=self._worker, args=(self._base, self._queue, self._stop),
             daemon=True)
         self._started = False
+        self._exhausted = False
+        self._error = None
         self.epoch = self._state.epoch
         self.is_new_epoch = self._state.is_new_epoch
 
@@ -228,13 +269,23 @@ class MultithreadIterator(Iterator):
             q.put(e)
 
     def __next__(self):
+        if self._exhausted:
+            # sticky: the worker's one StopIteration sentinel is gone —
+            # blocking on the dead queue again would hang forever
+            raise StopIteration
+        if self._error is not None:
+            raise self._error
         if not self._started:
             self._thread.start()
             self._started = True
         item = self._queue.get()
         if item is StopIteration:
+            self._exhausted = True
             raise StopIteration
         if isinstance(item, Exception):
+            # sticky, like exhaustion: the worker died delivering this —
+            # a later next() would block forever on its dead queue
+            self._error = item
             raise item
         # advance the consumer shadow in lock-step (index bookkeeping only)
         self._state._next_indices()
@@ -257,17 +308,7 @@ class MultithreadIterator(Iterator):
         resume continues the stream where training saw it, regardless of
         prefetch depth).  On load, the prefetch pipeline is rebuilt from
         the restored position."""
-        if serializer.is_writer:
-            self._state.serialize(serializer)
-            return
-        try:
-            self._state.serialize(serializer)
-        except KeyError:
-            # snapshot from before this iterator serialized anything
-            # (the old inherited no-op): keep the fresh stream
-            return
-        self.finalize()
-        self._setup(from_state=self._state)
+        _serialize_consumer_shadow(self, serializer)
 
     def finalize(self):
         self._stop.set()
@@ -280,20 +321,33 @@ class MultithreadIterator(Iterator):
             self._thread.join(timeout=5.0)
 
 
-# On TPU hosts the thread-prefetch design serves both roles; keep the
-# reference name available.
-MultiprocessIterator = MultithreadIterator
+class _FeedDone:
+    """Sentinel: the feeder drained a non-repeating base iterator."""
+
+
+class _FeedError:
+    """Feeder-thread exception carrier (re-raised on the consumer)."""
+
+    def __init__(self, error):
+        self.error = error
 
 
 class DevicePrefetchIterator(Iterator):
     """Device-feed stage: keeps up to ``size`` batches already PLACED in
     device HBM (optionally under a ``jax.sharding.Sharding``) before the
-    consumer asks for them.  ``jax.device_put`` dispatches the transfer
-    asynchronously, so the next batch's host→device DMA overlaps the
-    current step's compute — the TPU analog of the CUDA-stream prefetch
+    consumer asks for them — the TPU analog of the CUDA-stream prefetch
     inside the reference's ``MultiprocessIterator`` (SURVEY §2.8
     iterators row), composed as a separate stage so it stacks over ANY
-    host iterator (Serial / Multithread / NativeBatch).
+    host iterator (Serial / Multithread / Multiprocess / NativeBatch).
+
+    With ``overlap=True`` (default) a feeder thread pulls from the base
+    iterator, runs ``converter``, and issues ``jax.device_put`` — i.e.
+    the whole host-side feed (batch assembly handoff, converter, H2D
+    dispatch) is double-buffered behind the current step's compute; the
+    consumer's ``next()`` only blocks when the feed can't keep up, and
+    that blocked time is accounted in :attr:`input_stall_ms`.
+    ``overlap=False`` keeps the synchronous fill (no extra thread; the
+    async ``device_put`` dispatch still overlaps the DMA itself).
 
     ``converter`` (e.g. ``dataset.concat_examples``) runs on host before
     placement; give the downstream updater ``identity_converter`` since
@@ -306,17 +360,42 @@ class DevicePrefetchIterator(Iterator):
     """
 
     def __init__(self, base_iterator, size=2, sharding=None,
-                 converter=None):
+                 converter=None, overlap=True):
         self.base = base_iterator
         self._size = max(1, size)
         self._sharding = sharding
         self._converter = converter
-        self._buf = []       # device batches in flight
-        self._meta = []      # (epoch, is_new_epoch, detail, prev_detail)
+        self._overlap = overlap
+        self._stall_s = 0.0  # cumulative consumer wait on the feed
+        self._setup_feed()
+
+    def _setup_feed(self):
+        self._buf = []       # sync mode: device batches in flight
+        self._meta = []      # sync mode: per-batch epoch bookkeeping
         self._states = []    # base snapshot BEFORE fetching each batch
         self._consumer_state = None  # base snapshot at consumer position
-        self.epoch = getattr(base_iterator, "epoch", 0)
-        self.is_new_epoch = getattr(base_iterator, "is_new_epoch", False)
+        self._detail = None
+        self._prev_detail = None
+        self.epoch = getattr(self.base, "epoch", 0)
+        self.is_new_epoch = getattr(self.base, "is_new_epoch", False)
+        if self._overlap:
+            self._q: queue.Queue = queue.Queue(maxsize=self._size)
+            self._stop = threading.Event()
+            self._base_lock = threading.Lock()
+            self._states_lock = threading.Lock()
+            # ALL feeder-touched state is bound as args (queue, stop,
+            # states list, both locks): an old feeder that outlived
+            # _teardown_feed's join timeout (base.next() blocked >5s)
+            # can only ever touch its OWN discarded objects — its stale
+            # state snapshot lands in the old list, never the rebuilt
+            # pipeline's resume bookkeeping
+            self._thread = threading.Thread(
+                target=self._feeder,
+                args=(self.base, self._q, self._stop, self._states,
+                      self._states_lock, self._base_lock), daemon=True)
+            self._started = False
+            self._drained = False
+            self._feed_error = None
 
     @staticmethod
     def _snap(base):
@@ -332,6 +411,68 @@ class DevicePrefetchIterator(Iterator):
         return jax.tree.map(
             lambda a: jax.device_put(a, self._sharding), batch)
 
+    # -- overlapped feed ----------------------------------------------------
+    def _feeder(self, base, q, stop, states, states_lock, base_lock):
+        try:
+            while not stop.is_set():
+                with base_lock:
+                    # snapshot + fetch + state-append are one atomic unit:
+                    # serialize's writer takes the same lock, so it can
+                    # never observe a fetched-but-unregistered batch (that
+                    # batch would be skipped on resume)
+                    state = self._snap(base)
+                    try:
+                        batch = base.next()
+                    except StopIteration:
+                        q.put(_FeedDone)
+                        return
+                    meta = (getattr(base, "epoch", 0),
+                            getattr(base, "is_new_epoch", False),
+                            getattr(base, "epoch_detail", None),
+                            getattr(base, "previous_epoch_detail", None))
+                    with states_lock:
+                        states.append(state)
+                placed = self._place(batch)  # H2D dispatched off-thread
+                q.put((placed, meta))
+        except Exception as e:  # surface feeder errors to the consumer
+            q.put(_FeedError(e))
+
+    def _teardown_feed(self):
+        """Stop the feeder thread (overlap mode) and drop buffered
+        batches; the base iterator is left untouched.  The feeder's
+        queue/stop/states are its own (bound as args), but ``base`` is
+        shared with whatever comes next — so wait for the feeder to
+        actually exit (draining the queue so a pending put can't wedge
+        it), bounded at ~30s; a feeder still inside a pathologically
+        blocked ``base.next()`` after that is reported, not silently
+        raced."""
+        if not self._overlap:
+            return
+        self._stop.set()
+        if self._started:
+            deadline = time.monotonic() + 30.0
+            while self._thread.is_alive() \
+                    and time.monotonic() < deadline:
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.5)
+            if self._thread.is_alive():
+                import sys
+                print("chainermn_tpu: DevicePrefetchIterator feeder "
+                      "still blocked in base.next() after 30s teardown "
+                      "wait; proceeding — the old feeder may consume "
+                      "one batch from the shared base iterator",
+                      file=sys.stderr)
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    # -- sync feed ----------------------------------------------------------
     def _fill(self):
         while len(self._buf) < self._size:
             state = self._snap(self.base)
@@ -348,52 +489,100 @@ class DevicePrefetchIterator(Iterator):
                 getattr(self.base, "previous_epoch_detail", None)))
 
     def __next__(self):
-        self._fill()
-        if not self._buf:
+        if not self._overlap:
+            t0 = time.perf_counter()
+            self._fill()
+            self._stall_s += time.perf_counter() - t0
+            if not self._buf:
+                raise StopIteration
+            batch = self._buf.pop(0)
+            self._consumer_state = self._states.pop(0)
+            (self.epoch, self.is_new_epoch, self._detail,
+             self._prev_detail) = self._meta.pop(0)
+            return batch
+        if self._drained:
             raise StopIteration
-        batch = self._buf.pop(0)
-        self._consumer_state = self._states.pop(0)
+        if self._feed_error is not None:
+            raise self._feed_error
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        t0 = time.perf_counter()
+        item = self._q.get()
+        self._stall_s += time.perf_counter() - t0
+        if item is _FeedDone:
+            self._drained = True
+            raise StopIteration
+        if isinstance(item, _FeedError):
+            # sticky: the feeder thread exited delivering this — a later
+            # next() would block forever on its dead queue
+            self._feed_error = item.error
+            raise item.error
+        placed, meta = item
+        with self._states_lock:
+            self._consumer_state = self._states.pop(0)
         (self.epoch, self.is_new_epoch, self._detail,
-         self._prev_detail) = self._meta.pop(0)
-        return batch
+         self._prev_detail) = meta
+        return placed
 
     next = __next__
 
     @property
     def epoch_detail(self):
-        return self._detail if self._meta or self._consumer_state \
+        return self._detail if self._detail is not None \
             else getattr(self.base, "epoch_detail", None)
 
     @property
     def previous_epoch_detail(self):
-        return self._prev_detail if self._meta or self._consumer_state \
+        return self._prev_detail if self._detail is not None \
             else getattr(self.base, "previous_epoch_detail", None)
 
+    @property
+    def input_stall_ms(self):
+        """Cumulative milliseconds ``next()`` spent blocked waiting for
+        the feed — the exposed (un-overlapped) input cost."""
+        return self._stall_s * 1e3
+
     def reset(self):
-        self._buf, self._meta, self._states = [], [], []
-        self._consumer_state = None
+        self._teardown_feed()
         if hasattr(self.base, "reset"):
             self.base.reset()
-        self.epoch = getattr(self.base, "epoch", 0)
-        self.is_new_epoch = getattr(self.base, "is_new_epoch", False)
+        self._setup_feed()
 
     def serialize(self, serializer):
         if serializer.is_writer:
             # consumer position: state before the oldest unconsumed
-            # batch; if nothing is buffered, the base's current state
-            state = (self._states[0] if self._states
-                     else self._snap(self.base))
+            # batch; if nothing is buffered, the base's current state.
+            # In overlap mode the base lock excludes a mid-fetch feeder
+            # (see _feeder) so the fallback snapshot is consistent.
+            if self._overlap and self._started:
+                with self._base_lock:
+                    with self._states_lock:
+                        state = dict(self._states[0]) if self._states \
+                            else None
+                    if state is None:
+                        state = self._snap(self.base)
+            else:
+                state = (self._states[0] if self._states
+                         else self._snap(self.base))
             for key, value in state.items():
                 serializer(key, value)
             return
         # read: the stored keys are exactly what base.serialize reads
+        self._teardown_feed()
         self.base.serialize(serializer)
-        self._buf, self._meta, self._states = [], [], []
-        self._consumer_state = None
-        self.epoch = getattr(self.base, "epoch", 0)
-        self.is_new_epoch = getattr(self.base, "is_new_epoch", False)
+        self._setup_feed()
 
     def finalize(self):
+        self._teardown_feed()
         self._buf, self._meta, self._states = [], [], []
         if hasattr(self.base, "finalize"):
             self.base.finalize()
+
+
+# The real process-pool implementation (shared-memory ring slots, typed
+# worker-error propagation) lives in multiprocess_iterator.py; re-export
+# under the reference import path (`dataset.iterators`).
+from .multiprocess_iterator import (  # noqa: E402  (after base classes)
+    IteratorError, IteratorWorkerCrashed, IteratorWorkerError,
+    MultiprocessIterator)

@@ -79,7 +79,7 @@ class StandardUpdater(Updater):
     def update_core(self):
         iterator = self._iterators["main"]
         optimizer = self._optimizers["main"]
-        batch = iterator.next()
+        batch = self._next_reporting_stall(iterator)
         in_arrays = self.converter(batch, self.device)
         loss_func = self.loss_func or optimizer.target
         if isinstance(in_arrays, tuple):
@@ -90,6 +90,25 @@ class StandardUpdater(Updater):
             optimizer.update(loss_func, in_arrays)
         if self.is_new_epoch:
             optimizer.new_epoch()
+
+    @staticmethod
+    def _report_stall_delta(iterator, stall_before):
+        """Report the feed-stall accrued since ``stall_before`` into the
+        current observation — LogReport can then surface how much of
+        the input pipeline the overlap fails to hide, per iteration."""
+        if stall_before is not None:
+            from ..core.reporter import report
+            report({"input_stall_ms":
+                    iterator.input_stall_ms - stall_before})
+
+    @classmethod
+    def _next_reporting_stall(cls, iterator):
+        """``iterator.next()`` with the stall delta reported, when the
+        iterator accounts it (``DevicePrefetchIterator.input_stall_ms``)."""
+        stall_before = getattr(iterator, "input_stall_ms", None)
+        batch = iterator.next()
+        cls._report_stall_delta(iterator, stall_before)
+        return batch
 
     def finalize(self):
         for iterator in self._iterators.values():
@@ -145,8 +164,12 @@ class FusedUpdater(StandardUpdater):
             raise TypeError("FusedUpdater requires a multi-node optimizer "
                             "(create_multi_node_optimizer)")
         epoch_before = iterator.epoch
+        # one stall observation across all K pulls (per-pull reports
+        # would overwrite each other inside a single observation)
+        stall_before = getattr(iterator, "input_stall_ms", None)
         batches = [self.converter(iterator.next(), self.device)
                    for _ in range(self.n_fused)]
+        self._report_stall_delta(iterator, stall_before)
         loss_func = self.loss_func or optimizer.target
         first = batches[0]
         if isinstance(first, tuple):

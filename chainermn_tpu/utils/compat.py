@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import inspect
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "axis_env_contains"]
 
 try:
     from jax import shard_map as _shard_map  # jax >= 0.6-era export
@@ -30,3 +30,38 @@ def shard_map(*args, **kwargs):
     elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
         kwargs["check_vma"] = kwargs.pop("check_rep")
     return _shard_map(*args, **kwargs)
+
+
+_axis_query = None
+
+
+def _resolve_axis_query():
+    """The installed jax's explicit axis-environment query.  Two known
+    homes; resolving fails LOUDLY (ImportError) rather than falling back
+    to exception-probe dispatch — a jax upgrade that moves the API again
+    must surface here, not silently flip eager/traced mode selection
+    (VERDICT open item 7)."""
+    try:  # jax >= 0.4.3x: the trace-global axis env object
+        from jax._src.core import get_axis_env
+        return lambda name: bool(get_axis_env().axis_exists(name))
+    except ImportError:
+        pass
+    from jax import core as _core  # public-ish accessor on the same env
+    unsafe_names = getattr(_core, "unsafe_get_axis_names_DO_NOT_USE", None)
+    if unsafe_names is not None:
+        return lambda name: name in unsafe_names()
+    raise ImportError(
+        "no axis-environment query found in this jax "
+        "(jax._src.core.get_axis_env / "
+        "jax.core.unsafe_get_axis_names_DO_NOT_USE); update "
+        "chainermn_tpu.utils.compat.axis_env_contains for this version")
+
+
+def axis_env_contains(name):
+    """True when ``name`` is bound as a mapped axis by an enclosing
+    ``shard_map``/``pmap`` of the current trace — the explicit check
+    behind ``Communicator._axis_in_scope`` (no traced-probe-and-catch)."""
+    global _axis_query
+    if _axis_query is None:
+        _axis_query = _resolve_axis_query()
+    return _axis_query(name)

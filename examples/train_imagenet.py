@@ -69,11 +69,25 @@ def main():
                              "casting caps at ~2.6k img/s on one core, "
                              "uint8 gather sustains ~9k (BENCH_NOTES r5)")
     parser.add_argument("--native-loader", action="store_true",
-                        help="assemble batches with the C++ gather "
-                             "engine (NativeBatchIterator); pair with "
-                             "--uint8-input for the full measured-fast "
-                             "host pipeline")
+                        help="deprecated alias for --loader native")
+    parser.add_argument("--loader", default=None,
+                        choices=["thread", "native", "multiprocess"],
+                        help="host batch assembly: thread "
+                             "(MultithreadIterator, GIL-releasing "
+                             "transforms), native (C++ gather engine "
+                             "over plain arrays), multiprocess "
+                             "(process pool + shared-memory slots — "
+                             "the escape hatch for GIL-bound Python "
+                             "transforms; docs/input_pipeline.md)")
+    parser.add_argument("--loader-workers", type=int, default=4,
+                        help="worker processes for --loader "
+                             "multiprocess")
     args = parser.parse_args()
+    if args.native_loader and args.loader not in (None, "native"):
+        parser.error("--native-loader conflicts with "
+                     f"--loader {args.loader}")
+    args.loader = args.loader or \
+        ("native" if args.native_loader else "thread")
 
     if args.simulate_devices:
         from chainermn_tpu.utils import simulate_devices
@@ -115,7 +129,7 @@ def main():
 
     from chainermn_tpu.dataset import concat_examples, identity_converter
     converter = concat_examples  # both updaters' default
-    if args.native_loader:
+    if args.loader == "native":
         # C++ gather engine over the materialized local shard: batches
         # arrive pre-stacked (x, t) tuples, so downstream converters are
         # identity.  With --uint8-input the rows stay uint8 end to end
@@ -127,18 +141,31 @@ def main():
                                          args.batchsize * comm.size,
                                          seed=0)
         converter = identity_converter
+    elif args.loader == "multiprocess":
+        # process pool + shared-memory slots: per-example work (the
+        # TransformDataset above included) runs in worker processes —
+        # the reference MultiprocessIterator path for GIL-bound
+        # transforms (docs/input_pipeline.md)
+        from chainermn_tpu.dataset import MultiprocessIterator
+        train_iter = MultiprocessIterator(train,
+                                          args.batchsize * comm.size,
+                                          n_processes=args.loader_workers,
+                                          as_arrays=True, seed=0)
+        converter = identity_converter
     else:
         train_iter = MultithreadIterator(train,
                                          args.batchsize * comm.size)
 
     if args.device_prefetch and not args.fused:
-        # device-feed stage: the next batch's host->device DMA overlaps
-        # this step's compute (FusedUpdater stacks K batches itself, so
-        # per-batch prefetch placement doesn't apply there)
+        # device-feed stage: a feeder thread converts and device_puts
+        # the next batch while this step computes (overlapped H2D;
+        # FusedUpdater stacks K batches itself, so per-batch prefetch
+        # placement doesn't apply there)
         from chainermn_tpu.dataset import DevicePrefetchIterator
         train_iter = DevicePrefetchIterator(
             train_iter, size=args.device_prefetch,
-            converter=None if args.native_loader else concat_examples)
+            converter=concat_examples if args.loader == "thread"
+            else None)
         converter = identity_converter
 
     if args.fused:

@@ -143,6 +143,114 @@ def test_native_base_stream_and_resume():
         np.testing.assert_array_equal(a, b)
 
 
+def test_multiprocess_base_stream_and_resume():
+    """DevicePrefetchIterator stacked over the process-pool iterator
+    (worker processes + shared-memory slots + overlapped device feed —
+    the full reference pipeline): stream matches serial order and
+    mid-stream resume stays exact."""
+    from chainermn_tpu.dataset import MultiprocessIterator
+    data = _dataset(24)
+
+    def build():
+        return DevicePrefetchIterator(
+            MultiprocessIterator(data, 4, shuffle=True, seed=3,
+                                 n_processes=2), size=2,
+            converter=concat_examples)
+
+    it = build()
+    ref = SerialIterator(data, 4, shuffle=True, seed=3)
+    for _ in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(it.next()[1]),
+            np.asarray(concat_examples(ref.next())[1]))
+    s = DictionarySerializer()
+    it.serialize(s)
+    cont = [np.asarray(it.next()[1]) for _ in range(4)]
+    it.finalize()
+
+    it2 = build()
+    it2.serialize(NpzDeserializer(s.target))
+    resumed = [np.asarray(it2.next()[1]) for _ in range(4)]
+    it2.finalize()
+    for a, b in zip(cont, resumed):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_overlap_off_matches_overlap_on():
+    """The synchronous fill (overlap=False) and the feeder thread
+    (overlap=True) are the same stream — only the scheduling differs."""
+    data = _dataset(20)
+    a = DevicePrefetchIterator(
+        SerialIterator(data, 4, shuffle=True, seed=11), size=3,
+        converter=concat_examples, overlap=False)
+    b = DevicePrefetchIterator(
+        SerialIterator(data, 4, shuffle=True, seed=11), size=3,
+        converter=concat_examples, overlap=True)
+    for _ in range(8):
+        ba, bb = a.next(), b.next()
+        np.testing.assert_array_equal(np.asarray(ba[1]),
+                                      np.asarray(bb[1]))
+        assert a.epoch == b.epoch
+        np.testing.assert_allclose(a.epoch_detail, b.epoch_detail)
+    a.finalize()
+    b.finalize()
+
+
+def test_input_stall_accounting():
+    """input_stall_ms counts only time next() blocked on the feed —
+    a slow consumer over a fast feed accumulates ~none."""
+    import time as _time
+    data = _dataset(16)
+    it = DevicePrefetchIterator(
+        SerialIterator(data, 4, shuffle=False), size=2,
+        converter=concat_examples)
+    it.next()
+    first_stall = it.input_stall_ms  # pipeline cold: some stall expected
+    for _ in range(4):
+        _time.sleep(0.02)  # feeder refills while the "step" runs
+        it.next()
+    assert it.input_stall_ms >= first_stall  # monotone counter
+    assert it.input_stall_ms - first_stall < 60.0  # feed kept up
+    it.finalize()
+
+
+def test_feeder_error_is_sticky_not_a_hang():
+    """A converter/base error crossing from the feeder thread must be
+    sticky: the feeder is dead, so a retrying caller's next next() has
+    to re-raise instead of blocking forever on the empty queue."""
+    import pytest
+    data = _dataset(16)
+    calls = [0]
+
+    def bad_converter(batch):
+        calls[0] += 1
+        if calls[0] == 2:
+            raise ValueError("converter blew up")
+        return concat_examples(batch)
+
+    it = DevicePrefetchIterator(
+        SerialIterator(data, 4, shuffle=False), size=2,
+        converter=bad_converter)
+    with pytest.raises(ValueError, match="converter blew up"):
+        for _ in range(4):
+            it.next()
+    with pytest.raises(ValueError, match="converter blew up"):
+        it.next()  # sticky — must not block on the dead feeder's queue
+    it.finalize()
+
+
+def test_finalize_is_idempotent_and_stops_feeder():
+    data = _dataset(16)
+    it = DevicePrefetchIterator(
+        SerialIterator(data, 4, shuffle=False), size=2,
+        converter=concat_examples)
+    it.next()
+    it.finalize()
+    it.finalize()
+    t = getattr(it, "_thread", None)
+    assert t is None or not t.is_alive()
+
+
 def test_non_repeating_drains():
     data = _dataset(8)
     pref = DevicePrefetchIterator(

@@ -1,0 +1,355 @@
+"""One contract, four iterator classes.
+
+Serial / Multithread / Multiprocess / NativeBatch all promise the same
+consumer-visible behavior (SURVEY §2.8 iterators row): identical batch
+stream for identical (shuffle, seed), `SerialIterator`-parity epoch
+bookkeeping, consumer-granularity ``serialize`` (mid-epoch resume
+replays exactly what the uninterrupted run would have delivered,
+regardless of prefetch depth), and idempotent ``finalize``.  The
+process iterator additionally promises typed worker-failure propagation
+and an unordered mode that still respects epoch boundaries.
+
+Everything here is fast and deterministic — tier-1, no ``slow`` marker.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.dataset import (MultiprocessIterator,
+                                   MultithreadIterator, SerialIterator,
+                                   TupleDataset)
+from chainermn_tpu.dataset.multiprocess_iterator import (
+    IteratorWorkerCrashed, IteratorWorkerError)
+from chainermn_tpu.serializers.npz import (DictionarySerializer,
+                                           NpzDeserializer)
+
+KINDS = ["serial", "thread", "process", "native"]
+
+N = 24
+BS = 4
+
+
+def _data(n=N):
+    rng = np.random.RandomState(0)
+    return [(rng.normal(0, 1, (4,)).astype(np.float32), np.int64(i))
+            for i in range(n)]
+
+
+def _make(kind, n=N, batch_size=BS, **kw):
+    data = _data(n)
+    if kind == "serial":
+        return SerialIterator(data, batch_size, **kw)
+    if kind == "thread":
+        return MultithreadIterator(data, batch_size, **kw)
+    if kind == "process":
+        return MultiprocessIterator(data, batch_size, n_processes=2,
+                                    **kw)
+    if kind == "native":
+        from chainermn_tpu.utils.native import load_library
+        if load_library() is None:
+            pytest.skip("native loader unavailable (no g++ toolchain)")
+        from chainermn_tpu.dataset.native_iterator import \
+            NativeBatchIterator
+        xs = np.stack([x for x, _ in data])
+        ys = np.asarray([int(y) for _, y in data], np.int64)
+        return NativeBatchIterator(TupleDataset(xs, ys), batch_size, **kw)
+    raise AssertionError(kind)
+
+
+def _labels(batch):
+    """Per-example integer labels, whatever the batch convention:
+    list-of-example-tuples (serial/thread/process) or pre-stacked
+    array tuple (native)."""
+    if isinstance(batch, tuple):
+        return [int(v) for v in batch[1]]
+    return [int(l) for _, l in batch]
+
+
+@pytest.fixture(params=KINDS)
+def kind(request):
+    return request.param
+
+
+def test_stream_and_epoch_parity_with_serial(kind):
+    """Same (shuffle, seed) ⇒ same batch stream as SerialIterator, and
+    epoch / is_new_epoch / epoch_detail / previous_epoch_detail move in
+    lock-step with the consumer."""
+    ref = SerialIterator(_data(), BS, shuffle=True, seed=5)
+    it = _make(kind, shuffle=True, seed=5)
+    try:
+        for _ in range(2 * (N // BS) + 3):  # crosses two epoch bounds
+            assert _labels(it.next()) == _labels(ref.next())
+            assert it.epoch == ref.epoch
+            assert it.is_new_epoch == ref.is_new_epoch
+            assert it.epoch_detail == pytest.approx(ref.epoch_detail)
+            assert it.previous_epoch_detail == pytest.approx(
+                ref.previous_epoch_detail)
+    finally:
+        it.finalize()
+
+
+def test_resume_mid_epoch(kind):
+    """Snapshot mid-epoch (prefetch pipelines running ahead), resume in
+    a fresh instance: the continuation replays exactly the batches the
+    uninterrupted run delivered."""
+    it = _make(kind, shuffle=True, seed=3)
+    for _ in range(3):  # mid-epoch: 3 of 6 batches consumed
+        it.next()
+    s = DictionarySerializer()
+    it.serialize(s)
+    cont = [_labels(it.next()) for _ in range(8)]  # crosses the bound
+    it.finalize()
+
+    it2 = _make(kind, shuffle=True, seed=3)
+    it2.serialize(NpzDeserializer(s.target))
+    resumed = [_labels(it2.next()) for _ in range(8)]
+    it2.finalize()
+    assert cont == resumed
+
+
+def test_snapshot_keys_interchangeable_with_serial(kind):
+    """All four classes serialize the consumer position under the same
+    keys, so a snapshot from any of them resumes a SerialIterator (and
+    vice versa) at the same stream position."""
+    it = _make(kind, shuffle=True, seed=9)
+    for _ in range(4):
+        it.next()
+    s = DictionarySerializer()
+    it.serialize(s)
+    cont = _labels(it.next())
+    it.finalize()
+
+    ref = SerialIterator(_data(), BS, shuffle=True, seed=9)
+    ref.serialize(NpzDeserializer(s.target))
+    assert _labels(ref.next()) == cont
+
+
+def test_non_repeat_drains_exactly(kind):
+    it = _make(kind, repeat=False, shuffle=False)
+    seen = []
+    try:
+        while True:
+            seen.extend(_labels(it.next()))
+    except StopIteration:
+        pass
+    try:
+        assert sorted(seen) == list(range(N))
+        with pytest.raises(StopIteration):
+            it.next()  # exhausted stays exhausted
+    finally:
+        it.finalize()
+
+
+def test_double_finalize_is_idempotent(kind):
+    it = _make(kind)
+    it.next()
+    it.finalize()
+    it.finalize()  # second teardown must be a no-op, not an error
+
+
+def test_finalize_without_consuming(kind):
+    """Teardown with the pipeline full (nothing consumed) must not hang
+    or leak: the prefetch depth of batches is simply dropped."""
+    it = _make(kind)
+    it.finalize()
+    it.finalize()
+
+
+# -- process-pool specifics -------------------------------------------------
+
+def test_process_ordered_matches_serial_unordered_keeps_epochs():
+    ref = SerialIterator(_data(), BS, shuffle=True, seed=1)
+    ordered = MultiprocessIterator(_data(), BS, shuffle=True, seed=1,
+                                   n_processes=2, ordered=True)
+    unordered = MultiprocessIterator(_data(), BS, shuffle=True, seed=1,
+                                     n_processes=2, ordered=False)
+    try:
+        per_epoch = N // BS
+        for _ in range(per_epoch):
+            assert _labels(ordered.next()) == _labels(ref.next())
+        for epoch in range(2):
+            got = sorted(l for _ in range(per_epoch)
+                         for l in _labels(unordered.next()))
+            # completion order may differ, but every epoch still
+            # delivers the full example multiset before the next starts
+            assert got == list(range(N)), epoch
+    finally:
+        ordered.finalize()
+        unordered.finalize()
+
+
+def test_process_transform_error_is_typed():
+    class Boom:
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            if i == 9:
+                raise ValueError("bad example 9")
+            return (np.zeros(3, np.float32), np.int64(i))
+
+    it = MultiprocessIterator(Boom(), 4, shuffle=False, n_processes=2)
+    try:
+        with pytest.raises(IteratorWorkerError) as ei:
+            for _ in range(3):
+                it.next()
+        assert "bad example 9" in str(ei.value)
+        assert "ValueError" in str(ei.value)  # worker traceback attached
+        with pytest.raises(IteratorWorkerError):
+            it.next()  # pipeline error is sticky, not silently resumed
+    finally:
+        it.finalize()
+
+
+def test_process_worker_crash_is_typed():
+    class Crash:
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            if i == 9:
+                os._exit(7)  # simulate segfault/OOM-kill: no traceback
+            return (np.zeros(3, np.float32), np.int64(i))
+
+    it = MultiprocessIterator(Crash(), 4, shuffle=False, n_processes=2)
+    try:
+        with pytest.raises(IteratorWorkerCrashed) as ei:
+            for _ in range(3):
+                it.next()
+        assert ei.value.exitcode == 7
+    finally:
+        it.finalize()
+
+
+def test_thread_transform_error_propagates_and_is_sticky():
+    class Boom:
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            if i == 9:
+                raise ValueError("bad example 9")
+            return (np.zeros(3, np.float32), np.int64(i))
+
+    it = MultithreadIterator(Boom(), 4, shuffle=False)
+    try:
+        with pytest.raises(ValueError, match="bad example 9"):
+            for _ in range(3):
+                it.next()
+        # sticky: the worker thread is dead — a retrying caller must get
+        # the error again, not block forever on the empty queue
+        with pytest.raises(ValueError, match="bad example 9"):
+            it.next()
+    finally:
+        it.finalize()
+
+
+def test_process_unordered_refuses_midstream_snapshot():
+    """ordered=False delivery diverges from the schedule-order shadow:
+    a mid-stream snapshot would resume with duplicated/dropped examples,
+    so the writer must refuse loudly instead of corrupting the epoch."""
+    it = MultiprocessIterator(_data(), BS, shuffle=True, seed=4,
+                              n_processes=2, ordered=False)
+    try:
+        s = DictionarySerializer()
+        it.serialize(s)  # nothing consumed yet: shadow == stream, fine
+        it.next()
+        with pytest.raises(RuntimeError, match="ordered=True"):
+            it.serialize(DictionarySerializer())
+    finally:
+        it.finalize()
+
+
+def test_process_slow_batch_tolerated_while_others_progress():
+    """The no-progress deadline resets on every completed batch: ONE
+    legitimately slow batch must not break a pipeline whose other
+    workers keep delivering (the timeout is for dead-but-alive pools,
+    not skewed transform cost)."""
+    import time as _time
+
+    class Skewed:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            if i == 0:
+                _time.sleep(4.0)  # far beyond worker_timeout
+            elif i % 2:
+                _time.sleep(0.6)  # steady sibling progress
+            return (np.zeros(2, np.float32), np.int64(i))
+
+    # n_prefetch keeps the sibling worker supplied with tasks for the
+    # whole duration of the slow batch, so results keep arriving
+    it = MultiprocessIterator(Skewed(), 2, shuffle=False, n_processes=2,
+                              n_prefetch=8, worker_timeout=2.0)
+    try:
+        labels = [l for _ in range(4) for _, l in it.next()]
+        assert labels == list(range(8))
+    finally:
+        it.finalize()
+
+
+def test_process_reset_restarts_stream():
+    it = MultiprocessIterator(_data(), BS, repeat=False, shuffle=True,
+                              seed=2, n_processes=2)
+    try:
+        first = [_labels(it.next()) for _ in range(3)]
+        it.reset()
+        again = [_labels(it.next()) for _ in range(3)]
+        assert first == again
+    finally:
+        it.finalize()
+
+
+def test_process_pickle_fallback_for_ragged_examples():
+    """Examples whose shapes disagree with the probe can't use the
+    shared-memory slots — the batch must still arrive (pickled),
+    correct and in order."""
+
+    class Ragged:
+        def __len__(self):
+            return 12
+
+        def __getitem__(self, i):
+            return (np.full(2 + (i % 3), i, np.float32), np.int64(i))
+
+    it = MultiprocessIterator(Ragged(), 4, shuffle=False, n_processes=2)
+    try:
+        batch = it.next()
+        assert [int(l) for _, l in batch] == [0, 1, 2, 3]
+        assert batch[2][0].shape == (4,)  # ragged payload intact
+    finally:
+        it.finalize()
+
+
+def test_process_scalar_and_multifield_layout():
+    """Slot layout handles >2 fields and scalar fields."""
+    class Three:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.full((2, 2), i, np.float32), np.int64(i),
+                    np.float32(i) / 2)
+
+    it = MultiprocessIterator(Three(), 4, shuffle=False, n_processes=2)
+    try:
+        b = it.next()
+        assert len(b) == 4 and len(b[1]) == 3
+        np.testing.assert_array_equal(b[3][0], np.full((2, 2), 3))
+        assert float(b[3][2]) == pytest.approx(1.5)
+    finally:
+        it.finalize()
+
+
+def test_process_as_arrays_matches_native_convention():
+    it = MultiprocessIterator(_data(), BS, shuffle=False, n_processes=2,
+                              as_arrays=True)
+    try:
+        x, y = it.next()
+        assert x.shape == (BS, 4) and y.shape == (BS,)
+        np.testing.assert_array_equal(y, np.arange(BS))
+    finally:
+        it.finalize()

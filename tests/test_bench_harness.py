@@ -631,7 +631,20 @@ def _run_supervised_wedge(tmp_path, wedge_mode, extra_env=None):
         assert lines, out
         return json.loads(lines[-1]), elapsed, detached_alive
     finally:
-        try:  # reap the fake wedged grandchild left alive by design
+        # reap the fake wedged grandchild left alive by design.  The
+        # child runs in its OWN session (start_new_session — the point
+        # of the group-signal hardening), so killing the supervisor's
+        # group no longer reaches it: collect its pid from the registry
+        # (detach path) and kill its session too.
+        if registry.exists():
+            for ln in registry.read_text().splitlines():
+                parts = ln.split()
+                if parts:
+                    try:
+                        os.killpg(int(parts[0]), _signal.SIGKILL)
+                    except Exception:
+                        pass
+        try:
             os.killpg(proc.pid, _signal.SIGKILL)
         except Exception:
             pass
@@ -832,3 +845,185 @@ def test_gloo_scaling_harness_zero_mode(tmp_path):
          "--gloo-hidden", "32", "--gloo-zero"], timeout=300)
     assert rows and rows[0]["zero_sharding"] is True
     assert rows[0]["step_ms"] > 0
+
+
+# -- detach hardening: session isolation, signal forwarding, registry lock --
+
+def test_registry_flock_serializes_read_modify_write(tmp_path, monkeypatch):
+    """Two concurrent supervisors must not interleave the registry's
+    read-append-replace (ADVICE r5: one os.replace could drop the
+    other's just-written entry).  Deterministic probe: while this
+    process holds the flock, a second (exec'd — a forked child would
+    inherit our lock fd and keep the flock alive past our close) writer
+    stays blocked; on release it completes and its entry lands."""
+    import subprocess
+    import sys
+    import time as _time
+
+    reg = str(tmp_path / "detached.pids")
+    marker = str(tmp_path / "writer-started")
+    monkeypatch.setattr(bench, "_DETACH_REGISTRY", reg)
+
+    lock = bench._registry_locked()
+    assert lock is not None
+    env = dict(os.environ, BENCH_DETACH_REGISTRY=reg)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import os, sys; sys.path.insert(0, sys.argv[1]); import bench;"
+         "open(sys.argv[2], 'w').close();"
+         "bench._register_detached(os.getpid())",
+         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+         marker],
+        env=env)
+    try:
+        deadline = _time.monotonic() + 20
+        while not os.path.exists(marker):  # writer up, about to lock
+            assert _time.monotonic() < deadline, "writer never started"
+            _time.sleep(0.05)
+        _time.sleep(0.5)
+        assert not os.path.exists(reg), \
+            "writer got past the held registry lock"
+        lock.close()  # releases the flock
+        assert proc.wait(timeout=15) == 0
+        pids = [int(ln.split()[0])
+                for ln in open(reg).read().splitlines()]
+        assert pids == [proc.pid]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_register_detached_write_failure_emits_diagnostic(
+        tmp_path, monkeypatch, capsys):
+    """A failed registry write still detaches (never force a kill) but
+    must say so on stderr — an unrecorded child is invisible to the
+    next run's contention wait (ADVICE r5 low)."""
+    reg = str(tmp_path / "no-such-dir" / "detached.pids")
+    monkeypatch.setattr(bench, "_DETACH_REGISTRY", reg)
+    assert bench._register_detached(os.getpid()) is True
+    assert "could NOT be recorded" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_supervised_child_runs_in_own_session(tmp_path):
+    """start_new_session: the supervised child leads its OWN session, so
+    a group-directed signal at the supervisor (GNU timeout, Ctrl-C, CI
+    group-kill) cannot reach it — a detach stays a real detach."""
+    import signal as _signal
+    import subprocess
+    import sys
+
+    registry = tmp_path / "detached.pids"
+    env = dict(os.environ, BENCH_TEST_WEDGE="emit-then-wedge",
+               BENCH_DEADLINE_S="8",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo_cache.json"),
+               BENCH_DETACH_REGISTRY=str(registry),
+               BENCH_START_STAMP=str(tmp_path / "started"))
+    env.pop("BENCH_MODEL", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, start_new_session=True)
+    child_pid = None
+    try:
+        proc.communicate(timeout=60)
+        entries = [ln.split() for ln in
+                   registry.read_text().splitlines() if ln.split()]
+        assert entries, "detached child was not registered"
+        child_pid = int(entries[-1][0])
+        assert os.getsid(child_pid) == child_pid, \
+            "detached child is not a session leader"
+    finally:
+        for pid in filter(None, [child_pid, proc.pid]):
+            try:
+                os.killpg(pid, _signal.SIGKILL)
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_supervisor_forwards_term_to_supervised_child(tmp_path):
+    """Interactive kill semantics survive the session split: TERM at the
+    still-supervising parent is forwarded to the child as SIGTERM, whose
+    handler emits the terminated line before dying — and the supervisor
+    serves it as the authoritative result, long before the deadline."""
+    import signal as _signal
+    import subprocess
+    import sys
+    import time as _time
+
+    env = dict(os.environ, BENCH_TEST_WEDGE="sleep-obedient",
+               BENCH_DEADLINE_S="120",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo_cache.json"),
+               BENCH_DETACH_REGISTRY=str(tmp_path / "detached.pids"),
+               BENCH_START_STAMP=str(tmp_path / "started"))
+    env.pop("BENCH_MODEL", None)
+    start = _time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, start_new_session=True)
+    try:
+        _time.sleep(3)  # let the supervisor spawn its child
+        os.kill(proc.pid, _signal.SIGTERM)
+        out, _ = proc.communicate(timeout=30)
+        elapsed = _time.monotonic() - start
+        lines = [ln for ln in out.strip().splitlines()
+                 if ln.startswith("{")]
+        assert lines, out
+        last = json.loads(lines[-1])
+        assert last["value"] is None
+        assert "terminated by supervisor" in last["error"]
+        assert elapsed < 60, \
+            f"TERM should end the run promptly, took {elapsed:.0f}s"
+    finally:
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except Exception:
+            pass
+
+
+@pytest.mark.slow
+def test_supervisor_interruptible_during_contention_wait(tmp_path):
+    """TERM/INT arriving while no supervised child exists (the
+    pre-spawn contention wait for an earlier run's detached child) must
+    not be swallowed: the handler re-delivers with the default
+    disposition, so `timeout`/Ctrl-C still end the supervisor."""
+    import signal as _signal
+    import subprocess
+    import sys
+    import time as _time
+
+    registry = tmp_path / "detached.pids"
+    me = f"{os.getpid()} {bench._proc_starttime(os.getpid())}"
+    registry.write_text(f"{me}\n")  # "alive sibling" -> contention wait
+    env = dict(os.environ, BENCH_TEST_WEDGE="sleep-obedient",
+               BENCH_DEADLINE_S="120",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo_cache.json"),
+               BENCH_DETACH_REGISTRY=str(registry),
+               BENCH_START_STAMP=str(tmp_path / "started"))
+    env.pop("BENCH_MODEL", None)
+    start = _time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+    try:
+        _time.sleep(2)  # inside the up-to-40s contention wait, no child
+        os.kill(proc.pid, _signal.SIGTERM)
+        rc = proc.wait(timeout=20)
+        elapsed = _time.monotonic() - start
+        assert rc != 0  # died by signal/default disposition
+        assert elapsed < 20, \
+            f"supervisor ignored TERM during contention wait ({elapsed:.0f}s)"
+    finally:
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except Exception:
+            pass

@@ -1,0 +1,113 @@
+"""GIL-bound-transform input-pipeline comparison (``make bench-input``).
+
+The question the process-pool iterator exists to answer: when the
+per-example transform is GIL-bound *Python* (not GIL-releasing numpy),
+how much throughput does a process pool recover over the prefetch
+thread?  Runs the SAME dataset + transform through
+``MultithreadIterator`` and ``MultiprocessIterator`` and prints one
+JSON row per configuration plus a final comparison row (last line is
+authoritative, bench.py convention):
+
+  {"metric": "gil_transform_input_throughput", ...,
+   "multithread_ips": ..., "multiprocess_ips": ..., "speedup": ...}
+
+No device, no jax — pure host measurement, safe anywhere.
+
+Env knobs: INPUT_BENCH_N (examples/epoch), INPUT_BENCH_BS,
+INPUT_BENCH_BATCHES (timed batches), INPUT_BENCH_PROCS (worker count;
+default cpu_count), INPUT_BENCH_WORK (transform cost knob — python
+bytecode iterations per example).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+N = int(os.environ.get("INPUT_BENCH_N", "512"))
+BS = int(os.environ.get("INPUT_BENCH_BS", "32"))
+BATCHES = int(os.environ.get("INPUT_BENCH_BATCHES", "24"))
+PROCS = int(os.environ.get("INPUT_BENCH_PROCS", "0")) \
+    or (os.cpu_count() or 2)
+WORK = int(os.environ.get("INPUT_BENCH_WORK", "20000"))
+
+
+class GilBoundDataset:
+    """Synthetic examples behind a deliberately GIL-bound transform: a
+    pure-Python accumulation loop (no numpy fast path to release the
+    GIL) — the workload class the reference's process pool targets
+    (SURVEY §2.8; VERDICT open item 5).  Picklable for spawn workers."""
+
+    def __init__(self, n, work):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for k in range(self.work):  # GIL held for the whole loop
+            acc = (acc + i * k) % 1000003
+        x = np.full((8,), float(acc % 256), np.float32)
+        return x, np.int64(i % 1000)
+
+
+def _throughput(make_iterator):
+    it = make_iterator()
+    try:
+        it.next()  # pipeline warm: workers up, first wave in flight
+        t0 = time.perf_counter()
+        for _ in range(BATCHES):
+            it.next()
+        elapsed = time.perf_counter() - t0
+    finally:
+        it.finalize()
+    return BATCHES * BS / elapsed
+
+
+def main():
+    from chainermn_tpu.dataset import (MultiprocessIterator,
+                                       MultithreadIterator)
+    dataset = GilBoundDataset(N, WORK)
+
+    thread_ips = _throughput(
+        lambda: MultithreadIterator(dataset, BS, shuffle=False,
+                                    n_prefetch=2))
+    print(json.dumps({"metric": "gil_transform_input_throughput",
+                      "iterator": "multithread", "value": round(
+                          thread_ips, 1), "unit": "images/sec"}),
+          flush=True)
+
+    proc_ips = _throughput(
+        lambda: MultiprocessIterator(dataset, BS, shuffle=False,
+                                     n_processes=PROCS, n_prefetch=2))
+    print(json.dumps({"metric": "gil_transform_input_throughput",
+                      "iterator": "multiprocess", "n_processes": PROCS,
+                      "value": round(proc_ips, 1),
+                      "unit": "images/sec"}), flush=True)
+
+    print(json.dumps({
+        "metric": "gil_transform_input_throughput",
+        "unit": "images/sec",
+        "batch_size": BS,
+        "batches_timed": BATCHES,
+        "transform_work": WORK,
+        "n_processes": PROCS,
+        "n_cpus": os.cpu_count(),
+        "multithread_ips": round(thread_ips, 1),
+        "multiprocess_ips": round(proc_ips, 1),
+        # the acceptance ratio: ≥2× with ≥4 workers on a ≥4-core host
+        # (capped by physical cores — a 2-core box tops out near 2×)
+        "speedup": round(proc_ips / thread_ips, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
