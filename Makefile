@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input audit dryrun examples clean
+.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -69,6 +69,13 @@ watch-status:     ## round-start checklist: watcher liveness + probe + queue sta
 
 probe-input:      ## host input-pipeline bandwidth at flagship scale (no chip)
 	PROBE=input_pipeline PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
+
+probe-bytes:      ## flagship HBM byte bill vs committed budget (no chip)
+	@# per-op-category bytes_accessed table + memory_analysis peaks for
+	@# the flagship ResNet-50 train step, checked against
+	@# tools/hbm_budgets.json (the tier-1 regression gate's data).
+	@# PROBE_COMPILE=0 skips backend codegen (lowered accounting only).
+	PROBE=hbm_bytes PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
 bench-input:      ## GIL-bound transform: MultiprocessIterator vs MultithreadIterator (no chip, no jax)
 	$(PY) tools/bench_input.py

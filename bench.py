@@ -63,8 +63,17 @@ a variant that is excluded from the last-good cache):
                 BENCH_HEADS, BENCH_REMAT_POLICY — transformer;
                 BENCH_STEPS (steps/trial), BENCH_TRIALS,
                 BENCH_PEAK_TFLOPS (MFU denominator override)
+                BENCH_DONATE=0 (A/B leg: disable params/opt-state
+                buffer donation — never cached as flagship data),
+                BENCH_MEMSTATS=0 (skip the memory_analysis row fields)
   deadline      BENCH_DEADLINE_S (else 270 s warm / 480 s first
-                contact per model, via BENCH_PREWARM_SENTINEL)
+                contact per model, via BENCH_PREWARM_SENTINEL);
+                compile time is EXCLUDED from it via the compile
+                heartbeat (BENCH_COMPILE_STAMP path, credit capped at
+                BENCH_COMPILE_GRACE_S, default 900)
+  compile cache BENCH_XLA_CACHE_DIR (persistent XLA cache location;
+                cpu+scan runs skip persistence — replay segfault,
+                BENCH_NOTES r5 tail)
   cache slots   BENCH_CACHE_PATH (/tmp), BENCH_REPO_CACHE_PATH
                 (committed bench_last_good.json; "" disables)
   detach        BENCH_DETACH_REGISTRY (lingering-children registry),
@@ -161,8 +170,81 @@ class BenchDeadline(Exception):
     relay; see `_child_main`)."""
 
 
+# Every process gets a unique run id (the supervisor overrides it for its
+# child) so staleness detection compares measurement provenance, not ''.
+os.environ.setdefault("BENCH_RUN_ID", f"{os.getpid()}-{int(time.time())}")
+
+# -- compile-phase heartbeat -------------------------------------------------
+#
+# VERDICT r5 Weak #1: three straight rounds the driver's first-contact
+# run stale-outed on COMPILE time, not measurement time.  The child now
+# stamps a heartbeat file around every trace+compile; the supervisor
+# reads it and EXCLUDES compile time from the measurement deadline — the
+# clock pauses while a compile is in flight (bounded by
+# BENCH_COMPILE_GRACE_S) and the recorded compile seconds stay credited
+# afterwards.  The child's cooperative `_remaining()` gets the same
+# credit, so both sides agree on the budget.
+
+_COMPILE_STAMP = os.environ.get("BENCH_COMPILE_STAMP") or (
+    "/tmp/chainermn_tpu_bench_compile." + os.environ["BENCH_RUN_ID"])
+_COMPILE_GRACE_S = float(os.environ.get("BENCH_COMPILE_GRACE_S", "900"))
+_COMPILE_CREDIT = [0.0]  # child-side cumulative compile seconds
+
+
+_STAMP_WRITTEN = [False]
+
+
+def _stamp_compile(phase, credit_s):
+    """Write the compile-phase heartbeat (atomic replace; never raises).
+    ``phase``: "compile" (in flight — the supervisor's clock pauses) or
+    "done" (credit_s holds the cumulative compile seconds).  The first
+    write registers an atexit removal, so unsupervised and DETACHED
+    children clean their own stamp (the supervisor only removes its
+    still-supervised child's) — /tmp must not accumulate one uniquely
+    named file per bench run."""
+    try:
+        tmp = _COMPILE_STAMP + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"run_id": os.environ["BENCH_RUN_ID"],
+                       "phase": phase, "t": time.monotonic(),
+                       "credit_s": credit_s}, f)
+        os.replace(tmp, _COMPILE_STAMP)
+        if not _STAMP_WRITTEN[0]:
+            _STAMP_WRITTEN[0] = True
+            import atexit
+
+            def _cleanup():
+                try:
+                    os.remove(_COMPILE_STAMP)
+                except OSError:
+                    pass
+            atexit.register(_cleanup)
+    except Exception:
+        pass
+
+
+def _compile_credit_from_stamp(stamp_path, run_id, now, grace_s):
+    """Supervisor side: deadline extension earned by the child's compile
+    phases — the recorded cumulative compile seconds, plus the elapsed
+    time of an in-flight compile (CLOCK_MONOTONIC is process-shared on
+    this platform), capped at ``grace_s``.  A stamp from another run_id
+    earns nothing.  Never raises."""
+    try:
+        with open(stamp_path) as f:
+            st = json.load(f)
+        if st.get("run_id") != run_id:
+            return 0.0
+        credit = float(st.get("credit_s", 0.0))
+        if st.get("phase") == "compile":
+            credit += max(0.0, now - float(st.get("t", now)))
+        return min(credit, grace_s)
+    except Exception:
+        return 0.0
+
+
 def _remaining():
-    return _DEADLINE_S - (time.monotonic() - _START)
+    credit = min(_COMPILE_CREDIT[0], _COMPILE_GRACE_S)
+    return _DEADLINE_S + credit - (time.monotonic() - _START)
 
 
 def _check_compile_budget():
@@ -198,10 +280,6 @@ def _newer_bench_started():
 
 _EMITTED = [None]  # last result dict this process printed
 
-# Every process gets a unique run id (the supervisor overrides it for its
-# child) so staleness detection compares measurement provenance, not ''.
-os.environ.setdefault("BENCH_RUN_ID", f"{os.getpid()}-{int(time.time())}")
-
 
 _METRIC_TO_MODEL = {
     "resnet50_imagenet_train_throughput": "resnet50",
@@ -218,14 +296,14 @@ _DEFAULT_FINGERPRINTS = {
     "resnet50": {"model": "resnet50", "bs": DEFAULT_BS,
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
-                 "input_pipeline": False},
+                 "input_pipeline": False, "donate": True},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
                     "n_vocab": DEFAULT_TF_VOCAB, "heads": 0,
                     "remat": False, "remat_policy": "",
                     "n_steps": DEFAULT_TF_STEPS,
-                    "flash_blocks": ":"},
+                    "flash_blocks": ":", "donate": True},
 }
 
 
@@ -278,6 +356,9 @@ def _config_fingerprint(model=None):
                 os.environ.get("CHAINERMN_TPU_FLASH_BLOCK_Q", "")
                 + ":"
                 + os.environ.get("CHAINERMN_TPU_FLASH_BLOCK_K", ""),
+            # BENCH_DONATE=0 is the buffer-donation A/B leg: different
+            # compiled program + different HBM headroom, never flagship
+            "donate": os.environ.get("BENCH_DONATE", "1") == "1",
         }
     return {
         "model": "resnet50",
@@ -289,6 +370,7 @@ def _config_fingerprint(model=None):
         "n_steps": _env_int("BENCH_STEPS", DEFAULT_STEPS),
         "input_pipeline":
             os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1",
+        "donate": os.environ.get("BENCH_DONATE", "1") == "1",
     }
 
 
@@ -322,6 +404,9 @@ def _payload_flagship_ok(model, result):
     if result.get("value") is None or result.get("stale") \
             or result.get("error") or result.get("contended") \
             or result.get("platform") in (None, "cpu", "cpu_fallback"):
+        return False
+    if not result.get("donated", True):
+        # the BENCH_DONATE=0 A/B leg is a measurement, not flagship data
         return False
     if model == "resnet50":
         # batch bounds: OOM backoff halves the requested batch at most
@@ -598,6 +683,15 @@ def _transformer_flops_per_token(d_model, n_layers, n_vocab, seq_len):
     return 3.0 * (matmul + attn)
 
 
+def _scan_mode_requested():
+    """Will this run compile a scan-over-steps program?  Mirrors the
+    BENCH_SCAN / BENCH_INPUT_PIPELINE default logic in `_run_bench`."""
+    scan_env = os.environ.get("BENCH_SCAN", "")
+    if scan_env:
+        return _env_int("BENCH_SCAN", 0) > 0
+    return os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1"
+
+
 def _enable_compile_cache(jax):
     # On this box the JAX_PLATFORMS env var is NOT honored (the axon
     # sitecustomize registers its PJRT plugin at interpreter startup and
@@ -610,12 +704,17 @@ def _enable_compile_cache(jax):
             jax.config.update("jax_platforms", plat)
         except Exception:
             pass
-    try:  # persistent compile cache: repeat runs skip the ~30s XLA compile
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/chainermn_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # Persistent compile cache: repeat runs skip the ~30s XLA compile.
+    # Gated through the shared guard — the CPU backend CRASHES replaying
+    # persisted scan-over-steps programs (BENCH_NOTES r5 tail) AND
+    # params-donated step programs (round 6; donation is the default),
+    # so such cpu runs forgo persistence entirely and scan programs
+    # elsewhere get a `.scan`-keyed sibling cache dir.
+    from chainermn_tpu.utils.compat import configure_persistent_cache
+    configure_persistent_cache(
+        jax, cache_dir=os.environ.get("BENCH_XLA_CACHE_DIR"),
+        platform=plat, scan_program=_scan_mode_requested(),
+        donated_program=os.environ.get("BENCH_DONATE", "1") == "1")
 
 
 def _timed_steps(do_steps, calls, trials=None, on_first=None):
@@ -630,10 +729,15 @@ def _timed_steps(do_steps, calls, trials=None, on_first=None):
     later trials risk the deadline.  Returns (best_seconds, compile_s)."""
     if trials is None:
         trials = int(os.environ.get("BENCH_TRIALS", "1"))
+    _stamp_compile("compile", _COMPILE_CREDIT[0])
     t0 = time.perf_counter()
     loss = do_steps()  # first call: trace + XLA compile
     float(loss)
     compile_s = time.perf_counter() - t0
+    # compile time is excluded from the deadline (both sides: the child's
+    # cooperative checks here, the supervisor via the heartbeat file)
+    _COMPILE_CREDIT[0] += compile_s
+    _stamp_compile("done", _COMPILE_CREDIT[0])
     loss = do_steps()  # warmup dispatch
     float(loss)
     best = None
@@ -651,6 +755,32 @@ def _timed_steps(do_steps, calls, trials=None, on_first=None):
             # must be returned, not replaced by a stale/error line
             break
     return best, compile_s
+
+
+def _step_hbm_stats(opt):
+    """``memory_analysis`` of the step program just benchmarked: the
+    donation proof (params + opt-state alias bytes) and the
+    peak-resident figure for the result row.  AOT re-lower + compile
+    from shape specs, run UNDER the compile heartbeat: where the
+    persistent cache absorbs it, the credit is ~0; where the cache is
+    disabled (cpu + donated programs — the replay-crash guard) the
+    recompile's seconds are excluded from the deadline like any other
+    compile, so this query can never stale-out the run it decorates.
+    Skipped when the remaining budget is thin, the knob is off, or the
+    backend implements no analysis."""
+    if os.environ.get("BENCH_MEMSTATS", "1") != "1" or _remaining() < 45:
+        return None
+    from chainermn_tpu.core.optimizer import memory_stats_dict
+    _stamp_compile("compile", _COMPILE_CREDIT[0])
+    t0 = time.perf_counter()
+    try:
+        ma = opt.compiled_step_memory_analysis()
+    except Exception:
+        ma = None
+    finally:
+        _COMPILE_CREDIT[0] += time.perf_counter() - t0
+        _stamp_compile("done", _COMPILE_CREDIT[0])
+    return memory_stats_dict(ma)
 
 
 def _run_bench_transformer():
@@ -688,12 +818,13 @@ def _run_bench_transformer():
     if d_model % n_heads:
         raise ValueError(f"BENCH_D_MODEL={d_model} is not divisible by "
                          f"n_heads={n_heads}; set BENCH_HEADS explicitly")
+    donate = os.environ.get("BENCH_DONATE", "1") == "1"
 
     devices = jax.devices()
     n_devices = len(devices)
     platform = devices[0].platform
 
-    def mk_result(tokens_per_sec, compile_s, used_bs):
+    def mk_result(tokens_per_sec, compile_s, used_bs, hbm=None):
         per_chip = tokens_per_sec / n_devices
         result = {
             "metric": "transformer_lm_train_throughput",
@@ -711,8 +842,12 @@ def _run_bench_transformer():
             "remat": remat,
             "remat_policy": remat_policy,
             "n_steps": n_steps,
+            "donated": donate,
             "compile_s": round(compile_s, 1),
         }
+        if hbm is not None:
+            result["peak_hbm_bytes"] = hbm["peak_hbm_bytes"]
+            result["hbm"] = hbm
         peak = _peak_tflops(devices)
         if peak:
             fpt = _transformer_flops_per_token(d_model, n_layers, n_vocab,
@@ -730,7 +865,7 @@ def _run_bench_transformer():
                               compute_dtype=jnp.bfloat16)
         comm.bcast_data(model)
         inner = Adam(alpha=3e-4)
-        inner.donate_params = True
+        inner.donate_params = donate  # BENCH_DONATE=0 = the A/B leg
         opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
 
         global_bs = per_chip_bs * n_devices
@@ -745,7 +880,8 @@ def _run_bench_transformer():
 
         best, compile_s = _timed_steps(lambda: opt.update(model, x, t),
                                        n_steps, on_first=on_first)
-        return n_steps * global_bs * seq_len / best, compile_s
+        return (n_steps * global_bs * seq_len / best, compile_s,
+                _step_hbm_stats(opt))
 
     tokens_per_sec = None
     last_err = None
@@ -755,7 +891,7 @@ def _run_bench_transformer():
             break
         _check_compile_budget()
         try:
-            tokens_per_sec, compile_s = run(bs)
+            tokens_per_sec, compile_s, hbm = run(bs)
             used_bs = bs
             break
         except BenchDeadline:
@@ -764,7 +900,7 @@ def _run_bench_transformer():
             last_err = e
     if tokens_per_sec is None:
         raise last_err
-    return mk_result(tokens_per_sec, compile_s, used_bs)
+    return mk_result(tokens_per_sec, compile_s, used_bs, hbm)
 
 
 def _run_bench():
@@ -817,12 +953,15 @@ def _run_bench():
                 "BENCH_ITERATOR=native requires the native loader "
                 "(g++ toolchain) — unavailable on this host")
 
+    donate = os.environ.get("BENCH_DONATE", "1") == "1"
+
     devices = jax.devices()  # raises if the backend is unavailable
     n_devices = len(devices)
     platform = devices[0].platform
     device_kind = getattr(devices[0], "device_kind", platform)
 
-    def mk_result(images_per_sec, compile_s, used_bs, feed_stats=None):
+    def mk_result(images_per_sec, compile_s, used_bs, feed_stats=None,
+                  hbm=None):
         per_chip = images_per_sec / n_devices
         result = {
             "metric": "resnet50_imagenet_train_throughput",
@@ -838,9 +977,13 @@ def _run_bench():
             "remat": remat,
             "n_steps": n_steps,
             "input_pipeline": input_pipeline,
+            "donated": donate,
             "compile_s": round(compile_s, 1),
             "fused_steps_per_dispatch": scan_k or 1,
         }
+        if hbm is not None:
+            result["peak_hbm_bytes"] = hbm["peak_hbm_bytes"]
+            result["hbm"] = hbm
         if input_pipeline:
             result["iterator_kind"] = iterator_kind
             if feed_stats is not None:
@@ -892,7 +1035,7 @@ def _run_bench():
             input_norm="imagenet" if input_pipeline else None))
         comm.bcast_data(model)
         inner = MomentumSGD(lr=0.1, momentum=0.9)
-        inner.donate_params = True  # in-place param update (bench owns the model)
+        inner.donate_params = donate  # BENCH_DONATE=0 = the A/B leg
         opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
 
         rng = np.random.RandomState(0)
@@ -969,7 +1112,7 @@ def _run_bench():
             best, compile_s = _timed_steps(do_steps, calls,
                                            on_first=on_first)
             return (calls * steps_per_call * global_bs / best, compile_s,
-                    feed_stats)
+                    feed_stats, _step_hbm_stats(opt))
         finally:
             if it is not None:
                 it.finalize()  # stop pool/threads before any OOM rebuild
@@ -982,7 +1125,7 @@ def _run_bench():
             break
         _check_compile_budget()
         try:
-            images_per_sec, compile_s, feed_stats = run(bs)
+            images_per_sec, compile_s, feed_stats, hbm = run(bs)
             used_bs = bs
             break
         except BenchDeadline:
@@ -991,7 +1134,7 @@ def _run_bench():
             last_err = e
     if images_per_sec is None:
         raise last_err
-    return mk_result(images_per_sec, compile_s, used_bs, feed_stats)
+    return mk_result(images_per_sec, compile_s, used_bs, feed_stats, hbm)
 
 
 def _err_metric():
@@ -1043,6 +1186,22 @@ def _child_main():
         signal.signal(signal.SIGTERM, signal.SIG_IGN)
         while True:
             time.sleep(3600)
+    if os.environ.get("BENCH_TEST_WEDGE") == "slow-compile":
+        # fault injection: a compile phase LONGER than the whole
+        # deadline, then a fresh result — the supervisor must pause its
+        # clock on the heartbeat and serve the fresh line, not stale
+        # (VERDICT r5 Weak #1: first contact stale-outing on compile)
+        dur = float(os.environ.get("BENCH_TEST_COMPILE_S", "12"))
+        _stamp_compile("compile", 0.0)
+        time.sleep(dur)
+        _COMPILE_CREDIT[0] += dur
+        _stamp_compile("done", _COMPILE_CREDIT[0])
+        print(json.dumps({"metric": "resnet50_imagenet_train_throughput",
+                          "value": 77.0, "unit": "images/sec/chip",
+                          "vs_baseline": None, "platform": "test",
+                          "compile_s": dur, "fresh_after_compile": True}),
+              flush=True)
+        return 0
     if os.environ.get("BENCH_TEST_WEDGE") == "emit-then-wedge":
         # fault injection: an early-emit line, then the wedge — the
         # supervisor's incremental read must serve the early line as
@@ -1269,7 +1428,10 @@ def _supervise():
     still-supervised child as SIGTERM (whose handler emits before
     dying); once detached, nothing is forwarded."""
     run_id = f"{os.getpid()}-{int(time.time())}"
-    env = dict(os.environ, BENCH_SUPERVISED="1", BENCH_RUN_ID=run_id)
+    compile_stamp = os.environ.get("BENCH_COMPILE_STAMP") or (
+        "/tmp/chainermn_tpu_bench_compile." + run_id)
+    env = dict(os.environ, BENCH_SUPERVISED="1", BENCH_RUN_ID=run_id,
+               BENCH_COMPILE_STAMP=compile_stamp)
     sig_state = {"proc": None, "detached": False}
 
     def _forward_signal(signum, frame):
@@ -1334,7 +1496,14 @@ def _supervise():
     buf = bytearray()
     timed_out = False
     while True:
-        left = deadline - time.monotonic()
+        now = time.monotonic()
+        # compile time is excluded from the measurement deadline: the
+        # child's heartbeat pauses the clock while a compile is in
+        # flight and credits recorded compile seconds afterwards
+        # (VERDICT r5 Weak #1 — first contact must not stale-out on
+        # compile time alone), bounded by BENCH_COMPILE_GRACE_S
+        left = deadline + _compile_credit_from_stamp(
+            compile_stamp, run_id, now, _COMPILE_GRACE_S) - now
         if left <= 0:
             timed_out = True
             break
@@ -1394,6 +1563,11 @@ def _supervise():
                           file=sys.stderr, flush=True)
                 except Exception:
                     pass
+    if not sig_state["detached"]:
+        try:  # heartbeat hygiene; a detached child may still be writing
+            os.remove(compile_stamp)
+        except OSError:
+            pass
     out = buf.decode("utf-8", "replace")
     result = _parse_last_json_line(out)
     if result is None:

@@ -224,10 +224,16 @@ class Link:
 
     # -- copy -------------------------------------------------------------
     def copyparams(self, link: "Link"):
+        """Copy parameter VALUES from ``link`` (reference ``copyparams``
+        semantics: ``copydata``, not aliasing).  Copying — rather than
+        sharing the ``jax.Array`` objects, as an earlier build did — is
+        part of the donation-safety contract: a donated train step on one
+        link must never invalidate another link's buffers (see
+        ``Optimizer.donate_params``)."""
         src = dict(link.namedparams())
         for path, p in self.namedparams():
             if path in src and src[path].array is not None:
-                p.array = src[path].array
+                p.array = jnp.array(src[path].array, copy=True)
 
     # -- serialization (chainer serializer protocol) ----------------------
     def serialize(self, serializer):

@@ -251,6 +251,78 @@ def deserialize_flat_tree(serializer, template, count_key, leaf_prefix):
     return jax.tree.unflatten(treedef, new)
 
 
+def raise_if_donated_state_lost(exc, optimizer):
+    """Donation failure containment, shared by every updater path.
+
+    A donated step that fails mid-execution has already consumed the
+    parameter/opt-state buffers; retrying ``update()`` on the same
+    instance would feed deleted arrays back into XLA with an opaque
+    error.  Detect the case and raise a RuntimeError that names the
+    actual recovery (rebuild or reload the model — the resilience
+    subsystem's consensus resume does exactly that), chaining the
+    original failure.  No-op when nothing was donated or the failure
+    happened before execution (trace/shape errors leave buffers alive).
+    """
+    target = getattr(optimizer, "target", None)
+    if target is None or not getattr(optimizer, "donate_params", False):
+        return
+    lost = any(p.array is not None
+               and getattr(p.array, "is_deleted", lambda: False)()
+               for p in target.params())
+    if lost:
+        raise RuntimeError(
+            "a donated train step failed after consuming the model's "
+            "parameter buffers; rebuild or reload the model (snapshot / "
+            "consensus resume) before the next update — or set "
+            "optimizer.donate_params = False for retry-able interactive "
+            "use") from exc
+
+
+def _operand_specs(operands):
+    """ShapeDtypeStruct tree of an operand tuple (idempotent: specs map
+    to equal specs) — shapes only, no buffers pinned."""
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "dtype") and hasattr(a, "shape") else a, operands)
+
+
+def memory_stats_dict(ma):
+    """``CompiledMemoryStats`` → plain dict (JSON-ready), with the
+    derived ``peak_hbm_bytes`` figure.  ONE definition — bench rows and
+    the hbm_bytes probe both report through it, so the committed budget
+    comparisons can never diverge on what "peak" means.  None passes
+    through (backend without memory analysis)."""
+    if ma is None:
+        return None
+    return {
+        "argument_bytes": ma.argument_size_in_bytes,
+        "output_bytes": ma.output_size_in_bytes,
+        "temp_bytes": ma.temp_size_in_bytes,
+        "alias_bytes": ma.alias_size_in_bytes,
+        "peak_hbm_bytes": ma.argument_size_in_bytes
+        + ma.output_size_in_bytes - ma.alias_size_in_bytes
+        + ma.temp_size_in_bytes + ma.generated_code_size_in_bytes,
+    }
+
+
+def aot_memory_analysis(step, operands):
+    """``memory_analysis()`` of a compiled step, from shape specs only.
+
+    ``step`` is the jit-wrapped step function; ``operands`` the exact
+    argument tuple a dispatch received (or its spec tree).  Lowering
+    from ``ShapeDtypeStruct``s pins no buffers, and with the persistent
+    XLA cache enabled the AOT compile is a cache hit of the
+    dispatch-path executable.  Returns None when the backend implements
+    no memory analysis.  Used by bench rows (``peak_hbm_bytes``) and the
+    donation test suite (params + opt-state aliased into outputs).
+    """
+    try:
+        return step.lower(*_operand_specs(operands)).compile() \
+            .memory_analysis()
+    except NotImplementedError:
+        return None
+
+
 class _LRUCache(OrderedDict):
     """Bounded compiled-step cache.
 
@@ -289,13 +361,22 @@ class Optimizer:
     # names of hyperparameters passed as traced args (mutable between steps)
     _dynamic_hyper = ("lr",)
 
-    #: opt-in: donate parameter buffers to the compiled step (in-place
-    #: update; saves one params-sized HBM allocation — see _make_step).
-    #: Caveat: if a donated step fails at runtime (e.g. HBM OOM), the
-    #: Link's old param buffers are already invalidated — recovery
-    #: requires rebuilding/reloading the model, not retrying update()
-    #: on the same instance.  Leave False for anything interactive.
-    donate_params = False
+    #: Donate parameter buffers to the compiled step (in-place update:
+    #: one less params-sized HBM allocation per step, and the headroom
+    #: that unlocks per-chip batches beyond 256 on the flagship model).
+    #: ON by default: donation is safe through the Link pytree bridge —
+    #: every compiled step returns fresh param arrays that ``_write_back``
+    #: rebinds into the SAME ``Parameter`` objects before control returns
+    #: to user code, and ``Link.copyparams`` copies by value, so code that
+    #: goes through Parameters never sees a deleted buffer.  What donation
+    #: DOES invalidate is a raw ``jax.Array`` reference captured from
+    #: ``p.array`` before an update — hold the ``Parameter``, or
+    #: ``np.asarray`` the value, or set ``donate_params = False``.
+    #: If a donated step fails MID-EXECUTION (e.g. HBM OOM), the donated
+    #: buffers are already consumed: ``update`` raises a RuntimeError
+    #: naming the recovery (rebuild/reload the model) instead of leaving
+    #: the Link silently holding dead arrays.
+    donate_params = True
 
     def __init__(self):
         self.target: Link | None = None
@@ -410,16 +491,34 @@ class Optimizer:
                 hyper.get("decoupled_wd", 0.0))
             return new_params, new_pstate, new_opt_state, loss, grads, obs
 
-        # donate opt_state (optimizer-internal, replaced by the returned
-        # value) so XLA updates it in place; params/persistent state stay
-        # un-donated by default — Link arrays are user-visible and may be
-        # aliased (copyparams shares array objects).  Setting
-        # ``opt.donate_params = True`` opts in to donating the parameter
-        # buffers as well (in-place update, one less params-sized HBM
-        # allocation — worth it for big models; the old ``p.array`` objects
-        # become invalid, which only matters to code that kept references)
-        donate = (0, 2) if getattr(self, "donate_params", False) else (2,)
+        # donate params + opt_state so XLA updates both in place (see the
+        # ``donate_params`` class doc for the safety contract; persistent
+        # state — arg 1, BN stats — is NOT donated: it is small and the
+        # forward reads it eagerly outside the aliasing guarantee)
+        donate = (0, 2) if getattr(self, "donate_params", True) else (2,)
         return jax.jit(step, donate_argnums=donate)
+
+    def _stash_step_spec(self, step, operands):
+        """Remember the last dispatched step as (jit fn, ShapeDtypeStruct
+        tree) — shapes only, no buffers pinned — so tooling can AOT-query
+        the exact compiled program (see :func:`aot_memory_analysis`).
+        Hot-path discipline: the spec is rebuilt only when the step
+        object CHANGES — operand shapes/dtypes are part of the step-cache
+        key, so same step ⇒ same specs, and re-dispatches pay one
+        identity check instead of a tree-map over the whole
+        param/opt-state pytree."""
+        last = getattr(self, "_last_step_spec", None)
+        if last is not None and last[0] is step:
+            return
+        self._last_step_spec = (step, _operand_specs(operands))
+
+    def compiled_step_memory_analysis(self):
+        """``memory_analysis()`` of the most recently dispatched compiled
+        step (None before any update, or when the backend lacks it)."""
+        spec = getattr(self, "_last_step_spec", None)
+        if spec is None:
+            return None
+        return aot_memory_analysis(*spec)
 
     def _cache_key(self, lossfun, args, kwargs):
         shapes = tuple(
@@ -447,9 +546,15 @@ class Optimizer:
         if step is None:
             step = self._make_step(lossfun)
             self._step_cache[key] = step
-        new_params, new_pstate, new_opt_state, loss, grads, obs = step(
-            params, pstate, opt_state, self._hyper_values(),
-            self._next_rng_key(), args, kwargs)
+        operands = (params, pstate, opt_state, self._hyper_values(),
+                    self._next_rng_key(), args, kwargs)
+        self._stash_step_spec(step, operands)
+        try:
+            new_params, new_pstate, new_opt_state, loss, grads, obs = \
+                step(*operands)
+        except Exception as e:
+            raise_if_donated_state_lost(e, self)
+            raise
         self._write_back(new_params, new_pstate, grads)
         self._opt_state = new_opt_state
         self.t += 1

@@ -5,10 +5,16 @@ from .failure_recovery import FailureRecovery, RecoveryGivingUp
 from .observation_aggregator import ObservationAggregator
 
 try:
-    from .orbax_checkpoint import OrbaxCheckpointer
+    from .orbax_checkpoint import (OrbaxCheckpointer,
+                                   create_multi_node_orbax_checkpointer,
+                                   _MultiNodeOrbaxCheckpointer)
 except Exception:  # pragma: no cover - orbax optional
     OrbaxCheckpointer = None
+    create_multi_node_orbax_checkpointer = None
+    _MultiNodeOrbaxCheckpointer = None
 
 __all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer",
            "FailureRecovery", "RecoveryGivingUp",
-           "ObservationAggregator", "OrbaxCheckpointer"]
+           "ObservationAggregator", "OrbaxCheckpointer",
+           "create_multi_node_orbax_checkpointer",
+           "_MultiNodeOrbaxCheckpointer"]

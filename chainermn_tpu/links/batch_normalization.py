@@ -15,7 +15,6 @@ so plain moments are global moments and the op degrades to the base BN.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from ..nn.links import BatchNormalization
@@ -38,16 +37,13 @@ class MultiNodeBatchNormalization(BatchNormalization):
         self.comm = comm
         self.communication_backend = communication_backend
 
-    def _moments(self, x, axis):
-        x = x.astype(jnp.float32)  # fp32 statistics for bf16 activations
-        mean = x.mean(axis=axis)
-        sq_mean = (x * x).mean(axis=axis)
+    def _sync_moments(self, mean, sq_mean, x):
+        # global-batch statistics: one fused pmean of both single-pass
+        # accumulators (the base class forms the variance afterwards)
         if isinstance(x, jax.core.Tracer) and self.comm.axis_name is not None:
-            # global-batch statistics: one fused pmean of both moments
             mean = lax.pmean(mean, self.comm.axis_name)
             sq_mean = lax.pmean(sq_mean, self.comm.axis_name)
-        var = sq_mean - mean * mean
-        return mean, var
+        return mean, sq_mean
 
     def _moment_count(self, x, axis):
         m = super()._moment_count(x, axis)

@@ -14,6 +14,8 @@ fresh per-step subkey comes from the compiled train step's key scope
 from __future__ import annotations
 
 import builtins
+import functools
+import os
 
 import numpy as np
 
@@ -29,7 +31,8 @@ __all__ = [
     "convolution_2d", "deconvolution_2d", "depthwise_convolution_2d",
     "max_pooling_2d", "average_pooling_2d", "unpooling_2d",
     "global_average_pooling_2d", "resize_images",
-    "batch_normalization", "fixed_batch_normalization", "layer_normalization",
+    "batch_normalization", "fixed_batch_normalization", "batch_moments",
+    "layer_normalization",
     "concat", "stack", "hstack", "vstack", "split_axis", "separate",
     "average", "select_item", "absolute", "maximum", "minimum", "swish",
     "normalize", "local_response_normalization", "squared_error",
@@ -294,6 +297,16 @@ def _pool_geometry(kh, kw, sy, sx, pads, layout):
     return tuple(dims), tuple(strides), tuple(padding)
 
 
+#: Backward lowering for float max pooling: "argmax" (default) stores the
+#: per-window argmax in the forward and scatters the cotangent through it
+#: in ONE fused pass; "xla" keeps the reduce_window VJP, whose
+#: `select-and-scatter` re-compares the whole input against the output on
+#: the backward pass (an unfusible HBM-bound op — the 0.75 ms/step row in
+#: the r5 ResNet trace).  Env knob for A/B and fallback; tests pin the
+#: two paths equal.
+_MAXPOOL_VJP = os.environ.get("CHAINERMN_TPU_MAXPOOL_VJP", "argmax")
+
+
 def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True,
                    layout="NCHW"):
     kh, kw = _pair(ksize)
@@ -308,11 +321,129 @@ def max_pooling_2d(x, ksize, stride=None, pad=0, cover_all=True,
         ew = builtins.max(0, (-(w + 2 * pw - kw) % sx)) if sx > 1 else 0
     else:
         eh = ew = 0
+    pads = ((ph, ph + eh), (pw, pw + ew))
+    if _MAXPOOL_VJP == "argmax" and kh * kw <= 255 \
+            and jnp.issubdtype(x.dtype, jnp.floating):
+        # uint8 argmax storage caps the window at 255 taps; larger
+        # windows (never seen in practice) keep the XLA path
+        return _max_pool_argmax(x, (kh, kw), (sy, sx), pads,
+                                (x.shape[hd], x.shape[wd]), layout)
+    return _max_pool_xla(x, (kh, kw), (sy, sx), pads, layout)
+
+
+def _max_pool_xla(x, kdims, sdims, pads, layout):
+    """Plain reduce_window max (XLA differentiates it via
+    select-and-scatter) — the pre-argmax lowering, kept as the integer
+    path, the >255-tap fallback, and the equivalence-test reference."""
+    kh, kw = kdims
+    sy, sx = sdims
     neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.iinfo(x.dtype).min
-    dims, strides, padding = _pool_geometry(
-        kh, kw, sy, sx, ((ph, ph + eh), (pw, pw + ew)), layout)
+    dims, strides, padding = _pool_geometry(kh, kw, sy, sx, pads, layout)
     return lax.reduce_window(x, neg, lax.max, dims, strides, padding)
+
+
+def _window_taps(x_p, kh, kw, sy, sx, oh, ow, hd, wd):
+    """(offset, strided slice of the padded input) per window tap — each
+    slice is an output-shaped view; XLA fuses the whole chain into one
+    pass over the input."""
+    nd = x_p.ndim
+    for i in range(kh):
+        for j in range(kw):
+            start = [0] * nd
+            limit = list(x_p.shape)
+            strides = [1] * nd
+            start[hd], start[wd] = i, j
+            limit[hd] = i + sy * (oh - 1) + 1
+            limit[wd] = j + sx * (ow - 1) + 1
+            strides[hd], strides[wd] = sy, sx
+            yield i * kw + j, lax.slice(x_p, start, limit, strides)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _max_pool_argmax(x, kdims, sdims, pads, hw, layout):
+    """Max pooling whose VJP scatters through STORED argmax indices.
+
+    Forward: the max itself comes from the same fused ``reduce_window``
+    as the XLA path (bit-identical values); a fused compare chain over
+    the k·k strided window taps additionally materializes each window's
+    (first) argmax as a uint8 plane.  Backward: one pass summing the
+    k·k dilated placements of ``where(idx == tap, g, 0)`` — all pads and
+    adds, fully fusible — instead of XLA's ``select-and-scatter``, which
+    re-reads the entire input AND output to re-discover the argmax.
+    Gradients match the XLA lowering bit-exactly for tie-free inputs.
+    With EXACT ties (realistic in bf16) the two lowerings diverge: this
+    path routes the whole cotangent to the FIRST maximum in window order
+    (the argmax convention, and the reference Chainer's), while XLA's
+    packed select-and-gather picks a tied winner by tangent bit pattern
+    — effectively arbitrary.  Deterministic-first is the better
+    contract, so the divergence is intentional; NaN windows likewise
+    route to tap 0 here where XLA propagates.
+    """
+    y, _ = _max_pool_argmax_fwd_impl(x, kdims, sdims, pads, layout)
+    return y
+
+
+def _max_pool_argmax_fwd_impl(x, kdims, sdims, pads, layout):
+    kh, kw = kdims
+    sy, sx = sdims
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = pads
+    hd, wd, _ = _spatial_dims(layout)
+    dims, strides, padding = _pool_geometry(kh, kw, sy, sx, pads, layout)
+    y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+    pad_cfg = [(0, 0, 0)] * x.ndim
+    pad_cfg[hd] = (ph_lo, ph_hi, 0)
+    pad_cfg[wd] = (pw_lo, pw_hi, 0)
+    x_p = lax.pad(x, jnp.array(-jnp.inf, x.dtype), pad_cfg)
+    oh, ow = y.shape[hd], y.shape[wd]
+    best = idx = None
+    for o, tap in _window_taps(x_p, kh, kw, sy, sx, oh, ow, hd, wd):
+        if best is None:
+            best, idx = tap, jnp.zeros(tap.shape, jnp.uint8)
+        else:
+            take = tap > best  # strict >: first max wins, like argmax
+            best = jnp.where(take, tap, best)
+            idx = jnp.where(take, jnp.uint8(o), idx)
+    return y, idx
+
+
+def _max_pool_argmax_fwd(x, kdims, sdims, pads, hw, layout):
+    y, idx = _max_pool_argmax_fwd_impl(x, kdims, sdims, pads, layout)
+    # residual: ONE uint8 output-shaped plane (vs select-and-scatter
+    # keeping the full input AND output live into the backward)
+    return y, idx
+
+
+def _max_pool_argmax_bwd(kdims, sdims, pads, hw, layout, idx, g):
+    kh, kw = kdims
+    sy, sx = sdims
+    (ph_lo, ph_hi), (pw_lo, pw_hi) = pads
+    h_in, w_in = hw
+    hd, wd, _ = _spatial_dims(layout)
+    oh, ow = g.shape[hd], g.shape[wd]
+    hp = h_in + ph_lo + ph_hi
+    wp = w_in + pw_lo + pw_hi
+    zero = jnp.array(0, g.dtype)
+    dx_p = None
+    for i in range(kh):
+        for j in range(kw):
+            o = i * kw + j
+            contrib = jnp.where(idx == jnp.uint8(o), g, zero)
+            # transpose of the forward's strided slice: dilate by the
+            # stride, offset by the tap position
+            pad_cfg = [(0, 0, 0)] * g.ndim
+            pad_cfg[hd] = (i, hp - (i + sy * (oh - 1) + 1), sy - 1)
+            pad_cfg[wd] = (j, wp - (j + sx * (ow - 1) + 1), sx - 1)
+            placed = lax.pad(contrib, zero, pad_cfg)
+            dx_p = placed if dx_p is None else dx_p + placed
+    start = [0] * dx_p.ndim
+    limit = list(dx_p.shape)
+    start[hd], start[wd] = ph_lo, pw_lo
+    limit[hd], limit[wd] = ph_lo + h_in, pw_lo + w_in
+    return (lax.slice(dx_p, start, limit),)
+
+
+_max_pool_argmax.defvjp(_max_pool_argmax_fwd, _max_pool_argmax_bwd)
 
 
 def average_pooling_2d(x, ksize, stride=None, pad=0, layout="NCHW"):
@@ -322,7 +453,9 @@ def average_pooling_2d(x, ksize, stride=None, pad=0, layout="NCHW"):
     dims, strides, padding = _pool_geometry(
         kh, kw, sy, sx, ((ph, ph), (pw, pw)), layout)
     summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
-    # reference divides by the full window size (count_include_pad=True)
+    # reference divides by the full window size (count_include_pad=True);
+    # the scale stays in x.dtype (weak-typed), so a bf16 activation is
+    # read and written as bf16 — no f32 round-trip through HBM
     return summed / (kh * kw)
 
 
@@ -363,6 +496,9 @@ def unpooling_2d(x, ksize, stride=None, pad=0, outsize=None, cover_all=True):
 
 
 def global_average_pooling_2d(x, layout="NCHW"):
+    # one reduction in x.dtype: bf16 activations pool as bf16 (half the
+    # HBM read of an f32 upcast); heads needing f32 cast the RESULT
+    # (a [N, C] vector), as models/resnet.py does before its fc
     hd, wd, _ = _spatial_dims(layout)
     return x.mean(axis=(hd, wd))
 
@@ -375,11 +511,29 @@ def resize_images(x, output_shape):
 
 # -- normalization ---------------------------------------------------------
 
+def batch_moments(x, axis):
+    """Single-pass batch moments: mean and E[x²] accumulate side by side
+    over ONE read of ``x`` (fp32 accumulation regardless of activation
+    dtype), ``var = E[x²] − mean²`` clamped at 0 against fp32
+    cancellation.  The two-pass formulation this replaces (mean, then
+    mean of squared deviations) read the activation three times — for a
+    ResNet the BN-stat loop fusions were the largest non-conv HBM row in
+    the r5 trace.  The VJP is also one pass (d/dx of both sums is a
+    fused axpy), where the two-pass var backward re-read x.  Same
+    formulation as the multi-node sync BN, which pmeans the two
+    accumulators — so single- and multi-node BN now share their numerics.
+    """
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=axis)
+    sq_mean = jnp.mean(x32 * x32, axis=axis)
+    var = jnp.maximum(sq_mean - jnp.square(mean), 0.0)
+    return mean, var
+
+
 def batch_normalization(x, gamma, beta, eps=2e-5, axis=None):
     if axis is None:
         axis = (0,) + tuple(range(2, x.ndim))
-    mean = x.mean(axis=axis)
-    var = x.var(axis=axis)
+    mean, var = batch_moments(x, axis)
     return _apply_bn(x, gamma, beta, mean, var, eps, axis)
 
 

@@ -221,11 +221,24 @@ class BatchNormalization(Link):
         return gamma, beta
 
     def _moments(self, x, axis):
-        """Batch moments, accumulated in fp32 regardless of activation
-        dtype (bf16 inputs keep fp32 running statistics); overridden by
-        the multi-node subclass to psum."""
-        x = x.astype(jnp.float32)
-        return x.mean(axis=axis), x.var(axis=axis)
+        """Single-pass batch moments (``F.batch_moments``): mean and
+        E[x²] accumulate over ONE fp32-accumulated read of the
+        activation instead of the two-pass mean/var loop — the BN-stat
+        fusions were the largest non-conv HBM row in the r5 ResNet
+        trace.  The multi-node subclass overrides ``_sync_moments`` to
+        pmean the two accumulators across ranks before the variance is
+        formed."""
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(axis=axis)
+        sq_mean = jnp.mean(x32 * x32, axis=axis)
+        mean, sq_mean = self._sync_moments(mean, sq_mean, x)
+        return mean, jnp.maximum(sq_mean - jnp.square(mean), 0.0)
+
+    def _sync_moments(self, mean, sq_mean, x):
+        """Cross-rank moment hook (identity here; the multi-node sync BN
+        pmeans both accumulators over its communicator axis)."""
+        del x
+        return mean, sq_mean
 
     def _moment_count(self, x, axis):
         """Number of elements each moment reduces over (the multi-node

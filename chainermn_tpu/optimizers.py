@@ -158,18 +158,24 @@ class _MultiNodeOptimizer:
                     else self._make_step(lossfun, args, kwargs))
             self._mn_step_cache[key] = step
 
+        if self._double_buffering and self._stale_grads is None:
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            super().__setattr__("_stale_grads", zeros)
+        stale = (self._stale_grads,) if self._double_buffering else ()
+        operands = (params, pstate, opt_state, actual._hyper_values(),
+                    actual._next_rng_key(), stale, args, kwargs)
+        actual._stash_step_spec(step, operands)
+        try:
+            new_params, new_pstate, new_opt_state, loss, grads, obs = \
+                step(*operands)
+        except Exception as e:
+            from .core.optimizer import raise_if_donated_state_lost
+            raise_if_donated_state_lost(e, actual)
+            raise
         if self._double_buffering:
-            if self._stale_grads is None:
-                zeros = jax.tree.map(jnp.zeros_like, params)
-                super().__setattr__("_stale_grads", zeros)
-            new_params, new_pstate, new_opt_state, loss, grads, obs = step(
-                params, pstate, opt_state, actual._hyper_values(),
-                actual._next_rng_key(), (self._stale_grads,), args, kwargs)
+            # the donated stale buffer is rebound to this step's fresh
+            # mean gradient — through the wrapper, never a raw alias
             super().__setattr__("_stale_grads", grads)
-        else:
-            new_params, new_pstate, new_opt_state, loss, grads, obs = step(
-                params, pstate, opt_state, actual._hyper_values(),
-                actual._next_rng_key(), (), args, kwargs)
         actual._write_back(new_params, new_pstate, grads)
         actual._opt_state = new_opt_state
         actual.t += 1
@@ -290,7 +296,7 @@ class _MultiNodeOptimizer:
                       kwargs_specs),
             out_specs=(P(), P(), opt_specs, P(), P(), P()),
             check_vma=False)
-        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- compiled DP step ------------------------------------------------------
@@ -366,10 +372,16 @@ class _MultiNodeOptimizer:
                       kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        # donate opt_state; params too when the wrapped optimizer opts in
-        # via ``donate_params`` (see core/optimizer.py note: Link arrays
-        # may be user-aliased, so this is off by default)
-        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        # donate params + opt_state (and, under double buffering, the
+        # params-sized stale-grad buffer at argnum 5: it is replaced by
+        # this step's returned gradient, so XLA may update it in place).
+        # Safe by default through the Link bridge — see core/optimizer.py
+        # ``donate_params``; set it False on the wrapped optimizer to
+        # keep pre-update buffers alive.
+        if getattr(actual, "donate_params", True):
+            donate = (0, 2, 5) if double_buffering else (0, 2)
+        else:
+            donate = (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- multi-step fused dispatch ----------------------------------------------
@@ -441,9 +453,16 @@ class _MultiNodeOptimizer:
                     if self.zero_sharding
                     else self._make_scan_step(lossfun, args, kwargs, n_steps))
             self._mn_step_cache[key] = step
-        new_params, new_pstate, new_opt_state, losses, grads, obs = step(
-            params, pstate, opt_state, actual._hyper_values(),
-            actual._next_rng_key(), args, kwargs)
+        operands = (params, pstate, opt_state, actual._hyper_values(),
+                    actual._next_rng_key(), args, kwargs)
+        actual._stash_step_spec(step, operands)
+        try:
+            new_params, new_pstate, new_opt_state, losses, grads, obs = \
+                step(*operands)
+        except Exception as e:
+            from .core.optimizer import raise_if_donated_state_lost
+            raise_if_donated_state_lost(e, actual)
+            raise
         actual._write_back(new_params, new_pstate, grads)
         actual._opt_state = new_opt_state
         actual.t += n_steps
@@ -506,7 +525,7 @@ class _MultiNodeOptimizer:
             in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
             out_specs=(P(), P(), P(), P(), P(), P()),
             check_vma=False)
-        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
     def _make_zero_scan_step(self, lossfun, ex_args, ex_kwargs, n_steps):
@@ -559,7 +578,7 @@ class _MultiNodeOptimizer:
                       kwargs_specs),
             out_specs=(P(), P(), opt_specs, P(), P(), P()),
             check_vma=False)
-        donate = (0, 2) if getattr(actual, "donate_params", False) else (2,)
+        donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- misc reference API -----------------------------------------------------

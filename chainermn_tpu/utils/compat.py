@@ -11,8 +11,10 @@ in ``ops/flash_attention.py``.
 from __future__ import annotations
 
 import inspect
+import os
 
-__all__ = ["shard_map", "axis_env_contains"]
+__all__ = ["shard_map", "axis_env_contains", "persistent_cache_safe",
+           "configure_persistent_cache"]
 
 try:
     from jax import shard_map as _shard_map  # jax >= 0.6-era export
@@ -65,3 +67,75 @@ def axis_env_contains(name):
     if _axis_query is None:
         _axis_query = _resolve_axis_query()
     return _axis_query(name)
+
+
+# ---------------------------------------------------------------------------
+# XLA persistent compile cache — replay-segfault guard
+# ---------------------------------------------------------------------------
+
+def _platform_guess():
+    """Best backend guess WITHOUT initializing jax (asking the backend
+    would dial the wedge-prone TPU relay — the hazard bench.py exists
+    to avoid): 'axon' only where the axon TPU plugin is actually
+    installed (its sitecustomize home), else a plain CPU host."""
+    return "axon" if os.path.exists("/root/.axon_site") else "cpu"
+
+
+def persistent_cache_safe(platform, scan_program=False,
+                          donated_program=False):
+    """Is the XLA persistent compile cache safe for this (backend,
+    program-kind) pair?
+
+    Known defect on jax 0.4.37's CPU backend: a persisted executable
+    for a scan-over-train-steps program (``update_scan`` /
+    ``BENCH_SCAN`` — BENCH_NOTES r5 tail, run1 RC=0 / run2 RC=139) or
+    for a step program with DONATED parameter buffers
+    (``donate_argnums`` covering params; isolated during round 6's
+    donation work — replay aborts/segfaults identically, and the
+    donate-off program replays clean, reproduced at the pre-PR base
+    commit too) compiles and runs clean on a COLD cache, then CRASHES
+    when the next process replays the cached entry.  Undonated per-step
+    programs (opt-state-only aliasing included) replay fine, and the
+    TPU relay backend has not shown the defect (a warm cache is itself
+    a relay-safety feature there — long compiles are what wedge it), so
+    the skip stays scoped to the CONFIRMED-broken pairs.  A falsy
+    ``platform`` is resolved via :func:`_platform_guess`: the axon box
+    defaults to its TPU relay, any OTHER host defaults to CPU — where
+    the replay crash is live.  Correctness first: the cache is an
+    optimization.
+    """
+    plat = (platform or _platform_guess()).lower()
+    return not ((scan_program or donated_program) and "cpu" in plat)
+
+
+def configure_persistent_cache(jax_module, cache_dir=None, platform=None,
+                               scan_program=False, donated_program=False):
+    """Enable the persistent XLA compile cache when it is safe to.
+
+    ``platform``: the backend the caller has pinned (None/"" = platform
+    left to the runtime — the TPU relay on the bench box);
+    ``scan_program`` / ``donated_program``: whether the process will
+    compile scan-over-step programs / params-donated step programs (the
+    two kinds whose persisted executables crash on CPU replay — see
+    :func:`persistent_cache_safe`).  Scan programs that DO get a cache
+    use a ``.scan``-keyed sibling directory, so a future backend showing
+    the replay defect poisons only the scan slice (``rm -rf
+    <dir>.scan`` heals it without recompiling every per-step program).
+    Returns True when persistence was enabled.  One shared gate for
+    ``bench.py`` and ``tools/probe_perf.py`` so the two cannot drift
+    (the regression tests drive it through real warm-cache double
+    runs).
+    """
+    if not persistent_cache_safe(platform, scan_program, donated_program):
+        return False
+    cache_dir = cache_dir or os.environ.get(
+        "CHAINERMN_TPU_XLA_CACHE_DIR", "/tmp/chainermn_tpu_jax_cache")
+    if scan_program:
+        cache_dir = cache_dir + ".scan"
+    try:
+        jax_module.config.update("jax_compilation_cache_dir", cache_dir)
+        jax_module.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        return False
+    return True

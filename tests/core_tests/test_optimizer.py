@@ -118,8 +118,12 @@ def test_optimizer_serialize_roundtrip(tmp_path):
                              [(n, q.array) for n, q in m2.namedparams()]]})
     load_npz(path, opt2)
     assert opt2.t == 3
-    # momentum buffer restored: next update matches
-    m2.w.array = m.w.array
+    # momentum buffer restored: next update matches.  copyparams (copy
+    # by VALUE) rather than aliasing m's array object: updates donate
+    # their param buffers by default, so a raw alias shared across
+    # models would be consumed by m's next update (the donation
+    # contract — see core/optimizer.py donate_params).
+    m2.copyparams(m)
     opt.update(m)
     opt2.update(m2)
     np.testing.assert_allclose(np.asarray(m2.w.array), np.asarray(m.w.array),

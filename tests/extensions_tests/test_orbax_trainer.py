@@ -1,0 +1,96 @@
+"""Orbax trainer-extension checkpointer: trigger-driven snapshots,
+generation GC, consensus resume (VERDICT r5 Missing #3 — the npz
+checkpointer's contract at SURVEY §5 "orbax-style" scale)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+import chainermn_tpu as ct
+from chainermn_tpu import F, L
+from chainermn_tpu.core.optimizer import SGD
+from chainermn_tpu.dataset import SerialIterator, get_mnist
+from chainermn_tpu.extensions import create_multi_node_orbax_checkpointer
+from chainermn_tpu.training import StandardUpdater, Trainer
+
+
+class MLP(ct.Chain):
+    def __init__(self):
+        super().__init__()
+        with self.init_scope():
+            self.l1 = L.Linear(784, 16, seed=7)
+            self.l2 = L.Linear(16, 10, seed=8)
+
+    def forward(self, x, t):
+        h = self.l2(F.relu(self.l1(x)))
+        return F.softmax_cross_entropy(h, t)
+
+
+def _make_trainer(out, epochs):
+    model = MLP()
+    comm = ct.create_communicator("jax_ici")
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.05), comm).setup(model)
+    opt.seed = 11  # deterministic per-step rng stream for exact resume
+    train, _ = get_mnist(n_train=256, n_test=8)
+    train = ct.scatter_dataset(train, comm, shuffle=True, seed=0)
+    it = SerialIterator(train, 8 * comm.size, shuffle=False)
+    updater = StandardUpdater(it, opt)
+    return model, comm, Trainer(updater, (epochs, "epoch"), out=out)
+
+
+def test_orbax_save_and_consensus_resume_continues_exactly(tmp_path):
+    ckpt_dir = str(tmp_path / "orbax")
+    # golden: 4 uninterrupted epochs
+    golden, _, trainer_g = _make_trainer(str(tmp_path / "g"), 4)
+    trainer_g.run()
+    w_golden = np.asarray(golden.l1.W.array)
+
+    # crashed run: 2 epochs, snapshotting every epoch
+    model1, comm1, trainer1 = _make_trainer(str(tmp_path / "r1"), 2)
+    cp1 = create_multi_node_orbax_checkpointer(comm1, ckpt_dir)
+    trainer1.extend(cp1, trigger=(1, "epoch"))
+    trainer1.run()
+    assert cp1.stats["snapshots"] == 2
+    saved_iteration = trainer1.updater.iteration
+
+    # relaunch: consensus resume restores the newest common generation,
+    # then training continues to the SAME state as the uninterrupted run
+    model2, comm2, trainer2 = _make_trainer(str(tmp_path / "r2"), 4)
+    cp2 = create_multi_node_orbax_checkpointer(comm2, ckpt_dir)
+    resumed = cp2.maybe_load(trainer2)
+    assert resumed == saved_iteration
+    assert trainer2.updater.iteration == saved_iteration
+    np.testing.assert_array_equal(np.asarray(model2.l1.W.array),
+                                  np.asarray(model1.l1.W.array))
+    trainer2.extend(cp2, trigger=(1, "epoch"))
+    trainer2.run()
+    np.testing.assert_allclose(np.asarray(model2.l1.W.array), w_golden,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_orbax_maybe_load_empty_dir_returns_none(tmp_path):
+    model, comm, trainer = _make_trainer(str(tmp_path / "r"), 1)
+    cp = create_multi_node_orbax_checkpointer(comm, str(tmp_path / "none"))
+    assert cp.maybe_load(trainer) is None
+
+
+def test_orbax_gc_keeps_cp_interval_and_pins_protected(tmp_path):
+    ckpt_dir = str(tmp_path / "orbax")
+    model, comm, trainer = _make_trainer(str(tmp_path / "r"), 1)
+    cp = create_multi_node_orbax_checkpointer(comm, ckpt_dir, cp_interval=2)
+    for it in (1, 2, 3, 4, 5):
+        cp.save(trainer, it)
+    assert sorted(cp._ckpt.all_steps()) == [4, 5]
+    assert cp.stats["gc"] == 3
+
+    # a consensus resume pins its generation against later sweeps
+    model2, comm2, trainer2 = _make_trainer(str(tmp_path / "r2"), 1)
+    cp2 = create_multi_node_orbax_checkpointer(comm2, ckpt_dir,
+                                               cp_interval=2)
+    assert cp2.maybe_load(trainer2) == 5
+    for it in (6, 7, 8):
+        cp2.save(trainer2, it)
+    steps = sorted(cp2._ckpt.all_steps())
+    assert 5 in steps, "the resumed generation must never be swept"
+    assert steps[-2:] == [7, 8]

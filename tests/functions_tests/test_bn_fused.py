@@ -1,0 +1,115 @@
+"""Single-pass BN statistics: output equivalence against the two-pass
+mean/var formulation they replace, plus the bf16 traffic discipline of
+the pooling ops (ISSUE 3 tentpole: one read for stats, one read + one
+write for normalize, f32 confined to the per-channel vectors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import chainermn_tpu as ct
+from chainermn_tpu import L
+from chainermn_tpu.nn import functions as F
+
+
+def _two_pass_reference(x, gamma, beta, eps, axis):
+    x32 = np.asarray(x, np.float32)
+    mean = x32.mean(axis=axis)
+    var = x32.var(axis=axis)
+    return np.asarray(
+        F._apply_bn(jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta),
+                    jnp.asarray(mean), jnp.asarray(var), eps, axis))
+
+
+def test_batch_moments_single_pass_matches_two_pass():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(2, 3, (16, 8, 5, 5)).astype(np.float32))
+    mean, var = F.batch_moments(x, (0, 2, 3))
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(x).mean((0, 2, 3)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(var),
+                               np.asarray(x).var((0, 2, 3)),
+                               rtol=1e-4, atol=1e-5)
+    assert mean.dtype == jnp.float32 and var.dtype == jnp.float32
+
+
+def test_batch_moments_variance_never_negative():
+    # fp32 cancellation territory: large mean, tiny variance
+    x = jnp.full((64, 4), 1e4, jnp.float32)
+    _, var = F.batch_moments(x, (0,))
+    assert np.all(np.asarray(var) >= 0.0)
+
+
+def test_batch_normalization_matches_two_pass_reference():
+    rng = np.random.RandomState(1)
+    for shape, axis in [((32, 6), (0,)), ((8, 6, 7, 7), (0, 2, 3))]:
+        x = jnp.asarray(rng.normal(1, 2, shape).astype(np.float32))
+        gamma = jnp.asarray(rng.uniform(0.5, 2, shape[1]).astype(np.float32))
+        beta = jnp.asarray(rng.normal(0, 1, shape[1]).astype(np.float32))
+        y = F.batch_normalization(x, gamma, beta, axis=axis)
+        ref = _two_pass_reference(x, gamma, beta, 2e-5, axis)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_bn_link_forward_and_ema_match_two_pass():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.normal(0, 2, (16, 3, 4, 4)).astype(np.float32))
+    bn = L.BatchNormalization(3, decay=0.8)
+    y = bn(x)
+    ref = _two_pass_reference(x, np.ones(3, np.float32),
+                              np.zeros(3, np.float32), 2e-5, (0, 2, 3))
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-4, atol=2e-5)
+    m = 16 * 4 * 4
+    expected_var = 0.8 * 1.0 + 0.2 * np.asarray(x).var((0, 2, 3)) * m / (m - 1)
+    np.testing.assert_allclose(np.asarray(bn.avg_var), expected_var,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(bn.avg_mean),
+                               0.2 * np.asarray(x).mean((0, 2, 3)),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_bn_bf16_keeps_activation_dtype_and_f32_stats():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.normal(0, 1, (8, 4, 6, 6)).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    bn = L.BatchNormalization(4)
+    y = bn(x)
+    assert y.dtype == jnp.bfloat16
+    assert bn.avg_mean.dtype == jnp.float32
+    assert bn.avg_var.dtype == jnp.float32
+    ref = _two_pass_reference(np.asarray(x, np.float32),
+                              np.ones(4, np.float32),
+                              np.zeros(4, np.float32), 2e-5, (0, 2, 3))
+    np.testing.assert_allclose(np.asarray(y, np.float32), ref,
+                               rtol=2e-2, atol=2e-2)  # bf16 output rounding
+
+
+def test_bn_gradients_match_two_pass_formulation():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.normal(1, 2, (12, 5)).astype(np.float32))
+    gamma = jnp.asarray(rng.uniform(0.5, 2, 5).astype(np.float32))
+    beta = jnp.zeros(5, jnp.float32)
+
+    def loss_single(a):
+        return jnp.sum(F.batch_normalization(a, gamma, beta, axis=(0,)) ** 3)
+
+    def loss_two_pass(a):
+        a32 = a.astype(jnp.float32)
+        mean = a32.mean(axis=0)
+        var = a32.var(axis=0)
+        return jnp.sum(F._apply_bn(a, gamma, beta, mean, var, 2e-5,
+                                   (0,)) ** 3)
+
+    g1 = jax.grad(loss_single)(x)
+    g2 = jax.grad(loss_two_pass)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_pooling_bf16_stays_bf16():
+    x = jnp.ones((2, 3, 8, 8), jnp.bfloat16)
+    assert F.average_pooling_2d(x, 2).dtype == jnp.bfloat16
+    assert F.global_average_pooling_2d(x).dtype == jnp.bfloat16
+    xh = jnp.ones((2, 8, 8, 3), jnp.bfloat16)
+    assert F.global_average_pooling_2d(xh, layout="NHWC").dtype \
+        == jnp.bfloat16

@@ -1,0 +1,126 @@
+"""Traffic-lean max-pooling VJP: output equivalence against the XLA
+``reduce_window``/``select-and-scatter`` lowering it replaces.
+
+The argmax path stores each window's argmax in the forward (one uint8
+plane) and scatters the cotangent through it in one fused pad-and-sum
+pass; the XLA backward re-compares the whole input against the output
+(``select-and-scatter`` — the 0.75 ms/step HBM-bound row in the r5
+ResNet trace).  These tests pin the two lowerings equal — values AND
+gradients — across layouts, geometries, cover_all, and dtypes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu.nn.functions as F
+
+GEOMETRIES = [
+    # (h, w, ksize, stride, pad, cover_all)
+    (7, 7, 3, 2, 1, False),     # the ResNet stem shape family
+    (8, 10, 3, 2, 1, True),     # cover_all extra padding, non-square
+    (6, 6, 2, 2, 0, True),
+    (9, 9, 3, 3, 1, True),
+    (5, 5, 3, 1, 1, False),     # stride 1 (fully overlapping windows)
+    (14, 14, 2, 2, 0, False),
+    (6, 6, (3, 2), (2, 1), (1, 0), True),  # asymmetric window/stride/pad
+]
+
+
+def _xla_reference(x, k, s, p, ca, layout, monkeypatch):
+    monkeypatch.setattr(F, "_MAXPOOL_VJP", "xla")
+    try:
+        y = F.max_pooling_2d(x, k, s, p, ca, layout)
+        g = jax.grad(lambda a: jnp.sum(
+            F.max_pooling_2d(a, k, s, p, ca, layout) ** 2))(x)
+    finally:
+        monkeypatch.setattr(F, "_MAXPOOL_VJP", "argmax")
+    return y, g
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("geom", GEOMETRIES)
+def test_argmax_vjp_matches_xla_lowering(layout, geom, monkeypatch):
+    h, w, k, s, p, ca = geom
+    rng = np.random.RandomState(hash((layout, str(geom))) % (2 ** 31))
+    shape = (2, 3, h, w) if layout == "NCHW" else (2, h, w, 3)
+    x = jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
+    y_ref, g_ref = _xla_reference(x, k, s, p, ca, layout, monkeypatch)
+    assert F._MAXPOOL_VJP == "argmax"
+    y = F.max_pooling_2d(x, k, s, p, ca, layout)
+    g = jax.grad(lambda a: jnp.sum(
+        F.max_pooling_2d(a, k, s, p, ca, layout) ** 2))(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_ref))
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+
+
+def test_bf16_values_and_grads_match(monkeypatch):
+    # TIE-FREE bf16 data: 512 distinct bf16 values (bf16's 8-bit
+    # mantissa makes random draws collide within windows, and on exact
+    # ties the two lowerings intentionally diverge — argmax routes to
+    # the first max, XLA's packed select-and-gather picks by tangent
+    # bit pattern; see _max_pool_argmax)
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([np.linspace(lo, 2 * lo, 128, endpoint=False)
+                           for lo in (1.0, 2.0, 4.0, 8.0)])
+    rng.shuffle(vals)
+    x = jnp.asarray(vals.astype(np.float32).reshape(2, 8, 8, 4)
+                    ).astype(jnp.bfloat16)
+    assert len(set(np.asarray(x, np.float32).ravel())) == 512
+
+    def loss(a):
+        return jnp.sum(F.max_pooling_2d(
+            a, 3, 2, 1, False, "NHWC").astype(jnp.float32))
+
+    monkeypatch.setattr(F, "_MAXPOOL_VJP", "xla")
+    y_ref = F.max_pooling_2d(x, 3, 2, 1, False, "NHWC")
+    g_ref = jax.grad(loss)(x)
+    monkeypatch.setattr(F, "_MAXPOOL_VJP", "argmax")
+    y = F.max_pooling_2d(x, 3, 2, 1, False, "NHWC")
+    g = jax.grad(loss)(x)
+    assert y.dtype == jnp.bfloat16 and g.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                  np.asarray(y_ref, np.float32))
+    np.testing.assert_array_equal(np.asarray(g, np.float32),
+                                  np.asarray(g_ref, np.float32))
+
+
+def test_tie_routes_gradient_to_first_max_like_argmax():
+    # constant window: both lowerings send the whole cotangent to the
+    # FIRST element in window order
+    x = jnp.ones((1, 1, 4, 4), jnp.float32)
+    g = jax.grad(lambda a: jnp.sum(F.max_pooling_2d(a, 2, 2, 0)))(x)
+    expected = np.zeros((1, 1, 4, 4), np.float32)
+    expected[0, 0, ::2, ::2] = 1.0
+    np.testing.assert_array_equal(np.asarray(g), expected)
+
+
+def test_integer_inputs_keep_reduce_window_path():
+    xi = jnp.arange(36, dtype=jnp.int32).reshape(1, 1, 6, 6)
+    y = F.max_pooling_2d(xi, 2, 2, 0)
+    assert y.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(y)[0, 0, 0],
+                                  np.asarray([7, 9, 11]))
+
+
+def test_no_select_and_scatter_in_argmax_backward():
+    x = jnp.ones((2, 8, 8, 4), jnp.bfloat16)
+    grad_fn = jax.grad(lambda a: jnp.sum(F.max_pooling_2d(
+        a, 3, 2, 1, False, "NHWC").astype(jnp.float32)))
+    text = jax.jit(grad_fn).lower(x).as_text()
+    assert "select_and_scatter" not in text
+    # and the stored residual is the uint8 argmax plane
+    assert "ui8" in text
+
+
+def test_jit_and_second_application_consistent():
+    # under jit, and reused at a second shape (fresh trace) — the
+    # custom_vjp's static-argument plumbing must not leak shapes
+    f = jax.jit(lambda a: F.max_pooling_2d(a, 3, 2, 1, False, "NHWC"))
+    a = jnp.asarray(np.random.RandomState(0).normal(
+        0, 1, (1, 12, 12, 2)).astype(np.float32))
+    b = jnp.asarray(np.random.RandomState(1).normal(
+        0, 1, (2, 20, 20, 3)).astype(np.float32))
+    ya, yb = f(a), f(b)
+    assert ya.shape == (1, 6, 6, 2) and yb.shape == (2, 10, 10, 3)

@@ -1,0 +1,161 @@
+"""XLA persistent-cache replay-segfault guard (ISSUE 3 satellite;
+BENCH_NOTES r5 tail): on jax 0.4.37's CPU backend, a persisted
+scan-over-train-steps executable compiles and runs clean on a COLD
+cache, then SEGFAULTS when the next process replays the cached entry.
+The guard (utils.compat.configure_persistent_cache) skips persistence
+for exactly that (backend, program-kind) pair; elsewhere scan programs
+get a ``.scan``-keyed sibling cache directory."""
+
+import os
+import subprocess
+import sys
+
+from chainermn_tpu.utils import compat
+from chainermn_tpu.utils.compat import (configure_persistent_cache,
+                                        persistent_cache_safe)
+
+
+def test_safe_matrix(monkeypatch):
+    # the CONFIRMED-broken pairs: cpu backend + scan program, and cpu
+    # backend + params-donated step program
+    assert not persistent_cache_safe("cpu", scan_program=True)
+    assert not persistent_cache_safe("cpu", donated_program=True)
+    assert not persistent_cache_safe("cpu", scan_program=True,
+                                     donated_program=True)
+    # undonated per-step programs replay fine on cpu
+    assert persistent_cache_safe("cpu")
+    assert persistent_cache_safe("tpu", scan_program=True)
+    assert persistent_cache_safe("tpu", donated_program=True)
+    assert persistent_cache_safe("tpu")
+    # unset platform resolves through the host guess: the axon bench box
+    # defaults to its TPU relay (cache stays on — it is relay
+    # protection), any other host defaults to CPU, where the replay
+    # crash is live
+    monkeypatch.setattr(compat, "_platform_guess", lambda: "axon")
+    assert persistent_cache_safe(None, scan_program=True)
+    assert persistent_cache_safe("", donated_program=True)
+    monkeypatch.setattr(compat, "_platform_guess", lambda: "cpu")
+    assert not persistent_cache_safe(None, scan_program=True)
+    assert not persistent_cache_safe(None, donated_program=True)
+    assert persistent_cache_safe(None)
+
+
+class _FakeJax:
+    def __init__(self):
+        self.updates = {}
+        self.config = self
+
+    def update(self, key, value):
+        self.updates[key] = value
+
+
+def test_configure_skips_cpu_scan_and_keys_scan_dir(tmp_path, monkeypatch):
+    fake = _FakeJax()
+    assert configure_persistent_cache(fake, cache_dir=str(tmp_path / "c"),
+                                      platform="cpu",
+                                      scan_program=True) is False
+    assert fake.updates == {}
+    # per-step cpu: enabled, plain dir
+    assert configure_persistent_cache(fake, cache_dir=str(tmp_path / "c"),
+                                      platform="cpu", scan_program=False)
+    assert fake.updates["jax_compilation_cache_dir"] == str(tmp_path / "c")
+    # scan on the TPU box (unset platform resolves to axon there):
+    # enabled under the .scan-keyed sibling dir
+    monkeypatch.setattr(compat, "_platform_guess", lambda: "axon")
+    fake2 = _FakeJax()
+    assert configure_persistent_cache(fake2, cache_dir=str(tmp_path / "c"),
+                                      platform=None, scan_program=True)
+    assert fake2.updates["jax_compilation_cache_dir"] \
+        == str(tmp_path / "c") + ".scan"
+
+
+_PROGRAM_TEMPLATE = r"""
+import sys
+import jax
+from chainermn_tpu.utils.compat import configure_persistent_cache
+enabled = configure_persistent_cache(jax, platform="cpu",
+                                     scan_program={scan},
+                                     donated_program={donated})
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import chainermn_tpu as ct
+from chainermn_tpu.core.optimizer import SGD
+
+
+class Quad(ct.Chain):
+    def __init__(self):
+        super().__init__()
+        with self.init_scope():
+            self.w = ct.Parameter(np.full(3, 5.0, np.float32))
+
+    def forward(self, x):
+        return jnp.sum((self.w.array - 3.0) ** 2) + 0.0 * jnp.sum(x)
+
+
+m = Quad()
+comm = ct.create_communicator("jax_ici")
+inner = SGD(lr=0.1)
+inner.donate_params = {donated}
+opt = ct.create_multi_node_optimizer(inner, comm).setup(m)
+if {scan}:
+    xs = jnp.zeros((2, comm.size, 1))
+    losses = opt.update_scan(m, xs)
+    assert losses.shape == (2,)
+else:
+    opt.update(m, jnp.zeros((comm.size, 1)))
+print("PROGRAM_OK", enabled)
+"""
+
+
+def _double_run(tmp_path, scan, donated):
+    """Run the same program in two processes against one cache dir;
+    assert both exit clean and report whether persistence was enabled."""
+    cache_dir = str(tmp_path / "xla_cache")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               CHAINERMN_TPU_XLA_CACHE_DIR=cache_dir,
+               PYTHONPATH=root + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("JAX_PLATFORMS", None)
+    program = _PROGRAM_TEMPLATE.format(scan=scan, donated=donated)
+    enabled = None
+    for attempt in (1, 2):
+        proc = subprocess.run([sys.executable, "-c", program],
+                              env=env, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == 0, (
+            f"run {attempt} (scan={scan} donated={donated}) "
+            f"rc={proc.returncode} (139/134 = the warm-cache replay "
+            f"crash the guard exists for)\n{proc.stderr[-2000:]}")
+        enabled = "PROGRAM_OK True" in proc.stdout
+    return cache_dir, enabled
+
+
+def test_scan_program_runs_twice_against_warm_cache(tmp_path):
+    """The r5 repro shape: the SAME scan program, two processes, one
+    cache directory (pre-guard: run1 RC=0, run2 RC=139)."""
+    cache_dir, enabled = _double_run(tmp_path, scan=True, donated=False)
+    assert enabled is False
+    # the guard refused persistence: nothing was cached to replay
+    assert not os.path.exists(cache_dir) or not os.listdir(cache_dir)
+
+
+def test_donated_program_runs_twice_against_warm_cache(tmp_path):
+    """The round-6 repro shape: a params-DONATED per-step program's
+    persisted executable crashes on CPU replay exactly like the scan
+    one (reproduced at the pre-PR base commit too) — and donation is
+    now the default, so this pair is what every cpu bench run hits."""
+    cache_dir, enabled = _double_run(tmp_path, scan=False, donated=True)
+    assert enabled is False
+    assert not os.path.exists(cache_dir) or not os.listdir(cache_dir)
+
+
+def test_undonated_per_step_program_may_persist(tmp_path):
+    """The SAFE pair (cpu, per-step, no params donation) keeps its
+    persistent cache enabled and both runs stay clean.  (The tiny test
+    program compiles under the 1 s persistence threshold, so the dir
+    may legitimately stay empty — the contract under test is the
+    guard's decision plus a clean double run, not the write.)"""
+    _, enabled = _double_run(tmp_path, scan=False, donated=False)
+    assert enabled is True

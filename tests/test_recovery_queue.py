@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}"'"}'
     ;;
   *probe_perf.py*)
     echo "flashcmp header text"
@@ -69,17 +69,19 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 9 bench steps recorded, each once, in queue order
+    # all 11 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn",     # prewarm (default knobs)
-        "resnet50-bsd-d-scand-seqd-ip0-rpn",     # flagship default
-        "resnet50-bs256-d-scand-seqd-ip0-rpn",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn",
-        "resnet50-bsd-d-scand-seqd-ip1-rpn",     # real input pipeline
-        "transformer-bsd-d-scand-seqd-ip0-rpn",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn",   # full remat
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots",  # dots policy
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # prewarm (default knobs)
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # flagship default
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0",   # donation A/B leg
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1",  # headroom probe
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1",   # real input pipeline
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1",    # full remat
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1",  # dots policy
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
@@ -117,7 +119,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the nine bench rows must
+    the queue must still complete (|| true), the eleven bench rows must
     already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
@@ -141,5 +143,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 9
+                if '"final"' in ln]) == 11
     assert "Flash-vs-XLA" not in notes_text

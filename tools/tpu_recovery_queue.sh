@@ -55,6 +55,17 @@ run_one "resnet bs256 NCHW (layout comparison)" \
   BENCH_BS=256 BENCH_LAYOUT=NCHW BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 run_one "resnet bs256 NHWC scan8 (fused dispatch)" \
   BENCH_BS=256 BENCH_SCAN=8 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+# A/B leg for end-to-end buffer donation (ISSUE 3): delta vs the bs64
+# flagship row = the on-chip img/s payoff of params+opt-state donation.
+# BENCH_DONATE=0 is fingerprint-excluded from the last-good cache.
+run_one "resnet bs64 NHWC donate-off (A/B: donation payoff)" \
+  BENCH_DONATE=0 BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+# donation headroom probe: does the freed params-sized allocation let
+# bs512 fit?  (r5: MFU still rising at bs256; OOM backoff steps down
+# 512->256->128 and reports per_chip_batch, so the row is safe either
+# way)
+run_one "resnet bs512 NHWC (donation headroom probe)" \
+  BENCH_BS=512 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 # delta vs the bs64 flagship row = exposed host input cost on chip
 # (uint8 C++ gather -> async device placement -> in-graph cast)
 run_one "resnet bs64 real input pipeline (uint8 native gather)" \
