@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes audit dryrun examples clean
+.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -79,6 +79,15 @@ probe-bytes:      ## flagship HBM byte bill vs committed budget (no chip)
 
 bench-input:      ## GIL-bound transform: MultiprocessIterator vs MultithreadIterator (no chip, no jax)
 	$(PY) tools/bench_input.py
+
+sweep-flash:      ## on-chip flash fwd/bwd/fwd+bwd tile sweep; regenerates tools/flash_budgets.json
+	@# the r5 BENCH_NOTES sweep methodology as one command.  On a
+	@# chip-less box this interpret-smokes clamped T and REFUSES the
+	@# budget rewrite (budgets are measured artifacts).
+	$(PY) tools/flash_sweep.py --write-budgets
+
+probe-flash:      ## committed flash budgets joined with live fused-vs-split rows (cpu = smoke)
+	PROBE=flash PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
 audit:            ## StableHLO dtype census, resnet + transformer (no chip)
 	PROBE=precision_audit $(PY) tools/probe_perf.py

@@ -81,6 +81,20 @@ class CommunicatorBase:
         raise NotImplementedError
 
     def gather(self, data, root=0):
+        """Gather one value per rank; EVERY rank receives the result.
+
+        Root-symmetric return — a deliberate semantics shift from the
+        reference (MPI ``gather`` returns the gathered list on ``root``
+        and ``None`` elsewhere): in single-controller SPMD there is no
+        per-process asymmetry to express — the one controlling process
+        plays every rank, and in-step (traced) mode the lowering is
+        ``lax.all_gather`` either way.  ``root`` is accepted for
+        signature compatibility and ignored by the return convention;
+        reference code guarding on ``if comm.rank == root:`` before
+        using the result keeps working unchanged, code relying on the
+        ``None`` on non-root ranks must drop that branch (see
+        docs/migration.md).
+        """
         raise NotImplementedError
 
     def allgather(self, x):
@@ -106,6 +120,10 @@ class CommunicatorBase:
         raise NotImplementedError
 
     def gather_obj(self, obj, root=0):
+        """Gather one picklable object per rank; EVERY rank receives the
+        gathered list (root-symmetric, same convention and rationale as
+        :meth:`gather` — the reference returned ``None`` on non-root
+        ranks)."""
         raise NotImplementedError
 
     def allgather_obj(self, obj):

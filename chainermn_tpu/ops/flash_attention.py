@@ -11,6 +11,17 @@ tiles, softmax runs online (running max/normalizer), and the MXU sees one
 jnp reference elsewhere (CPU tests run the kernel in interpreter mode to
 pin kernel↔reference equivalence).
 
+The backward is a FUSED one-pass kernel by default
+(:func:`_flash_bwd_fused_kernel`): each (qi, ki) attention tile is
+recomputed once — s = q·kᵀ, mask, p = exp(s − lse) — and feeds all
+three gradients (dk/dv accumulate in VMEM across the query loop, dq
+leaves as per-key-block partial planes reduced by one XLA sum).  The
+legacy two-kernel lowering (one dq pass + one dkv pass, each
+recomputing the tile) stays available bit-for-bit behind
+``CHAINERMN_TPU_FLASH_BWD=split``.  Backward tiles are tuned
+independently of the forward's (``CHAINERMN_TPU_FLASH_BWD_BLOCK_Q/K``,
+sweep-driven per-T table — `make sweep-flash`).
+
 Ring-attention composition: ``parallel.ring_attention`` rotates KV blocks
 between chips; within a chip this kernel computes each block's
 contribution — ICI transfers at the outer level, VMEM tiling at the
@@ -225,6 +236,89 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                            dq_part_ref, dk_ref, dv_ref, *, block_q,
+                            causal, scale):
+    """Fused backward: ONE pass over the (qi, ki) tiles per key block.
+
+    The split lowering (`_flash_bwd_dq_kernel` + `_flash_bwd_dkv_kernel`)
+    recomputes the attention block twice: each kernel re-runs the
+    s = q·kᵀ dot, the mask, exp(s − lse) and the g·vᵀ dot for every tile
+    it touches.  Here each (qi, ki) tile is recomputed ONCE and all three
+    gradient contributions leave together:
+
+        dv  += pᵀ g                      (accumulated in VMEM over qi)
+        dk  += dsᵀ q                     (accumulated in VMEM over qi)
+        dq_part[qi] = ds·k               (per-key-block partial plane)
+
+    dq cannot be accumulated in-place across key blocks — the grid is
+    parallel over ki and Mosaic offers no cross-program accumulation —
+    so each program writes its [Tq, D] dq contribution to its own slot
+    of a [n_kblocks, Tq, D] partial array; the caller reduces it with
+    one XLA sum (the splash-attention fused-backward shape; the reduce
+    is HBM-bound but a rounding error next to the recomputed dots it
+    replaces).  Per tile pair the split lowering runs 8 MXU dots + 2
+    exp's; this runs 5 dots + 1 exp — the recompute-once argument in
+    docs/performance.md quantifies it.
+    """
+    bk, d = k_ref.shape
+    tq = q_ref.shape[0]
+    ki = pl.program_id(1)
+    k = k_ref[:]          # storage dtype into the dots (see fwd kernel)
+    v = v_ref[:]
+    n_qblocks = tq // block_q
+    k_pos = (ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    # causally-skipped query tiles must still leave a defined partial:
+    # zero the whole plane once, the live tiles overwrite below
+    dq_part_ref[:] = jnp.zeros((tq, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.ds(qi * block_q, block_q), :]
+        g_blk = g_ref[pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[pl.ds(qi * block_q, block_q), :] \
+            .reshape(block_q, 1)
+        delta = delta_ref[pl.ds(qi * block_q, block_q), :] \
+            .reshape(block_q, 1)
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = (qi * block_q
+                     + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse), 0.0)  # ONCE
+        dv = dv + jax.lax.dot_general(
+            p.astype(g_blk.dtype), g_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        gv = jax.lax.dot_general(g_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (gv - delta)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dq contribution of this (qi, ki) tile; scale is applied after
+        # the cross-block sum (mirrors the split dq kernel's `dq * scale`
+        # after its fori accumulation)
+        dq_part_ref[pl.ds(qi * block_q, block_q), :] = \
+            jax.lax.dot_general(ds.astype(k.dtype), k,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # query blocks at or after this key block participate
+        first = (ki * bk) // block_q
+    else:
+        first = 0
+    dk, dv = jax.lax.fori_loop(first, n_qblocks, body, (dk, dv))
+    # ds was computed from UNSCALED q·k products with scale folded into s,
+    # so dk = scale · Σ ds·q (the fwd scale that s carries)
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
                   q_offset_blocks):
     """One (batch*head, q-block) program: stream K/V blocks through VMEM
@@ -325,6 +419,79 @@ def _flash_blocks(block_q=None, block_k=None, tq=None, tk=None):
     return tuple(out)
 
 
+# -- backward lowering selection ---------------------------------------------
+
+#: CHAINERMN_TPU_FLASH_BWD: "fused" (default) = the one-pass dq/dkv
+#: kernel; "split" = the legacy two-kernel lowering (dq pass + dkv pass,
+#: each recomputing the attention block) — the escape hatch, kept
+#: exactly like nn.functions' CHAINERMN_TPU_MAXPOOL_VJP=xla: read once
+#: at import, monkeypatchable in tests, and the legacy kernels are
+#: untouched so `split` restores the old lowering bit-for-bit.
+_FLASH_BWD = os.environ.get("CHAINERMN_TPU_FLASH_BWD", "fused")
+
+#: Backward-specific tile table, keyed by sequence length — the bwd
+#: kernels have a different VMEM/recompute balance than the forward
+#: (whole-T q/g staging + an f32 [Tq, D] partial plane vs the forward's
+#: K/V streaming), so their best tiles need not match.  Regenerate with
+#: `make sweep-flash` (tools/flash_sweep.py sweeps fwd/bwd/fwd+bwd per
+#: (block_q, block_k) and rewrites tools/flash_budgets.json; paste the
+#: winners here).  Committed values are the best KNOWN config — the r5
+#: on-chip sweep's 1024-tile winner for the split backward (BENCH_NOTES
+#: r5: 128-tiles 11.2 → 1024-tiles 31.8 TFLOP/s at T=8192); the fused
+#: kernel's own sweep refines them on the next chip session.
+_BWD_BLOCK_TABLE = {
+    1024: (1024, 1024),
+    2048: (1024, 1024),
+    8192: (1024, 1024),
+    16384: (1024, 1024),
+}
+
+
+def _flash_bwd_mode():
+    mode = _FLASH_BWD
+    if mode not in ("fused", "split"):
+        raise ValueError(
+            f"CHAINERMN_TPU_FLASH_BWD={mode!r} invalid (fused|split)")
+    return mode
+
+
+def _flash_bwd_blocks(block_q=None, block_k=None, tq=None, tk=None):
+    """Backward tile resolution: explicit arguments win, else the
+    CHAINERMN_TPU_FLASH_BWD_BLOCK_Q/K env knobs, else the sweep-driven
+    per-T table (:data:`_BWD_BLOCK_TABLE`), else the forward's
+    shape-adaptive default.  Same env-retrace caveat and multiple-of-8
+    validation as :func:`_flash_blocks`."""
+    out = []
+    for i, (name, given, t) in enumerate(
+            (("CHAINERMN_TPU_FLASH_BWD_BLOCK_Q", block_q, tq),
+             ("CHAINERMN_TPU_FLASH_BWD_BLOCK_K", block_k, tk))):
+        if given is None:
+            raw = os.environ.get(name)
+            if raw is None:
+                entry = _BWD_BLOCK_TABLE.get(t)
+                given = entry[i] if entry else _adaptive_block(t)
+            else:
+                try:
+                    given = int(raw)
+                except ValueError:
+                    raise ValueError(f"{name}={raw!r} is not an integer")
+                if given <= 0 or given % 8:
+                    raise ValueError(
+                        f"{name}={given} invalid: flash block sizes must "
+                        "be positive multiples of 8")
+        out.append(given)
+    return tuple(out)
+
+
+def _interpret_forced():
+    """CHAINERMN_TPU_FLASH_INTERPRET=1 routes the `attention` /
+    `attention_with_lse` dispatchers through the Pallas kernels in
+    interpreter mode on ANY backend — how the CPU tier-1 suite drives
+    the ring/Ulysses consumers through the real custom-VJP backward
+    instead of the blockwise-jnp fallback."""
+    return os.environ.get("CHAINERMN_TPU_FLASH_INTERPRET", "0") == "1"
+
+
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     block_k=None, interpret=False):
     """Fused attention via Pallas.  q/k/v: [B, H, T, D].  Default block
@@ -399,15 +566,25 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=None,
 
 def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
                         block_q=None, block_k=None, interpret=False,
-                        g_lse=None):
-    """Backward kernels: (dq, dk, dv) with flash memory behavior.
+                        g_lse=None, bwd_block_q=None, bwd_block_k=None):
+    """Backward: (dq, dk, dv) with flash memory behavior.
+
+    Default lowering is the FUSED one-pass kernel
+    (:func:`_flash_bwd_fused_kernel`): one recompute of each (qi, ki)
+    attention tile feeds dq, dk and dv together, with its own
+    sweep-tunable tiles (``bwd_block_q``/``bwd_block_k`` →
+    :func:`_flash_bwd_blocks`).  ``CHAINERMN_TPU_FLASH_BWD=split``
+    restores the legacy two-kernel lowering (a dq pass and a dkv pass,
+    each recomputing exp(q·kᵀ − lse)) bit-for-bit — the escape hatch,
+    same contract as PR 3's ``MAXPOOL_VJP=xla``.
 
     ``g_lse``: optional cotangent of the lse output.  Since
     ∂lse_i/∂s_ij = p_ij, its whole contribution is ``ds += g_lse_i * p``
     — algebraically identical to replacing ``delta`` with
-    ``delta - g_lse`` in the existing kernels (``ds = p*(gv - delta)``),
-    so no kernel changes are needed.  Ring attention depends on this: the
-    cross-block merge weights are functions of each block's lse."""
+    ``delta - g_lse`` in the kernels (``ds = p*(gv - delta)``), so no
+    kernel changes are needed on either path.  Ring attention depends on
+    this: the cross-block merge weights are functions of each block's
+    lse."""
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
@@ -425,6 +602,50 @@ def flash_attention_bwd(q, k, v, out, lse, g, causal=False, scale=None,
                     axis=-1, keepdims=True)
     if g_lse is not None:
         delta = delta - g_lse.reshape(B * H, Tq, 1).astype(jnp.float32)
+
+    if _flash_bwd_mode() == "fused":
+        # bwd-specific tiles; the (already shape-validated) forward
+        # tiles are the fallback when the table/env tiles don't divide
+        # this T — e.g. ragged lengths reached with explicit fwd blocks
+        bq, bk = _flash_bwd_blocks(bwd_block_q, bwd_block_k,
+                                   tq=Tq, tk=Tk)
+        bq = min(bq, Tq)
+        bk = min(bk, Tk)
+        if Tq % bq or Tk % bk:
+            bq, bk = block_q, block_k
+        n_kblocks = Tk // bk
+        dq_part, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, block_q=bq,
+                              causal=causal, scale=scale),
+            grid=(B * H, n_kblocks),
+            in_specs=[
+                pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, None, Tq, D),
+                             lambda b, i: (b, i, 0, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B * H, n_kblocks, Tq, D),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+                jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+            ],
+            interpret=interpret,
+            compiler_params=_COMPILER_PARAMS,
+        )(qr, kr, vr, gr, lser, delta)
+        # the cross-key-block dq reduction the grid cannot express:
+        # one XLA sum over the partial planes, then the fwd scale
+        dq = (jnp.sum(dq_part, axis=1) * scale).astype(q.dtype)
+        return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+                dv.reshape(B, H, Tk, D))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -487,9 +708,11 @@ def _flash_diff_fwd(q, k, v, causal, scale, interpret):
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                    block_q=bq, block_k=bk,
                                    interpret=interpret)
-    # carry the block config in the residuals: the backward must use the
-    # EXACT tiles the forward was validated with (re-reading the env
-    # there would silently corrupt gradients if it changed mid-process)
+    # carry the block config in the residuals: the backward's SHAPE
+    # validation must use the exact tiles the forward was validated with
+    # (they are the fused path's divisibility fallback and the split
+    # path's tiles; re-reading the fwd env there would silently corrupt
+    # gradients if it changed mid-process)
     return out, (q, k, v, out, lse, (bq, bk))
 
 
@@ -510,10 +733,14 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def attention(q, k, v, causal=False, scale=None):
-    """Dispatch: Pallas kernels on TPU (flash forward AND backward via
-    custom VJP), XLA reference elsewhere."""
+    """Dispatch: Pallas kernels on TPU (flash forward AND fused backward
+    via custom VJP), XLA reference elsewhere.
+    CHAINERMN_TPU_FLASH_INTERPRET=1 forces the Pallas path in
+    interpreter mode on any backend (CPU kernel tests)."""
     if jax.default_backend() in ("tpu", "axon"):
         return _flash_diff(q, k, v, causal, scale, False)
+    if _interpret_forced():
+        return _flash_diff(q, k, v, causal, scale, True)
     return xla_attention(q, k, v, causal=causal, scale=scale)
 
 
@@ -591,8 +818,8 @@ def _flash_lse_fwd(q, k, v, causal, scale, interpret):
     out, lse = flash_attention_fwd(q, k, v, causal=causal, scale=scale,
                                    block_q=bq, block_k=bk,
                                    interpret=interpret)
-    # same residual-carried block config as _flash_diff: backward must
-    # tile exactly as the forward did
+    # same residual-carried block config as _flash_diff: the fwd tiles
+    # are the backward's validated divisibility fallback
     return (out, lse), (q, k, v, out, lse, (bq, bk))
 
 
@@ -621,9 +848,10 @@ def attention_with_lse(q, k, v, causal=False, scale=None):
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
     bq, bk = _flash_blocks(tq=Tq, tk=Tk)
-    if (jax.default_backend() in ("tpu", "axon")
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if ((on_tpu or _interpret_forced())
             and Tq % min(bq, Tq) == 0 and Tk % min(bk, Tk) == 0):
-        return _flash_lse_diff(q, k, v, causal, scale, False)
+        return _flash_lse_diff(q, k, v, causal, scale, not on_tpu)
     return _blockwise_attention_lse_jnp(q, k, v, causal, scale)
 
 

@@ -21,6 +21,14 @@ sequence while no rank ever holds more than one remote KV block and no
 score matrix ever reaches HBM.  Peak memory is O(T/n · block); XLA
 overlaps each step's ppermute with the previous block's kernels.
 
+Backward: every per-block attention differentiates through the FUSED
+one-pass flash backward (``ops.flash_attention`` — ISSUE 4; the merge
+weights' lse dependence flows via the kernel's ``g_lse → delta``
+folding, so the zigzag schedule's LSE-merge stays exact through the
+fused kernel; pinned by the consumer grad tests in
+tests/parallel_tests/test_long_context.py with
+``CHAINERMN_TPU_FLASH_INTERPRET=1``).
+
 Causal masking is chunk-aware and static-shape, with two schedules:
 
 * ``schedule="naive"`` — contiguous sharding (rank i holds chunk i).

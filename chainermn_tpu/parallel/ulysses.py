@@ -9,6 +9,11 @@ The sequence axis is sharded across ranks; for attention, an
 sequence, and a reverse ``all_to_all`` restores sequence sharding.  Two
 collectives per attention layer, each moving activations once — the
 bandwidth-optimal exchange when H ≥ n.
+
+The per-head-group attention runs through the blockwise primitive
+(Pallas flash kernels on TPU — forward and the FUSED one-pass backward
+of ISSUE 4), and ``all_to_all`` is self-transposing, so the whole layer
+differentiates through the fused kernel path.
 """
 
 from __future__ import annotations

@@ -47,6 +47,11 @@ def main():
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    # version-compat shim (jax.shard_map vs jax.experimental.shard_map
+    # with the check_vma/check_rep rename) — the same absorption point
+    # the framework and __graft_entry__ use
+    from chainermn_tpu.utils.compat import shard_map
+
     import chainermn_tpu as ct
     from chainermn_tpu.core.link import bind_state, extract_state
     from chainermn_tpu.models.transformer import TransformerLM
@@ -85,7 +90,7 @@ def main():
         new_params = jax.tree.map(lambda p, g: p - 1e-3 * g, params, grads)
         return new_params, jax.lax.pmean(loss, "seq")
 
-    compiled = jax.jit(jax.shard_map(
+    compiled = jax.jit(shard_map(
         step, mesh=comm.mesh,
         in_specs=(P(), P(), P(None, "seq"), P(None, "seq")),
         out_specs=(P(), P()), check_vma=False))

@@ -1,0 +1,246 @@
+"""Fused flash-attention backward (ISSUE 4 tentpole).
+
+Interpret-mode (CPU tier-1) coverage:
+
+* grad parity of the fused one-pass dq/dkv kernel vs the
+  ``_blockwise_attention_lse_jnp`` reference over a (T, causal,
+  tile-shape, dtype) grid — including ragged T where the bwd tile table
+  does not divide and the kernel must fall back to the forward tiles;
+* the ``CHAINERMN_TPU_FLASH_BWD=split`` escape hatch restores the
+  legacy two-kernel lowering bit-for-bit;
+* backward tile resolution (env knobs, sweep table, explicit args);
+* fused↔split numerical agreement.
+
+Ring/Ulysses consumer coverage lives in
+tests/parallel_tests/test_long_context.py (the kernels there run under
+shard_map via CHAINERMN_TPU_FLASH_INTERPRET=1).
+"""
+
+import functools
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+fa = importlib.import_module("chainermn_tpu.ops.flash_attention")
+
+
+def _data(B=1, H=2, T=128, D=16, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (B, H, T, D))
+                             .astype(np.float32)).astype(dtype)
+    return mk(), mk(), mk()
+
+
+def _grads(loss, q, k, v):
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+# (T, (block_q, block_k)) — 192/160 are the ragged rows: no default
+# candidate (1024/512/256/128) divides them, and the bwd table misses,
+# so the fused kernel exercises its forward-tile fallback branch; the
+# 64/128 rows resolve bwd tiles through _adaptive_block.
+_GRID = [
+    (64, (32, 32)),
+    (128, (64, 64)),
+    (128, (64, 32)),
+    (192, (64, 64)),
+    (160, (32, 32)),
+]
+
+
+@pytest.mark.parametrize("T,blocks", _GRID)
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_bwd_grad_parity_vs_blockwise(monkeypatch, T, blocks,
+                                            causal, dtype):
+    """Full-grid grad parity: fused backward (interpret mode) vs the
+    differentiable blockwise jnp reference, for a loss touching BOTH
+    outputs (out and lse — the g_lse→delta folding included)."""
+    bq, bk = blocks
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BLOCK_Q", str(bq))
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BLOCK_K", str(bk))
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_Q", raising=False)
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_K", raising=False)
+    assert fa._flash_bwd_mode() == "fused"
+    q, k, v = _data(T=T, seed=T + causal, dtype=dtype)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def loss_flash(q, k, v):
+        out, lse = fa._flash_lse_diff(q, k, v, causal, scale, True)
+        return jnp.sum(out.astype(jnp.float32) ** 2) \
+            + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        out, lse = fa._blockwise_attention_lse_jnp(q, k, v, causal,
+                                                   scale, block_k=32)
+        return jnp.sum(out.astype(jnp.float32) ** 2) \
+            + jnp.sum(jnp.sin(lse))
+
+    gf = _grads(loss_flash, q, k, v)
+    gr = _grads(loss_ref, q, k, v)
+    if dtype == jnp.float32:
+        rtol, atol = 2e-4, 1e-5
+    else:
+        rtol, atol = 0.1, 0.05
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32),
+            np.asarray(b, dtype=np.float32), rtol=rtol, atol=atol,
+            err_msg=f"d{name} T={T} blocks={blocks} causal={causal} "
+                    f"dtype={dtype.__name__}")
+
+
+def _legacy_two_kernel_bwd(q, k, v, out, lse, g, causal, scale,
+                           block_q, block_k):
+    """The pre-fusion lowering, reconstructed verbatim from the split
+    kernels and their original pallas_call specs — the bit-for-bit
+    reference for the escape hatch."""
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    gr = g.reshape(B * H, Tq, D)
+    lser = lse.reshape(B * H, Tq, 1)
+    delta = jnp.sum(gr.astype(jnp.float32)
+                    * out.reshape(B * H, Tq, D).astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    dq = pl.pallas_call(
+        functools.partial(fa._flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(B * H, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=True,
+    )(qr, kr, vr, gr, lser, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(fa._flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid=(B * H, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, Tq, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, Tq, 1), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        interpret=True,
+    )(qr, kr, vr, gr, lser, delta)
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_split_escape_hatch_restores_legacy_bit_for_bit(monkeypatch,
+                                                        causal):
+    q, k, v = _data(T=128, seed=3, dtype=jnp.float32)
+    g = _data(T=128, seed=4)[0]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out, lse = fa.flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                      block_q=64, block_k=64,
+                                      interpret=True)
+    monkeypatch.setattr(fa, "_FLASH_BWD", "split")
+    got = fa.flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                                 scale=scale, block_q=64, block_k=64,
+                                 interpret=True)
+    want = _legacy_two_kernel_bwd(q, k, v, out, lse, g, causal, scale,
+                                  64, 64)
+    for a, b, name in zip(got, want, ("dq", "dk", "dv")):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"{name}: split mode no longer the legacy lowering")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_matches_split(monkeypatch, causal):
+    """The two lowerings are the same math: fp32 agreement to float
+    noise (the only difference is dq's cross-block summation order)."""
+    q, k, v = _data(T=128, seed=5)
+    g = _data(T=128, seed=6)[0]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out, lse = fa.flash_attention_fwd(q, k, v, causal=causal, scale=scale,
+                                      block_q=64, block_k=64,
+                                      interpret=True)
+    monkeypatch.setattr(fa, "_FLASH_BWD", "fused")
+    fused = fa.flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                                   scale=scale, block_q=64, block_k=64,
+                                   interpret=True, bwd_block_q=64,
+                                   bwd_block_k=64)
+    monkeypatch.setattr(fa, "_FLASH_BWD", "split")
+    split = fa.flash_attention_bwd(q, k, v, out, lse, g, causal=causal,
+                                   scale=scale, block_q=64, block_k=64,
+                                   interpret=True)
+    for a, b, name in zip(fused, split, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
+
+
+def test_bwd_mode_validation(monkeypatch):
+    monkeypatch.setattr(fa, "_FLASH_BWD", "nonsense")
+    with pytest.raises(ValueError, match="CHAINERMN_TPU_FLASH_BWD"):
+        fa._flash_bwd_mode()
+
+
+def test_bwd_block_resolution(monkeypatch):
+    """Explicit args > env knobs > sweep table > fwd-adaptive default."""
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_Q", raising=False)
+    monkeypatch.delenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_K", raising=False)
+    # table rows exist for the swept lengths
+    for t in (1024, 2048, 8192, 16384):
+        assert fa._flash_bwd_blocks(tq=t, tk=t) == fa._BWD_BLOCK_TABLE[t]
+    # off-table lengths: fwd-adaptive fallback
+    assert fa._flash_bwd_blocks(tq=512, tk=512) == (512, 512)
+    assert fa._flash_bwd_blocks(tq=192, tk=192) == (128, 128)
+    # env knobs pin, explicit args win
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_Q", "256")
+    assert fa._flash_bwd_blocks(tq=8192, tk=8192) == (
+        256, fa._BWD_BLOCK_TABLE[8192][1])
+    assert fa._flash_bwd_blocks(64, None, tq=8192, tk=8192) == (
+        64, fa._BWD_BLOCK_TABLE[8192][1])
+    monkeypatch.setenv("CHAINERMN_TPU_FLASH_BWD_BLOCK_K", "70")
+    with pytest.raises(ValueError, match="multiples of 8"):
+        fa._flash_bwd_blocks(tq=8192, tk=8192)
+
+
+def test_fused_bwd_kernel_count_and_single_exp():
+    """Structural pin of the recompute-once property: the fused backward
+    lowers to exactly ONE pallas_call whose kernel contains exactly ONE
+    exp; split lowers to two kernels with one exp each.  Uses the same
+    jaxpr census the tier-1 budget gate runs (tools/flash_sweep.py) —
+    here pinned against absolute expectations, there against the
+    committed tools/flash_budgets.json structure section."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools"))
+    import flash_sweep
+
+    # fused: ONE backward kernel with ONE exp
+    assert flash_sweep.bwd_kernel_census(fa, "fused") == \
+        {"_flash_bwd_fused_kernel": 1}
+    # split: the legacy pair, each recomputing its own exp(s - lse) —
+    # the duplicated recompute the fusion eliminates
+    assert flash_sweep.bwd_kernel_census(fa, "split") == \
+        {"_flash_bwd_dq_kernel": 1, "_flash_bwd_dkv_kernel": 1}

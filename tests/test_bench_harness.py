@@ -1119,3 +1119,55 @@ def test_supervisor_excludes_compile_time_from_deadline(tmp_path):
     assert "stale" not in out and "error" not in out
     # it genuinely outlived the 6s deadline thanks to the credit
     assert elapsed > 9, f"finished in {elapsed:.1f}s — compile not waited?"
+
+
+# -- BENCH_MODEL=longcontext (ISSUE 4) ---------------------------------------
+
+def test_err_metric_longcontext(monkeypatch):
+    monkeypatch.setenv("BENCH_MODEL", "longcontext")
+    assert bench._err_metric() == ("longcontext_flash_feasibility",
+                                   "tokens_context")
+
+
+def test_longcontext_rows_never_cacheable(monkeypatch):
+    """The feasibility artifact is a measurement, not flagship data: its
+    metric is outside _METRIC_TO_MODEL so neither the summary line nor
+    the per-T rows can ever be persisted or re-served stale."""
+    monkeypatch.setenv("BENCH_MODEL", "longcontext")
+    for metric in ("longcontext_flash_feasibility",
+                   "longcontext_flash_row", "longcontext_xla_contrast"):
+        assert not bench._cacheable({
+            "metric": metric, "value": 16384, "unit": "tokens_context",
+            "platform": "axon", "device_kind": "TPU v5 lite"})
+
+
+def test_longcontext_cpu_smoke_end_to_end(tmp_path):
+    """Full child run of the longcontext mode on CPU (interpret mode,
+    clamped T): per-T flash rows + xla contrast row + summary line with
+    the largest completed T as the value."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NO_SUPERVISE="1",
+               BENCH_MODEL="longcontext", BENCH_LC_SEQS="64",
+               BENCH_LC_XLA_T="64", BENCH_NO_FALLBACK="1",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH="",
+               BENCH_PREWARM_SENTINEL=str(tmp_path / "prewarmed"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    lines = [json.loads(l) for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert lines, out.stderr[-2000:]
+    by_metric = {l["metric"]: l for l in lines}
+    assert by_metric["longcontext_flash_row"]["T"] == 64
+    assert by_metric["longcontext_flash_row"]["interpreted"] is True
+    assert by_metric["longcontext_flash_row"]["bwd_mode"] == "fused"
+    assert "longcontext_xla_contrast" in by_metric
+    summary = lines[-1]
+    assert summary["metric"] == "longcontext_flash_feasibility"
+    assert summary["value"] == 64
+    assert summary["rows"] and summary["xla_contrast"]["T"] == 64
+    # the smoke must not have persisted anything as flagship data
+    assert not os.path.exists(str(tmp_path / "cache.json"))

@@ -1,0 +1,116 @@
+"""Flash-backward budget gate (ISSUE 4: the kernel win can't rot).
+
+Mirrors tests/test_hbm_budget.py: tools/flash_budgets.json commits the
+flash-attention backward's contract and this gate holds every future PR
+to it.  Two layers:
+
+* STRUCTURE (backend-neutral, checked here on CPU): the fused backward
+  lowers to exactly one Pallas kernel with exactly one exp — the
+  recompute-once property the fusion exists for — and the split escape
+  hatch to the legacy two kernels.  Verified against the traced
+  program, not against documentation.
+* NUMBERS (measured on chip by `make sweep-flash`): when the committed
+  sweep section says ``measured``, the T=8192 fused fwd+bwd TFLOP/s
+  must meet the committed target (≥2× the r5 split-backward baseline);
+  while it says ``pending_on_chip`` the numeric half is dormant but the
+  schema/target relation is still enforced.
+"""
+
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import flash_sweep  # noqa: E402
+
+fa = importlib.import_module("chainermn_tpu.ops.flash_attention")
+
+
+def _budgets():
+    with open(flash_sweep.BUDGETS_PATH) as f:
+        return json.load(f)
+
+
+def test_budget_schema_and_target_relation():
+    b = _budgets()
+    assert b["baseline"]["fwd_bwd_tflops_T8192"] == 31.8  # the r5 datum
+    # the acceptance bar this PR committed to: >= 2x the split baseline
+    assert b["target_fwd_bwd_tflops_T8192"] >= \
+        2.0 * b["baseline"]["fwd_bwd_tflops_T8192"]
+    assert b["structure"]["bwd_mode_default"] == "fused"
+    assert set(b["bwd_block_table"]) == {"1024", "2048", "8192", "16384"}
+    for blocks in b["bwd_block_table"].values():
+        assert len(blocks) == 2
+        assert all(x > 0 and x % 8 == 0 for x in blocks)
+    assert b["sweep"]["status"] in ("pending_on_chip", "measured")
+
+
+def test_bwd_block_table_matches_kernel_literal():
+    """The kernel reads the literal table in ops/flash_attention.py;
+    the budgets file records it — they must not desync (the sweep tool
+    prints a reminder to paste winners into the literal)."""
+    b = _budgets()
+    assert {int(t): tuple(v) for t, v in b["bwd_block_table"].items()} \
+        == fa._BWD_BLOCK_TABLE
+
+
+def test_fused_structure_gate():
+    """Recompute-once, machine-checked: the fused backward is ONE
+    pallas kernel with ONE exp.  A PR that splits the pass again or
+    adds a second exp(s - lse) recompute fails here and must either fix
+    it or consciously re-commit the structure section."""
+    b = _budgets()
+    census = flash_sweep.bwd_kernel_census(fa, "fused")
+    assert census == b["structure"]["fused_bwd_kernels"], (
+        f"fused backward structure drifted: traced {census}, committed "
+        f"{b['structure']['fused_bwd_kernels']}")
+
+
+def test_split_structure_gate():
+    b = _budgets()
+    census = flash_sweep.bwd_kernel_census(fa, "split")
+    assert census == b["structure"]["split_bwd_kernels"], (
+        f"split escape hatch no longer the legacy two-kernel lowering: "
+        f"traced {census}")
+
+
+def test_measured_numbers_meet_target_when_present():
+    b = _budgets()
+    if b["sweep"]["status"] != "measured":
+        return  # pending_on_chip: the numeric half is dormant
+    results = b["sweep"]["results"]
+    assert "8192" in results, "sweep measured but no T=8192 row"
+    got = results["8192"]["fwd_bwd_tflops"]
+    assert got >= b["target_fwd_bwd_tflops_T8192"], (
+        f"committed T=8192 fused fwd+bwd {got} TFLOP/s below the "
+        f"{b['target_fwd_bwd_tflops_T8192']} target — record the "
+        "refutation in BENCH_NOTES (r5 ResNet precedent) before "
+        "re-committing a lower target")
+
+
+def test_sweep_tool_cpu_smoke(tmp_path):
+    """The one-command reproducibility claim: the sweep tool runs its
+    interpret-mode smoke end to end and refuses --write-budgets off
+    chip (budgets are measured artifacts)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "flash_sweep.py"),
+         "--T", "64", "--blocks", "32:32", "--reps", "1"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=root)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rows = [json.loads(l) for l in out.stdout.strip().splitlines()]
+    timed = [r for r in rows if "fwd_bwd_ms" in r]
+    assert {r["bwd_mode"] for r in timed} == {"fused", "split"}
+    assert all(r["interpreted"] for r in timed)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "flash_sweep.py"),
+         "--T", "64", "--blocks", "32:32", "--reps", "1",
+         "--write-budgets"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=root)
+    assert out.returncode == 2
+    assert "refused" in out.stdout

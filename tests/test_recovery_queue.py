@@ -31,6 +31,11 @@ case "$*" in
     echo '{"flash_vs_xla": "T2048"}'
     echo '{"flash_vs_xla": "T8192"}'
     ;;
+  *flash_sweep.py*)
+    echo "flash sweep header text"
+    echo '{"probe": "flash_sweep", "T": 8192}'
+    echo '{"probe": "flash_sweep", "wrote": "flash_budgets.json"}'
+    ;;
   *profile_tpu_step.py*)
     echo "profile stub ran: $*"
     ;;
@@ -69,7 +74,7 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 11 bench steps recorded, each once, in queue order
+    # all 12 bench steps recorded, each once, in queue order
     expected = [
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # prewarm (default knobs)
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # flagship default
@@ -82,6 +87,7 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
         "transformer-bsd-d-scand-seqd-ip0-rpn-dn1",
         "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1",    # full remat
         "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1",  # dots policy
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1",  # flash 16k/32k rows
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
@@ -91,6 +97,11 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
     assert "Flash-vs-XLA attention rows" in notes_text
     assert notes_text.index("On-chip results") \
         < notes_text.index("Flash-vs-XLA attention rows")
+    # flash backward tile-sweep rows folded too (ISSUE 4), after the
+    # supervised benches' fold like every unsupervised step's section
+    assert notes_text.count('"flash_sweep"') == 2
+    assert notes_text.index("On-chip results") \
+        < notes_text.index("Flash backward tile-sweep rows")
     # isolation: preliminary lines and the old run's rows are excluded
     assert '"prelim"' not in notes_text
     assert "STALE-OLD-ROW" not in notes_text
@@ -119,7 +130,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the eleven bench rows must
+    the queue must still complete (|| true), the twelve bench rows must
     already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
@@ -143,5 +154,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 11
+                if '"final"' in ln]) == 12
     assert "Flash-vs-XLA" not in notes_text

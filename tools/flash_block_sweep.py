@@ -9,6 +9,11 @@ process can compare configs without the env-knob retrace hazard) and
 prints one JSON line per config.
 
 Usage:  python tools/flash_block_sweep.py [--T 2048] [--reps 20]
+
+SUPERSEDED for new work by tools/flash_sweep.py (`make sweep-flash`):
+per-leg fwd/bwd/fwd+bwd rows, fused-vs-split backward modes, and the
+flash_budgets.json regeneration.  Kept because the r5 BENCH_NOTES rows
+were produced by this exact script.
 """
 
 import argparse

@@ -11,6 +11,9 @@ Plus the chip-free byte accountants:
                          flagship train step, per-op-category table,
                          memory_analysis peaks, committed-budget check
   PROBE=precision_audit — StableHLO dtype census
+  PROBE=flash          — committed flash-backward budget table
+                         (tools/flash_budgets.json) joined with a live
+                         fused-vs-split kernel measurement
 
 Prints one JSON line per experiment.  Sync discipline: device->host value
 fetch (see bench.py note — block_until_ready lies through the relay).
@@ -662,6 +665,73 @@ def probe_flashcmp():
         print(json.dumps(row), flush=True)
 
 
+def probe_flash():
+    """PROBE=flash: the committed flash-backward budget table
+    (tools/flash_budgets.json) joined with a live fused-vs-split
+    measurement — the per-kernel face of the bench rows.  On the real
+    chip each row carries TFLOP/s at the committed tiles plus the
+    within_target verdict at T=8192; on CPU it interpret-smokes a
+    clamped T (mechanics only, labeled)."""
+    import importlib
+    import flash_sweep
+    fa = importlib.import_module("chainermn_tpu.ops.flash_attention")
+
+    with open(flash_sweep.BUDGETS_PATH) as f:
+        budgets = json.load(f)
+    interp = jax.default_backend() == "cpu"
+    B, H, D = 4, 12, 64
+    seqs = tuple(int(t) for t in os.environ.get(
+        "PROBE_T", ",".join(sorted(budgets["bwd_block_table"],
+                                   key=int))).split(","))
+    reps = int(os.environ.get("PROBE_REPS", "20"))
+    if interp:
+        seqs = tuple(t for t in seqs if t <= 256) or (128,)
+        reps = 1
+        print(json.dumps({"probe": "flash", "warning":
+                          "cpu interpret mode: T clamped; timings "
+                          "validate mechanics only, not perf",
+                          "seqs": list(seqs)}), flush=True)
+    for T in seqs:
+        bq, bk = budgets["bwd_block_table"].get(
+            str(T), (None, None)) if not interp else (32, 32)
+        if bq is None:
+            bq, bk = 1024, 1024
+        bq, bk = min(bq, T), min(bk, T)
+        if T % bq or T % bk:
+            # grid = T // block silently drops the tail on ragged T —
+            # refuse the row instead of mismeasuring (flash_sweep skips
+            # such configs the same way)
+            print(json.dumps({
+                "probe": "flash", "T": T, "block_q": bq, "block_k": bk,
+                "error": f"tiles do not divide T={T}: pick PROBE_T "
+                         "multiples of the budget tiles"}), flush=True)
+            continue
+        row = {"probe": "flash", "T": T, "block_q": bq, "block_k": bk,
+               "baseline_split_tflops_T8192":
+                   budgets["baseline"]["fwd_bwd_tflops_T8192"],
+               "target_tflops_T8192":
+                   budgets["target_fwd_bwd_tflops_T8192"],
+               "sweep_status": budgets["sweep"]["status"]}
+        if interp:
+            row["interpreted"] = True
+        for mode in ("fused", "split"):
+            try:
+                point = flash_sweep.measure_point(
+                    fa, B, H, D, T, bq, bk, mode, reps, interp)
+            except Exception as e:  # noqa: BLE001 — report and continue
+                row[f"{mode}_error"] = f"{type(e).__name__}: {e}"[:200]
+                continue
+            row[f"{mode}_fwd_bwd_ms"] = point["fwd_bwd_ms"]
+            row[f"{mode}_fwd_bwd_tflops"] = point["fwd_bwd_tflops"]
+        if "fused_fwd_bwd_ms" in row and "split_fwd_bwd_ms" in row:
+            row["fused_speedup"] = round(
+                row["split_fwd_bwd_ms"] / row["fused_fwd_bwd_ms"], 2)
+        if T == 8192 and not interp and "fused_fwd_bwd_tflops" in row:
+            row["within_target"] = row["fused_fwd_bwd_tflops"] >= \
+                budgets["target_fwd_bwd_tflops_T8192"]
+        print(json.dumps(row), flush=True)
+
+
 if __name__ == "__main__":
     if os.environ.get("PROBE_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
@@ -692,3 +762,5 @@ if __name__ == "__main__":
         probe_precision_audit()
     if which == "flashcmp":
         probe_flashcmp()
+    if which == "flash":
+        probe_flash()
