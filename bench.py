@@ -71,7 +71,26 @@ a variant that is excluded from the last-good cache):
                 BENCH_PEAK_TFLOPS (MFU denominator override)
                 BENCH_DONATE=0 (A/B leg: disable params/opt-state
                 buffer donation — never cached as flagship data),
-                BENCH_MEMSTATS=0 (skip the memory_analysis row fields)
+                BENCH_MEMSTATS=0 (skip the memory_analysis row fields),
+                BENCH_EXCHANGE (per_leaf|flat|bucketed|reduce_scatter —
+                gradient-exchange structure of the DP step; default
+                flat, the historical flagship config; any other value
+                is a variant excluded from the last-good cache),
+                BENCH_BUCKET_MB (bucket bound for bucketed, default 4;
+                the recovery queue sweeps 1/4/16),
+                BENCH_SHORT_STEPS (first-contact fallback steps/trial,
+                default 4 — see the staleness note below)
+  staleness     a FIRST-CONTACT run (no warm-cache sentinel for the
+                model) with a deadline below the first-contact default
+                clamps to BENCH_SHORT_STEPS and emits a FRESH row
+                (n_steps-gated out of the flagship cache) instead of
+                measuring into the deadline; and the stale re-serve
+                path REFUSES to serve the cached flagship on first
+                contact — three straight rounds (VERDICT r3–r5) the
+                driver's first contact returned the same stale datum
+                with rc=0 and the round recorded no fresh data.  A
+                first-contact invocation now returns fresh data or an
+                honest ``value: null`` error, never ``"stale": true``.
   deadline      BENCH_DEADLINE_S (else 270 s warm / 480 s first
                 contact per model, via BENCH_PREWARM_SENTINEL);
                 compile time is EXCLUDED from it via the compile
@@ -156,11 +175,40 @@ def _prewarm_sentinel(model):
     return f"{_PREWARM_SENTINEL_BASE}.{model}"
 
 
+def _first_contact(model=None):
+    """No successful on-chip trial of this model family has stamped the
+    warm-cache sentinel yet — cold XLA cache, cold relay."""
+    return not os.path.exists(_prewarm_sentinel(
+        model or os.environ.get("BENCH_MODEL", "resnet50")))
+
+
+# first-contact default deadline (cold compile through the relay
+# measured 75-109 s in r2); doubles as the "tight deadline" threshold
+# for the first-contact short-steps fallback
+_FIRST_CONTACT_DEADLINE_S = 480.0
+
 _START = time.monotonic()
 _DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S") or
-                    (270 if os.path.exists(_prewarm_sentinel(
-                        os.environ.get("BENCH_MODEL", "resnet50")))
-                     else 480))
+                    (270 if not _first_contact()
+                     else _FIRST_CONTACT_DEADLINE_S))
+
+
+def _effective_steps(default):
+    """(steps per timing trial, short_steps flag).
+
+    First contact with a deadline below the first-contact default is a
+    tight window the full measurement has repeatedly failed to fit
+    (VERDICT r5 Weak #1: three straight rounds the driver's first
+    contact stale-outed): clamp to BENCH_SHORT_STEPS so a FRESH row is
+    emitted — it can never be re-served as flagship data (n_steps is
+    part of the payload gates) but it is real data, and its success
+    stamps the prewarm sentinel so the NEXT run measures at full steps
+    under the warm 270 s window.  Explicit BENCH_STEPS always wins."""
+    if os.environ.get("BENCH_STEPS"):
+        return int(os.environ["BENCH_STEPS"]), False
+    if _first_contact() and _DEADLINE_S < _FIRST_CONTACT_DEADLINE_S:
+        return _env_int("BENCH_SHORT_STEPS", 4), True
+    return default, False
 
 # Peak bf16 flops by TPU generation (per chip).  v5 lite = v5e.
 _PEAK_TFLOPS = {
@@ -302,15 +350,25 @@ _DEFAULT_FINGERPRINTS = {
     "resnet50": {"model": "resnet50", "bs": DEFAULT_BS,
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
-                 "input_pipeline": False, "donate": True},
+                 "input_pipeline": False, "donate": True,
+                 "exchange": "flat", "bucket_mb": 0},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
                     "n_vocab": DEFAULT_TF_VOCAB, "heads": 0,
                     "remat": False, "remat_policy": "",
                     "n_steps": DEFAULT_TF_STEPS,
-                    "flash_blocks": ":", "donate": True},
+                    "flash_blocks": ":", "donate": True,
+                    "exchange": "flat", "bucket_mb": 0},
 }
+
+def _env_float(name, default):
+    """float env knob with the same never-raises contract as
+    ``_env_int`` (used inside the fingerprint)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 def _env_int(name, default):
@@ -365,6 +423,11 @@ def _config_fingerprint(model=None):
             # BENCH_DONATE=0 is the buffer-donation A/B leg: different
             # compiled program + different HBM headroom, never flagship
             "donate": os.environ.get("BENCH_DONATE", "1") == "1",
+            # exchange variants (bucketed sweep, reduce-scatter A/B)
+            # compile different collective structures — measurements,
+            # not flagship data
+            "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
+            "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
         }
     return {
         "model": "resnet50",
@@ -377,6 +440,8 @@ def _config_fingerprint(model=None):
         "input_pipeline":
             os.environ.get("BENCH_INPUT_PIPELINE", "0") == "1",
         "donate": os.environ.get("BENCH_DONATE", "1") == "1",
+        "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
+        "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
     }
 
 
@@ -413,6 +478,11 @@ def _payload_flagship_ok(model, result):
         return False
     if not result.get("donated", True):
         # the BENCH_DONATE=0 A/B leg is a measurement, not flagship data
+        return False
+    if result.get("exchange", "flat") != "flat":
+        # bucketed/reduce_scatter/per_leaf legs compile a different
+        # collective structure — measurements, not flagship data
+        # (legacy entries lack the key and were flat by construction)
         return False
     if model == "resnet50":
         # batch bounds: OOM backoff halves the requested batch at most
@@ -689,6 +759,64 @@ def _transformer_flops_per_token(d_model, n_layers, n_vocab, seq_len):
     return 3.0 * (matmul + attn)
 
 
+def _exchange_config():
+    """(exchange, bucket_mb_or_None) from the env, validated against
+    the ONE exchange vocabulary (communicators.EXCHANGES; flat is the
+    historical flagship — other flavors are measured variants, never
+    flagship-cacheable).  Lazy import: this runs inside the measured
+    child, after platform config."""
+    from chainermn_tpu.communicators import EXCHANGES
+    exchange = os.environ.get("BENCH_EXCHANGE", "flat")
+    if exchange not in EXCHANGES:
+        raise ValueError(
+            f"unknown BENCH_EXCHANGE={exchange!r} ({'|'.join(EXCHANGES)})")
+    bucket_mb = os.environ.get("BENCH_BUCKET_MB")
+    return exchange, (float(bucket_mb) if bucket_mb else None)
+
+
+def _make_dp_optimizer(inner, model, exchange, bucket_mb):
+    """Communicator + multi-node wrapper for the requested gradient
+    exchange (flagship bf16 gradient compression on every flavor)."""
+    import chainermn_tpu as ct
+    bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
+    comm = ct.create_communicator("jax_ici",
+                                  allreduce_grad_dtype="bfloat16",
+                                  batch_collectives=bc,
+                                  bucket_mb=bucket_mb)
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(inner, comm,
+                                         exchange=opt_exchange)
+    return comm, opt.setup(model)
+
+
+def _exchange_row_fields(model, comm, exchange):
+    """Row fields documenting the exchange: structure knobs plus the
+    per-replica wire-byte accounting (ring decomposition — the same
+    formulas tools/comm_budgets.json commits; 0 on a single chip)."""
+    from chainermn_tpu.communicators._memory_utility import exchanged_bytes
+    arrays = [p.array for p in model.params() if p.array is not None]
+    n_params = sum(int(np.prod(a.shape)) for a in arrays)
+    param_bytes = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+                      for a in arrays)
+    gdtype = comm.allreduce_grad_dtype
+    grad_bytes = (n_params * gdtype.itemsize if gdtype is not None
+                  else param_bytes)  # uncompressed grads ride param dtype
+    size = comm.size
+    fields = {"exchange": exchange,
+              "bucket_mb": comm.bucket_mb if exchange == "bucketed"
+              else None}
+    if exchange == "reduce_scatter":
+        grad = exchanged_bytes(grad_bytes, size, "reduce_scatter")
+        fields["exchanged_bytes"] = grad + exchanged_bytes(
+            param_bytes, size, "all_gather")
+        fields["exchanged_grad_bytes"] = grad
+    else:
+        fields["exchanged_bytes"] = exchanged_bytes(grad_bytes, size,
+                                                    "psum")
+        fields["exchanged_grad_bytes"] = fields["exchanged_bytes"]
+    return fields
+
+
 def _scan_mode_requested():
     """Will this run compile a scan-over-steps program?  Mirrors the
     BENCH_SCAN / BENCH_INPUT_PIPELINE default logic in `_run_bench`."""
@@ -804,7 +932,9 @@ def _run_bench_transformer():
 
     per_chip_bs = int(os.environ.get("BENCH_BS", str(DEFAULT_TF_BS)))
     seq_len = int(os.environ.get("BENCH_SEQ", str(DEFAULT_SEQ)))
-    n_steps = int(os.environ.get("BENCH_STEPS", str(DEFAULT_TF_STEPS)))
+    n_steps, short_steps = _effective_steps(DEFAULT_TF_STEPS)
+    exchange, bucket_mb = _exchange_config()
+    exchange_info = {"exchange": exchange, "bucket_mb": bucket_mb}
     d_model = int(os.environ.get("BENCH_D_MODEL",
                                  str(DEFAULT_TF_D_MODEL)))
     n_layers = int(os.environ.get("BENCH_LAYERS",
@@ -851,6 +981,12 @@ def _run_bench_transformer():
             "donated": donate,
             "compile_s": round(compile_s, 1),
         }
+        result.update(exchange_info)
+        if short_steps:
+            # first-contact tight-deadline fallback: real data, but a
+            # different amortization regime — labeled, and n_steps-gated
+            # out of the flagship cache
+            result["short_steps"] = True
         if hbm is not None:
             result["peak_hbm_bytes"] = hbm["peak_hbm_bytes"]
             result["hbm"] = hbm
@@ -863,16 +999,14 @@ def _run_bench_transformer():
         return result
 
     def run(per_chip_bs):
-        comm = ct.create_communicator("jax_ici",
-                                      allreduce_grad_dtype="bfloat16")
         model = TransformerLM(n_vocab=n_vocab, d_model=d_model,
                               n_heads=n_heads, n_layers=n_layers,
                               max_len=seq_len, seed=0, remat=remat_arg,
                               compute_dtype=jnp.bfloat16)
-        comm.bcast_data(model)
         inner = Adam(alpha=3e-4)
         inner.donate_params = donate  # BENCH_DONATE=0 = the A/B leg
-        opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
+        comm, opt = _make_dp_optimizer(inner, model, exchange, bucket_mb)
+        exchange_info.update(_exchange_row_fields(model, comm, exchange))
 
         global_bs = per_chip_bs * n_devices
         rng = np.random.RandomState(0)
@@ -1086,7 +1220,9 @@ def _run_bench():
     per_chip_bs = int(os.environ.get("BENCH_BS", str(DEFAULT_BS)))
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     image_size = int(os.environ.get("BENCH_SIZE", str(DEFAULT_SIZE)))
-    n_steps = int(os.environ.get("BENCH_STEPS", str(DEFAULT_STEPS)))
+    n_steps, short_steps = _effective_steps(DEFAULT_STEPS)
+    exchange, bucket_mb = _exchange_config()
+    exchange_info = {"exchange": exchange, "bucket_mb": bucket_mb}
     # BENCH_SCAN=K fuses K steps per dispatch via update_scan (one jit
     # containing a lax.scan) — isolates device throughput from host/relay
     # dispatch latency; 0 = plain per-step update() dispatch.  The
@@ -1151,6 +1287,12 @@ def _run_bench():
             "compile_s": round(compile_s, 1),
             "fused_steps_per_dispatch": scan_k or 1,
         }
+        result.update(exchange_info)
+        if short_steps:
+            # first-contact tight-deadline fallback: real data, but a
+            # different amortization regime — labeled, and n_steps-gated
+            # out of the flagship cache
+            result["short_steps"] = True
         if hbm is not None:
             result["peak_hbm_bytes"] = hbm["peak_hbm_bytes"]
             result["hbm"] = hbm
@@ -1197,16 +1339,14 @@ def _run_bench():
 
     def run(per_chip_bs):
         global_bs = per_chip_bs * n_devices
-        comm = ct.create_communicator("jax_ici",
-                                      allreduce_grad_dtype="bfloat16")
         model = Classifier(ResNet50(
             n_classes=1000, remat=remat, compute_dtype=jnp.bfloat16,
             seed=0, layout=layout,
             input_norm="imagenet" if input_pipeline else None))
-        comm.bcast_data(model)
         inner = MomentumSGD(lr=0.1, momentum=0.9)
         inner.donate_params = donate  # BENCH_DONATE=0 = the A/B leg
-        opt = ct.create_multi_node_optimizer(inner, comm).setup(model)
+        comm, opt = _make_dp_optimizer(inner, model, exchange, bucket_mb)
+        exchange_info.update(_exchange_row_fields(model, comm, exchange))
 
         rng = np.random.RandomState(0)
         shape = ((global_bs, image_size, image_size, 3) if layout == "NHWC"
@@ -1322,8 +1462,24 @@ def _emit_stale_or_error(err):
     result is re-served ONLY if it passes the same config fingerprint
     that gated its persistence (``_cacheable``): a non-default or
     non-accelerator payload under the flagship metric is worse than
-    ``value: null`` — it reads as a (terrible) datum."""
+    ``value: null`` — it reads as a (terrible) datum.
+
+    FIRST CONTACT refuses the stale re-serve entirely (VERDICT r5 Weak
+    #1, third straight stale round): with no warm-cache sentinel this
+    invocation was supposed to produce fresh data (the short-steps
+    fallback exists precisely for its tight window) — re-serving the
+    cached flagship here is how three rounds in a row looked "fine"
+    while recording zero new measurements.  The honest ``value: null``
+    error line is the signal the driver needs to act on."""
     metric, unit = _err_metric()
+    if _first_contact():
+        _emit({"metric": metric, "value": None, "unit": unit,
+               "vs_baseline": None, "error": err, "first_contact": True,
+               "stale_refused": "no warm-cache sentinel for this model: "
+               "first contact must yield fresh data (short-steps "
+               "fallback) or fail honestly, never a stale re-serve"},
+              persist=False)
+        return
     # _load_cache is the single authoritative gate: it returns ONLY an
     # entry that passed the shape screen, the stored-vs-requested
     # fingerprint match, and `_cacheable`'s env+payload checks — or

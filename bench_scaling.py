@@ -121,7 +121,7 @@ def project_efficiency(step_ms, n_chips, grad_mb=51.1, ici_gbps=100.0,
 
 
 def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
-                 zero=False):
+                 zero=False, exchange="flat"):
     """One process of the REAL cross-process compiled DP step (the same
     path as ``tests/multiprocess_tests/_worker.py · run_dp_step``): gloo
     CPU backend, 1 device per process, the whole DP step one shard_mapped
@@ -144,13 +144,18 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
     from chainermn_tpu.core.optimizer import MomentumSGD
     from chainermn_tpu.models import MLP, Classifier
 
-    comm = ct.create_communicator("jax_ici")
+    # exchange selects the gradient-exchange structure under test (the
+    # ISSUE 5 exposed-comm A/B: bucketed vs flat across REAL process
+    # boundaries); reduce_scatter routes through the optimizer-level
+    # step variant, zero keeps the ZeRO-1 contract
+    bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
+    comm = ct.create_communicator("jax_ici", batch_collectives=bc)
     assert comm.size == nprocs == jax.device_count()
     model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(
-        MomentumSGD(lr=0.01, momentum=0.9), comm,
-        zero_sharding=zero).setup(model)
+        MomentumSGD(lr=0.01, momentum=0.9), comm, zero_sharding=zero,
+        exchange=opt_exchange).setup(model)
 
     gbs = per_rank_bs * nprocs
     rng = np.random.RandomState(0)
@@ -160,6 +165,11 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
     for _ in range(3):  # trace+compile, then steady-state warmup
         loss = opt.update(model, x, t)
     float(loss)
+
+    n_buckets = None
+    if exchange == "bucketed":
+        # post-warmup: params materialize lazily on the first update
+        n_buckets = len(comm.grad_buckets_for(model))
     if nprocs > 1:
         comm._host_channel().barrier()
     start = time.perf_counter()
@@ -170,16 +180,23 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
     if pid == 0:
         n_params = sum(int(np.prod(p.array.shape))
                        for p in model.params())
-        print(json.dumps({
+        row = {
             "processes": nprocs, "per_rank_bs": per_rank_bs,
             "zero_sharding": bool(zero),
+            "exchange": exchange,
             "grad_payload_mb": round(n_params * 4 / 1e6, 2),
             "step_ms": round(dt / steps * 1e3, 3),
-            "examples_per_sec": round(steps * gbs / dt, 1)}), flush=True)
+            "examples_per_sec": round(steps * gbs / dt, 1)}
+        if exchange == "bucketed":
+            # the degenerate single-bucket datum (payload fits the
+            # bound) must be tellable apart downstream
+            row["bucket_mb"] = comm.bucket_mb
+            row["n_buckets"] = n_buckets
+        print(json.dumps(row), flush=True)
 
 
 def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
-                    reps=1):
+                    reps=1, exchange="flat"):
     """Launch each P-process measurement and report per-hop overhead:
     step_ms(P) - step_ms(1) is the cost the framework adds per step when
     the SAME compiled program's gradient mean must cross P real process
@@ -224,7 +241,7 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
                 [sys.executable, os.path.abspath(__file__),
                  "--gloo-worker", str(pid), str(nprocs), str(port),
                  str(per_rank_bs), str(hidden), str(steps),
-                 str(int(zero))],
+                 str(int(zero)), exchange],
                 env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True)
                 for pid in range(nprocs)]
@@ -282,6 +299,13 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
           # the row's two fields stay mutually consistent
           row["examples_per_sec"] = round(
               nprocs * per_rank_bs / (row["step_ms"] / 1e3), 1)
+      if row.get("exchange") == "bucketed" and row.get("n_buckets", 0) <= 1:
+          # worker output is captured, so the launcher owns the warning
+          print(f"bench_scaling: bucketed plan degenerated to ONE bucket "
+                f"at bucket_mb={row.get('bucket_mb')} (gradient payload "
+                f"fits the bound) — structurally identical to flat; set "
+                f"CHAINERMN_TPU_BUCKET_MB below the payload for a real "
+                f"bucketed-vs-flat A/B", file=sys.stderr, flush=True)
       rows.append(row)
       print(json.dumps(row), flush=True)
     base = next(r["step_ms"] for r in rows if r["processes"] == 1)
@@ -325,7 +349,7 @@ def main():
                         help="comma list, e.g. 1,2,4: measure the REAL "
                              "cross-process compiled DP step at each "
                              "process count (gloo CPU backend)")
-    parser.add_argument("--gloo-worker", nargs=7, default=None,
+    parser.add_argument("--gloo-worker", nargs=8, default=None,
                         help=argparse.SUPPRESS)  # internal
     parser.add_argument("--gloo-hidden", type=int, default=512,
                         help="MLP hidden width for --gloo-procs")
@@ -336,18 +360,45 @@ def main():
                         help="repeat each P-process measurement and "
                              "report mean/min/max (noise quantification"
                              " on time-sliced hosts)")
+    parser.add_argument("--gloo-exchange", default="flat",
+                        help="gradient-exchange structure under test: "
+                             "per_leaf|flat|bucketed|reduce_scatter "
+                             "(validated against communicators."
+                             "EXCHANGES — the ISSUE 5 exposed-comm "
+                             "A/B: run the curve once with flat, once "
+                             "with bucketed — the delta across real "
+                             "process boundaries is the overlap "
+                             "payoff)")
     args = parser.parse_args()
 
     if args.gloo_worker:
         pid, nprocs, port, bs, hidden, steps, zero = \
-            map(int, args.gloo_worker)
-        _gloo_worker(pid, nprocs, port, bs, hidden, steps, bool(zero))
+            map(int, args.gloo_worker[:7])
+        _gloo_worker(pid, nprocs, port, bs, hidden, steps, bool(zero),
+                     exchange=args.gloo_worker[7])
         return
     if args.gloo_procs:
+        # lazy: the vocabulary lives with the communicator mapping (the
+        # parent never touches devices, so the import is safe here; the
+        # --gloo-worker branch above stays import-free until its own
+        # platform pinning has run)
+        from chainermn_tpu.communicators import EXCHANGES
+        if args.gloo_exchange not in EXCHANGES:
+            parser.error(f"unknown --gloo-exchange "
+                         f"{args.gloo_exchange!r} "
+                         f"({'|'.join(EXCHANGES)})")
+        if args.gloo_zero and args.gloo_exchange == "reduce_scatter":
+            # fail before any worker spawns: every worker would raise
+            # create_multi_node_optimizer's zero×reduce_scatter
+            # ValueError after ports are bound and gloo is up — in the
+            # unattended queue that burns the slot with no datum
+            parser.error("--gloo-zero already exchanges gradients via "
+                         "reduce-scatter; drop --gloo-exchange "
+                         "reduce_scatter")
         counts = [int(c) for c in args.gloo_procs.split(",")]
         _run_gloo_curve(counts, args.per_chip_bs, args.gloo_hidden,
                         args.steps, zero=args.gloo_zero,
-                        reps=args.gloo_reps)
+                        reps=args.gloo_reps, exchange=args.gloo_exchange)
         return
 
     if args.project:

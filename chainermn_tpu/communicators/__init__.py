@@ -12,6 +12,10 @@ name                 TPU realization
 ``naive``            per-parameter mean collectives (correctness baseline)
 ``flat``             single flat-bucket collective (``batch_collectives``)
 ``pure_nccl``        fused bucket + optional compressed-dtype gradient psum
+                     (``batch_collectives="bucketed"`` restores the
+                     reference's SIZE-BOUNDED bucket pipeline — K
+                     ``bucket_mb``-bounded collectives in reverse
+                     registration order, overlappable with backward)
 ``hierarchical``     alias of ``pure_nccl`` (XLA handles torus hierarchy)
 ``two_dimensional``  alias of ``pure_nccl``
 ``single_node``      asserts one host, otherwise ``pure_nccl``
@@ -41,25 +45,53 @@ __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
            "FaultInjectionCommunicator", "FaultSchedule", "FaultSpec",
            "InjectedFault", "bind_host_channel", "schedule_from_env",
            "ChannelError", "ChannelTimeoutError", "PeerLostError",
-           "HostChannel", "HeartbeatMonitor"]
+           "HostChannel", "HeartbeatMonitor",
+           "EXCHANGES", "exchange_knobs"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
           "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug",
           "fault")
 
+#: gradient-exchange vocabulary shared by bench rows, the gloo A/B, and
+#: tools/comm_budgets.json configs
+EXCHANGES = ("per_leaf", "flat", "bucketed", "reduce_scatter")
+
+
+def exchange_knobs(exchange):
+    """``(batch_collectives, optimizer exchange=)`` pair for a named
+    gradient-exchange structure — the ONE mapping bench.py's on-chip
+    rows and bench_scaling.py's gloo A/B share, so the same name always
+    measures the same collective structure on both surfaces.
+    ``reduce_scatter`` keeps a flat communicator: the optimizer-level
+    step variant owns its collective structure (the communicator's
+    packing only affects eager-mode collectives there)."""
+    try:
+        bc = {"per_leaf": False, "flat": True, "bucketed": "bucketed",
+              "reduce_scatter": True}[exchange]
+    except KeyError:
+        raise ValueError(f"unknown exchange {exchange!r} "
+                         f"({'|'.join(EXCHANGES)})") from None
+    return bc, ("reduce_scatter" if exchange == "reduce_scatter"
+                else "allreduce")
+
 
 def create_communicator(communicator_name="jax_ici", devices=None,
                         axis_name="mn_world", allreduce_grad_dtype=None,
-                        batch_collectives=None, fault_schedule=None,
-                        **kwargs):
+                        batch_collectives=None, bucket_mb=None,
+                        fault_schedule=None, **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
     (reference fp16 path; bf16 recommended on TPU).  ``devices``: subset of
-    ``jax.devices()`` (default all).  ``fault_schedule`` (``fault`` name
-    only): a :class:`FaultSchedule` or spec dict; defaults to
-    ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the environment — the chaos
-    harness's entry point (see ``docs/resilience.md``).
+    ``jax.devices()`` (default all).  ``batch_collectives``: ``False``
+    (per-leaf collectives), ``True`` (one flat bucket — the per-name
+    default for the fused flavors) or ``"bucketed"`` (K size-bounded
+    buckets, the reference pure_nccl pipeline; ``bucket_mb`` /
+    ``CHAINERMN_TPU_BUCKET_MB`` bounds each bucket, default ~4 MB).
+    ``fault_schedule`` (``fault`` name only): a :class:`FaultSchedule` or
+    spec dict; defaults to ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the
+    environment — the chaos harness's entry point (see
+    ``docs/resilience.md``).
     """
     name = communicator_name
     if name not in _NAMES:
@@ -84,7 +116,8 @@ def create_communicator(communicator_name="jax_ici", devices=None,
         base = create_communicator(
             "jax_ici", devices=devices, axis_name=axis_name,
             allreduce_grad_dtype=allreduce_grad_dtype,
-            batch_collectives=batch_collectives, **kwargs)
+            batch_collectives=batch_collectives, bucket_mb=bucket_mb,
+            **kwargs)
         # the hc.* transport hook gets its own schedule CLONE (same
         # specs + seed, separate RNG stream/counters): transport call
         # counts are inherently per-rank asymmetric (root puts,
@@ -101,7 +134,8 @@ def create_communicator(communicator_name="jax_ici", devices=None,
     if name == "debug":
         return DebugCommunicator(devices=devices, axis_name=axis_name,
                                  allreduce_grad_dtype=allreduce_grad_dtype,
-                                 batch_collectives=bool(batch_collectives))
+                                 batch_collectives=batch_collectives or False,
+                                 bucket_mb=bucket_mb)
     if name == "single_node" and jax.process_count() != 1:
         raise ValueError("single_node communicator requires one host "
                          f"(process_count={jax.process_count()})")
@@ -116,4 +150,5 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                                      "single_node")
     return MeshCommunicator(devices=devices, axis_name=axis_name,
                             allreduce_grad_dtype=allreduce_grad_dtype,
-                            batch_collectives=batch_collectives, name=name)
+                            batch_collectives=batch_collectives,
+                            bucket_mb=bucket_mb, name=name)

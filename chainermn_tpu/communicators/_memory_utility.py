@@ -6,7 +6,17 @@ arenas and batched-copy kernels gather scattered grads into one buffer.
 On TPU, packing is a ``concatenate`` *inside* the compiled step (XLA fuses
 the copies); no arena management exists because XLA owns HBM.  These
 helpers provide the same pack/unpack contract for the ``flat``-flavor
-communicator and for flat-buffer checkpointing.
+communicator, the bucketed gradient exchange, and flat-buffer
+checkpointing.
+
+Bucket planning (reference: pure_nccl's size-bounded gradient buckets,
+SURVEY §2.5 N2): :func:`plan_buckets` partitions a leaf list into
+contiguous size-bounded groups in REVERSE leaf order — backward produces
+the LAST-registered parameters' gradients first, so the first emitted
+bucket closes (and its collective can start) while earlier layers'
+gradients are still being computed.  The plan is a pure function of
+(shapes, dtypes, bound): every process traces the identical partition,
+which is what makes the per-bucket collectives line up across ranks.
 """
 
 from __future__ import annotations
@@ -16,7 +26,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack"]
+__all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
+           "plan_buckets", "bucket_table", "exchanged_bytes"]
+
+#: default bucket bound (MB) for the bucketed exchange —
+#: ``CHAINERMN_TPU_BUCKET_MB`` overrides (reference: pure_nccl's
+#: allreduce chunking; ~4 MB keeps each collective large enough to hit
+#: ring bandwidth while leaving several schedulable units per step)
+DEFAULT_BUCKET_MB = 4.0
 
 
 def tree_pack(tree, dtype=None):
@@ -39,6 +56,81 @@ def tree_unpack(flat, spec):
         leaves.append(flat[offset:offset + n].reshape(shape).astype(dt))
         offset += n
     return jax.tree.unflatten(treedef, leaves)
+
+
+def plan_buckets(shapes, dtypes, bucket_bytes):
+    """Partition leaves into size-bounded buckets of leaf INDICES.
+
+    Deterministic pure function of the arguments (identical on every
+    rank — the cross-process contract the per-bucket collectives rely
+    on).  Properties, pinned by tests/communicator_tests:
+
+    * every leaf index appears in exactly one bucket;
+    * buckets are emitted in REVERSE leaf order (last-registered
+      parameter first — its gradient exists first in the backward);
+    * a bucket never exceeds ``bucket_bytes`` unless a single leaf does
+      (an oversize leaf gets a bucket of its own);
+    * a bucket never mixes dtypes: the pack is a ``concatenate``, and a
+      mixed bucket would silently promote (and mis-size) the transfer.
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    buckets = []
+    current = []
+    current_bytes = 0
+    current_dtype = None
+    for i in reversed(range(len(shapes))):
+        dt = jnp.dtype(dtypes[i])
+        nbytes = int(np.prod(shapes[i])) * dt.itemsize
+        if current and (current_bytes + nbytes > bucket_bytes
+                        or dt != current_dtype):
+            buckets.append(current)
+            current, current_bytes = [], 0
+        current.append(i)
+        current_bytes += nbytes
+        current_dtype = dt
+    if current:
+        buckets.append(current)
+    return buckets
+
+
+def bucket_table(shapes, dtypes, bucket_bytes):
+    """Human/probe-facing accounting of a bucket plan: one row per
+    bucket with its leaf count, element count, bytes, and dtype."""
+    rows = []
+    for b, idx in enumerate(plan_buckets(shapes, dtypes, bucket_bytes)):
+        dt = jnp.dtype(dtypes[idx[0]])
+        elems = sum(int(np.prod(shapes[i])) for i in idx)
+        rows.append({"bucket": b, "n_leaves": len(idx),
+                     "elems": elems, "bytes": elems * dt.itemsize,
+                     "dtype": str(dt)})
+    return rows
+
+
+def exchanged_bytes(n_bytes, size, collective):
+    """Per-replica wire bytes of one collective on an ``n_bytes`` FULL
+    buffer (for ``all_gather``, the gathered result — chunk × size)
+    over ``size`` ranks, under the standard ring/bandwidth-optimal
+    decomposition (the accounting tools/comm_budgets.json commits):
+
+    * ``psum`` (allreduce)   → ``2 · n · (size-1)/size``
+      (reduce-scatter phase + all-gather phase)
+    * ``reduce_scatter``     → ``n · (size-1)/size``
+    * ``all_gather``         → ``n · (size-1)/size``
+
+    This is why the reduce-scatter update halves per-replica exchanged
+    GRADIENT bytes vs allreduce: the gradient crosses the wire once
+    (reduce-scatter) instead of twice; the step's other transfer — the
+    params all-gather — is parameter bytes, accounted separately.
+    """
+    if size <= 1:
+        return 0
+    frac = (size - 1) / size
+    if collective == "psum":
+        return int(2 * n_bytes * frac)
+    if collective in ("reduce_scatter", "all_gather"):
+        return int(n_bytes * frac)
+    raise ValueError(f"unknown collective {collective!r}")
 
 
 def pack_params(params, attr="grad", dtype=None):

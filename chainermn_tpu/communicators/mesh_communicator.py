@@ -15,8 +15,18 @@ are aliases of this class with their distinguishing knobs preserved:
 * ``flat``'s single fused buffer → ``batch_collectives=True``: gradients
   are flattened into one contiguous bucket before the collective (N2;
   XLA usually fuses this anyway — measured, not assumed; see bench/).
+* pure_nccl's size-bounded allreduce pipeline →
+  ``batch_collectives="bucketed"``: gradients are packed into K
+  size-bounded buckets (``CHAINERMN_TPU_BUCKET_MB`` / ``bucket_mb``,
+  default ~4 MB) in reverse parameter-registration order, one ``pmean``
+  per bucket — schedulable units XLA's async-collective scheduler can
+  overlap with the remaining backward compute (the reference hid its
+  NCCL allreduces behind backward the same way; see
+  docs/performance.md §7 and tools/comm_budgets.json).
 * ``hierarchical``/``two_dimensional``'s reduce-scatter structure → XLA
-  already decomposes large ``psum``s bandwidth-optimally over the torus.
+  already decomposes large ``psum``s bandwidth-optimally over the torus;
+  the explicit reduce-scatter DP update lives one level up
+  (``create_multi_node_optimizer(exchange="reduce_scatter")``).
 
 Two operating modes (see ``communicator_base`` docstring): eager host-mode
 collectives on stacked arrays, and in-step ``lax`` collectives inside
@@ -56,7 +66,7 @@ class MeshCommunicator(CommunicatorBase):
 
     def __init__(self, devices=None, axis_name="mn_world",
                  allreduce_grad_dtype=None, batch_collectives=False,
-                 name="jax_ici", _mesh=None):
+                 bucket_mb=None, name="jax_ici", _mesh=None):
         self.name = name
         self.axis_name = axis_name
         if _mesh is not None:
@@ -67,7 +77,29 @@ class MeshCommunicator(CommunicatorBase):
             self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
         self.allreduce_grad_dtype = (None if allreduce_grad_dtype is None
                                      else jnp.dtype(allreduce_grad_dtype))
+        if batch_collectives not in (False, True, "bucketed"):
+            raise ValueError(
+                f"batch_collectives must be False (per-leaf collectives), "
+                f"True (one flat bucket) or 'bucketed' (size-bounded "
+                f"buckets); got {batch_collectives!r}")
         self.batch_collectives = batch_collectives
+        # bucket bound for the "bucketed" exchange; the env knob is read
+        # at CONSTRUCTION (not trace) time so every rank of a job traces
+        # the same plan from the same communicator arguments.  Resolved
+        # only when it can matter (explicit arg or bucketed exchange) —
+        # a stray CHAINERMN_TPU_BUCKET_MB value must not break the
+        # flavors that never plan buckets
+        if bucket_mb is None and batch_collectives == "bucketed":
+            import os
+            from ._memory_utility import DEFAULT_BUCKET_MB
+            bucket_mb = float(os.environ.get("CHAINERMN_TPU_BUCKET_MB")
+                              or DEFAULT_BUCKET_MB)
+        if bucket_mb is not None:
+            bucket_mb = float(bucket_mb)
+            if bucket_mb <= 0:
+                raise ValueError(
+                    f"bucket_mb must be positive, got {bucket_mb}")
+        self.bucket_mb = bucket_mb
         self._mailbox = {}
         self._obj_mailbox = {}
         self._lock = threading.Lock()
@@ -484,41 +516,101 @@ class MeshCommunicator(CommunicatorBase):
         return fn(grads)
 
     # -- in-step gradient transform (the hot path) ---------------------------------
+    @property
+    def exchange(self):
+        """Canonical name of this communicator's gradient-exchange
+        structure: ``"per_leaf"`` | ``"flat"`` | ``"bucketed"`` (the
+        vocabulary tools/comm_budgets.json and bench rows use)."""
+        if self.batch_collectives == "bucketed":
+            return "bucketed"
+        return "flat" if self.batch_collectives else "per_leaf"
+
+    def grad_buckets(self, shapes, dtypes):
+        """The bucket plan this communicator's ``grad_transform`` traces
+        for leaves of the given shapes/dtypes (post dtype-compression):
+        list of index lists in emission order.  Exposed so probes/tests
+        census the SAME plan the hot path uses."""
+        from ._memory_utility import plan_buckets
+        if self.exchange == "per_leaf":
+            return [[i] for i in reversed(range(len(shapes)))]
+        if self.exchange == "flat":
+            return [list(reversed(range(len(shapes))))] if shapes else []
+        return plan_buckets(shapes, dtypes,
+                            int(self.bucket_mb * 2 ** 20))
+
+    @staticmethod
+    def grad_leaf_specs(model):
+        """``(shapes, dtypes)`` of ``model``'s params in the order
+        ``grad_transform`` plans over: the params-tree FLATTEN order
+        (sorted dict keys), NOT ``Link.params()`` registration order —
+        the two orders yield different plans, so every bucket census
+        must extract leaves through this one helper."""
+        from ..core.link import extract_state
+        leaves = jax.tree.leaves(extract_state(model)["params"])
+        return [p.shape for p in leaves], [p.dtype for p in leaves]
+
+    def grad_buckets_for(self, model):
+        """The bucket plan ``grad_transform`` traces for ``model``'s
+        gradients (leaves in hot-path order, post dtype-compression)."""
+        shapes, dtypes = self.grad_leaf_specs(model)
+        if self.allreduce_grad_dtype is not None:
+            dtypes = [self.allreduce_grad_dtype] * len(dtypes)
+        return self.grad_buckets(shapes, dtypes)
+
     def grad_transform(self):
         """Return ``grads -> grads`` for use inside a compiled train step.
 
         Implements the reference's ``allreduce_grad`` data path (SURVEY
-        §3.2): optional cast to the compressed dtype (N3), one fused
-        mean-``psum`` over the communicator axis, cast back.  With
-        ``batch_collectives`` (the ``flat`` flavor, N2) gradients are
-        first flattened into a single contiguous bucket so the collective
-        is one large transfer.
+        §3.2): optional cast to the compressed dtype (N3), mean-``psum``
+        over the communicator axis, cast back.  The collective structure
+        follows ``batch_collectives``:
+
+        * ``False`` — one ``pmean`` per leaf (the ``naive`` flavor).
+        * ``True`` — gradients flatten into ONE contiguous bucket (the
+          ``flat`` flavor, N2): one large transfer, but it cannot start
+          until the LAST gradient exists and the update waits for the
+          whole round trip.
+        * ``"bucketed"`` — K size-bounded buckets (``bucket_mb``) in
+          reverse parameter-registration order: the reference pure_nccl
+          pipeline's schedulable units.  Early buckets' collectives
+          cover late backward compute under XLA's async scheduler, and
+          the update of late-registered params can begin before early
+          buckets land.
+
+        All three produce bitwise-identical results (``pmean`` is
+        elementwise — packing changes the schedule, not the math;
+        golden-pinned by tests/core_tests/test_exchange_equivalence.py).
+        Packing goes through ``_memory_utility.tree_pack``/``tree_unpack``
+        — the one pack/unpack implementation (shared with ZeRO and the
+        reduce-scatter update).
         """
         axis = self.axis_name
         dtype = self.allreduce_grad_dtype
-        flat_bucket = self.batch_collectives
+        comm = self
 
         def transform(grads):
+            from ._memory_utility import tree_pack, tree_unpack
             leaves, treedef = jax.tree.flatten(grads)
             if not leaves:
                 return grads
             orig_dtypes = [g.dtype for g in leaves]
             if dtype is not None:
                 leaves = [g.astype(dtype) for g in leaves]
-            if flat_bucket:
-                shapes = [g.shape for g in leaves]
-                sizes = [int(np.prod(s)) for s in shapes]
-                bucket = jnp.concatenate([g.reshape(-1) for g in leaves])
-                bucket = lax.pmean(bucket, axis)
-                outs = []
-                offset = 0
-                for shape, n in zip(shapes, sizes):
-                    outs.append(bucket[offset:offset + n].reshape(shape))
-                    offset += n
-                leaves = outs
-            else:
-                leaves = [lax.pmean(g, axis) for g in leaves]
-            leaves = [g.astype(d) for g, d in zip(leaves, orig_dtypes)]
+            buckets = comm.grad_buckets([g.shape for g in leaves],
+                                        [g.dtype for g in leaves])
+            out = [None] * len(leaves)
+            for idx in buckets:
+                if len(idx) == 1:
+                    # single-leaf bucket: skip the pack/unpack reshape
+                    # noise (identical math, cleaner program)
+                    out[idx[0]] = lax.pmean(leaves[idx[0]], axis)
+                    continue
+                with jax.named_scope("mn_bucket_pmean"):
+                    flat, spec = tree_pack([leaves[i] for i in idx])
+                    flat = lax.pmean(flat, axis)
+                    for i, g in zip(idx, tree_unpack(flat, spec)):
+                        out[i] = g
+            leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
             return jax.tree.unflatten(treedef, leaves)
 
         return transform
@@ -615,6 +707,7 @@ class MeshCommunicator(CommunicatorBase):
                 axis_name=f"{self.axis_name}_s{c}",
                 allreduce_grad_dtype=self.allreduce_grad_dtype,
                 batch_collectives=self.batch_collectives,
+                bucket_mb=self.bucket_mb,
                 name=self.name))
         return comms
 

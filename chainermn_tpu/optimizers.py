@@ -8,10 +8,21 @@ The reference interposes ``communicator.allreduce_grad(target)`` between
 ``loss.backward()`` and ``optimizer.update()`` as a separate host-driven
 step (pack kernel → NCCL → unpack kernel).  Here the *entire* data-parallel
 step — per-rank forward/backward on the local batch shard, gradient mean
-over the communicator axis (optionally dtype-compressed / flat-bucketed),
+over the communicator axis (optionally dtype-compressed / flat- or
+size-bounded-bucketed, per the communicator's ``batch_collectives``),
 and the optax update — is one ``shard_map``ped, jit-compiled program:
-SURVEY §3.2's "this whole stack becomes ONE train_step".  XLA overlaps the
-gradient collective with remaining backward compute automatically.
+SURVEY §3.2's "this whole stack becomes ONE train_step".  XLA's
+async-collective scheduler overlaps the gradient collectives with
+remaining backward compute; the ``"bucketed"`` exchange hands it K
+independently schedulable units instead of one monolithic transfer
+(docs/performance.md §7, tools/comm_budgets.json).
+
+``exchange="reduce_scatter"`` replaces the allreduce-then-replicated-
+update structure with ``reduce_scatter(grads) → shard-local update →
+all_gather(params)``: per-replica exchanged gradient bytes are halved
+(the gradient crosses the wire once), the optimizer state lives
+shard-local, and — unlike ``zero_sharding`` — it composes with double
+buffering (the stale buffer is the 1/n mean-gradient chunk).
 
 Batch convention (single-controller translation of "each rank feeds its
 local batch"): ``update(lossfun, *args)`` receives the *global* batch
@@ -48,11 +59,32 @@ __all__ = ["create_multi_node_optimizer", "_MultiNodeOptimizer",
 
 def create_multi_node_optimizer(actual_optimizer, communicator,
                                 double_buffering=False, zero_fill=True,
-                                zero_sharding=False):
+                                zero_sharding=False, exchange=None):
     """Wrap an optimizer so updates average gradients over the communicator.
 
     Reference signature and delegation semantics preserved: the returned
     object forwards attribute access to ``actual_optimizer``.
+
+    ``exchange`` selects the gradient-exchange structure of the compiled
+    DP step (docs/performance.md §7):
+
+    * ``"allreduce"`` (default) — mean-``psum`` of the full gradient via
+      the communicator's ``grad_transform`` (per-leaf / flat / bucketed
+      per its ``batch_collectives``), then the replicated update.
+    * ``"reduce_scatter"`` — the comm-optimal DP update:
+      ``reduce_scatter(grads) → shard-local optimizer update →
+      all_gather(params)``.  The gradient crosses the wire ONCE instead
+      of twice — per-replica exchanged gradient bytes are halved vs any
+      allreduce flavor (tools/comm_budgets.json commits the accounting)
+      — and the optimizer state is maintained shard-local as a
+      consequence (each rank only ever sees its 1/n gradient chunk), so
+      it shares ZeRO-1's observable contract: ``Parameter.grad`` is not
+      populated and the serialized optimizer state is the flat sharded
+      vector.  Unlike ``zero_sharding`` it composes with
+      ``double_buffering`` (the one-step-stale buffer is the sharded
+      mean-gradient CHUNK — 1/n of a full stale buffer).  Trajectories
+      are golden-equal to the allreduce flavors
+      (tests/core_tests/test_exchange_equivalence.py).
 
     ``zero_sharding=True`` (beyond the reference — ZeRO-1 over the DP
     axis, TPU-idiomatic): the gradient mean becomes a ``psum_scatter``
@@ -63,8 +95,22 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
     2×params/n).  Observable differences, documented: ``Parameter.grad``
     is not populated (the full mean gradient never materializes) and the
     serialized optimizer state is the flat sharded vector, not the
-    per-parameter tree.
+    per-parameter tree.  ``zero_sharding`` already implies the
+    reduce-scatter exchange; passing both is a redundancy error.
     """
+    if exchange is None:
+        exchange = "allreduce"
+    if exchange not in ("allreduce", "reduce_scatter"):
+        raise ValueError(
+            f"exchange must be 'allreduce' or 'reduce_scatter', got "
+            f"{exchange!r} (per_leaf/flat/bucketed are communicator "
+            f"batch_collectives flavors of the allreduce exchange)")
+    if zero_sharding and exchange == "reduce_scatter":
+        raise ValueError(
+            "zero_sharding already exchanges gradients via reduce-scatter; "
+            "exchange='reduce_scatter' on top of it is a redundancy error "
+            "(pick one: zero_sharding=True for the ZeRO-1 contract, "
+            "exchange='reduce_scatter' for the comm-optimal plain-DP step)")
     if double_buffering:
         if zero_sharding:
             raise ValueError(
@@ -79,24 +125,35 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
                 "double buffering requires a fused-bucket communicator "
                 f"(reference: pure_nccl); got {communicator.name!r}")
         return _DoubleBufferingOptimizer(actual_optimizer, communicator,
-                                         zero_fill)
+                                         zero_fill, exchange=exchange)
     return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill,
-                               zero_sharding=zero_sharding)
+                               zero_sharding=zero_sharding,
+                               exchange=exchange)
 
 
 class _MultiNodeOptimizer:
     def __init__(self, actual_optimizer, communicator, zero_fill=True,
-                 zero_sharding=False):
+                 zero_sharding=False, exchange="allreduce"):
         super().__setattr__("communicator", communicator)
         super().__setattr__("actual_optimizer", actual_optimizer)
         super().__setattr__("zero_fill", zero_fill)
         super().__setattr__("zero_sharding", zero_sharding)
+        super().__setattr__("exchange", exchange)
         super().__setattr__("_zero_layout", None)  # (spec, n, n_pad)
         from .core.optimizer import _LRUCache
         super().__setattr__("_mn_step_cache", _LRUCache())
         super().__setattr__("_stale_grads", None)  # double-buffer slot
 
     _double_buffering = False
+
+    @property
+    def _sharded_update(self):
+        """True when the compiled step updates flat parameter CHUNKS
+        after a reduce-scatter (ZeRO-1, or the comm-optimal plain-DP
+        ``exchange="reduce_scatter"``) — the paths that share the flat
+        sharded optimizer state, its serialization, and the
+        grad-not-populated contract."""
+        return self.zero_sharding or self.exchange == "reduce_scatter"
 
     # -- reference-style delegation ---------------------------------------
     def __getattr__(self, name):
@@ -145,21 +202,28 @@ class _MultiNodeOptimizer:
             self.communicator.verify_step_signature((args, kwargs))
         state = extract_state(actual.target)
         params, pstate = state["params"], state["state"]
-        if self.zero_sharding:
+        if self._sharded_update:
             opt_state = self._ensure_zero_opt_state(params)
         else:
             opt_state = actual._ensure_opt_state(params)
         key = actual._cache_key(lossfun, args, kwargs) \
-            + (self._double_buffering, self.zero_sharding)
+            + (self._double_buffering, self._sharded_update)
         step = self._mn_step_cache.get(key)
         if step is None:
             step = (self._make_zero_step(lossfun, args, kwargs)
-                    if self.zero_sharding
+                    if self._sharded_update
                     else self._make_step(lossfun, args, kwargs))
             self._mn_step_cache[key] = step
 
         if self._double_buffering and self._stale_grads is None:
-            zeros = jax.tree.map(jnp.zeros_like, params)
+            if self._sharded_update:
+                # the stale buffer is the reduce-scattered mean-gradient
+                # CHUNK (flat, padded, f32 — 1/n of a full stale tree on
+                # each rank); first update applies zeros, same contract
+                _, _, n_pad = self._zero_layout
+                zeros = jnp.zeros((n_pad,), jnp.float32)
+            else:
+                zeros = jax.tree.map(jnp.zeros_like, params)
             super().__setattr__("_stale_grads", zeros)
         stale = (self._stale_grads,) if self._double_buffering else ()
         operands = (params, pstate, opt_state, actual._hyper_values(),
@@ -176,7 +240,12 @@ class _MultiNodeOptimizer:
             # the donated stale buffer is rebound to this step's fresh
             # mean gradient — through the wrapper, never a raw alias
             super().__setattr__("_stale_grads", grads)
-        actual._write_back(new_params, new_pstate, grads)
+        # sharded updates never materialize the full mean gradient, so
+        # Parameter.grad stays unpopulated (documented ZeRO contract;
+        # under double buffering ``grads`` is the flat fresh CHUNK and
+        # must not be scattered onto per-param slots)
+        actual._write_back(new_params, new_pstate,
+                           None if self._sharded_update else grads)
         actual._opt_state = new_opt_state
         actual.t += 1
         reporter_module.report(obs)
@@ -220,11 +289,20 @@ class _MultiNodeOptimizer:
             and leaf.shape[0] == n_pad else P(), opt_state)
 
     def _make_zero_update(self):
-        """Shared ZeRO core (per-step AND scan step makers): flat-pack
-        grads → reduce-scatter (each rank receives the SUM of its own
-        1/n segment — the reference's allreduce splits into
-        reduce_scatter + all_gather; ZeRO stops halfway and updates in
-        the scattered domain) → chunk update → all-gather → unpack."""
+        """Shared reduce-scatter core (ZeRO-1 AND the plain-DP
+        ``exchange="reduce_scatter"`` step, per-step AND scan makers):
+        flat-pack grads → reduce-scatter (each rank receives the SUM of
+        its own 1/n segment — the reference's allreduce splits into
+        reduce_scatter + all_gather; this path stops halfway and updates
+        in the scattered domain) → chunk update → all-gather(params) →
+        unpack.
+
+        ``stale_chunk`` (double buffering × reduce-scatter): the update
+        applies the PREVIOUS step's reduce-scattered mean-gradient chunk
+        while this step's fresh chunk is returned to become the next
+        stale buffer — the reference's one-step-stale semantics at 1/n
+        of the stale-buffer footprint.
+        """
         from .communicators._memory_utility import tree_pack, tree_unpack
         from .core.optimizer import apply_transform_update
         comm = self.communicator
@@ -235,7 +313,7 @@ class _MultiNodeOptimizer:
         chunk = n_pad // size
         grad_dtype = comm.allreduce_grad_dtype
 
-        def zero_update(params, grads, opt_state, hyper):
+        def zero_update(params, grads, opt_state, hyper, stale_chunk=None):
             with jax.named_scope("zero_reduce_scatter_grad"):
                 gflat, _ = tree_pack(grads)
                 gflat = jnp.pad(gflat, (0, n_pad - n))
@@ -250,12 +328,13 @@ class _MultiNodeOptimizer:
                 pchunk = lax.dynamic_slice_in_dim(
                     pflat, lax.axis_index(axis) * chunk, chunk)
                 new_pchunk, new_opt_state = apply_transform_update(
-                    tx, gchunk, opt_state, pchunk, hyper["lr"],
+                    tx, gchunk if stale_chunk is None else stale_chunk,
+                    opt_state, pchunk, hyper["lr"],
                     hyper.get("decoupled_wd", 0.0))
             with jax.named_scope("zero_all_gather_params"):
                 new_flat = lax.all_gather(new_pchunk, axis, tiled=True)
                 new_params = tree_unpack(new_flat, spec)
-            return new_params, new_opt_state
+            return new_params, new_opt_state, gchunk
 
         return zero_update
 
@@ -266,37 +345,49 @@ class _MultiNodeOptimizer:
         actual = self.actual_optimizer
         axis = comm.axis_name
         size = comm.size
+        double_buffering = self._double_buffering
         zero_update = self._make_zero_update()
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
         def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
                       args, kwargs):
-            del stale  # double buffering is rejected for ZeRO at creation
             rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
             with jax.named_scope("zero_forward_backward"):
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_local, args, kwargs)
-            new_params, new_opt_state = zero_update(params, grads,
-                                                    opt_state, hyper)
+            new_params, new_opt_state, fresh_chunk = zero_update(
+                params, grads, opt_state, hyper,
+                stale[0] if double_buffering else None)
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
             new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis),
                                       new_pstate)
-            # None grads: the full mean gradient never exists under ZeRO
-            return new_params, new_pstate, new_opt_state, loss, None, obs
+            # grads out: the fresh mean-gradient CHUNK under double
+            # buffering (it becomes the next stale buffer); otherwise
+            # None — the full mean gradient never exists on this path
+            out_grads = fresh_chunk if double_buffering else None
+            return new_params, new_pstate, new_opt_state, loss, \
+                out_grads, obs
 
         args_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
         opt_specs = self._zero_state_spec(actual._opt_state, axis)
+        # the stale chunk is sharded like the opt state's flat leaves
+        stale_spec = P(axis) if double_buffering else P()
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
-            in_specs=(P(), P(), opt_specs, P(), P(), P(), args_specs,
-                      kwargs_specs),
-            out_specs=(P(), P(), opt_specs, P(), P(), P()),
+            in_specs=(P(), P(), opt_specs, P(), P(), stale_spec,
+                      args_specs, kwargs_specs),
+            out_specs=(P(), P(), opt_specs, P(), stale_spec, P()),
             check_vma=False)
-        donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
+        if getattr(actual, "donate_params", True):
+            # under double buffering the stale chunk (argnum 5) is
+            # replaced by this step's fresh chunk — donate it too
+            donate = (0, 2, 5) if double_buffering else (0, 2)
+        else:
+            donate = (2,)
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- compiled DP step ------------------------------------------------------
@@ -441,16 +532,16 @@ class _MultiNodeOptimizer:
             self.communicator.verify_step_signature((args, kwargs))
         state = extract_state(actual.target)
         params, pstate = state["params"], state["state"]
-        if self.zero_sharding:
+        if self._sharded_update:
             opt_state = self._ensure_zero_opt_state(params)
         else:
             opt_state = actual._ensure_opt_state(params)
-        key = ("scan", n_steps, self.zero_sharding) \
+        key = ("scan", n_steps, self._sharded_update) \
             + actual._cache_key(lossfun, args, kwargs)
         step = self._mn_step_cache.get(key)
         if step is None:
             step = (self._make_zero_scan_step(lossfun, args, kwargs, n_steps)
-                    if self.zero_sharding
+                    if self._sharded_update
                     else self._make_scan_step(lossfun, args, kwargs, n_steps))
             self._mn_step_cache[key] = step
         operands = (params, pstate, opt_state, actual._hyper_values(),
@@ -552,8 +643,8 @@ class _MultiNodeOptimizer:
                 rng_i = jax.random.fold_in(rng_rank, i)
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_i, s_args, s_kwargs)
-                new_params, new_opt_state = zero_update(params, grads,
-                                                        opt_state, hyper)
+                new_params, new_opt_state, _ = zero_update(
+                    params, grads, opt_state, hyper)
                 return ((new_params, new_pstate, new_opt_state, i + 1),
                         (loss, obs))
 
@@ -667,7 +758,7 @@ class _MultiNodeOptimizer:
 
     def serialize(self, serializer):
         actual = self.actual_optimizer
-        if self.zero_sharding and not serializer.is_writer \
+        if self._sharded_update and not serializer.is_writer \
                 and actual.target is not None and self._zero_layout is None:
             # The saved opt_state leaves are flat (n_pad,) vectors.  The
             # base reader builds its template from the CURRENT _opt_state
@@ -690,7 +781,7 @@ class _MultiNodeOptimizer:
                 actual._opt_state = None
                 self._ensure_zero_opt_state(params)
         device_state = None
-        if serializer.is_writer and self.zero_sharding \
+        if serializer.is_writer and self._sharded_update \
                 and actual._opt_state is not None \
                 and any(isinstance(l, jax.Array)
                         and not l.is_fully_addressable
@@ -704,7 +795,7 @@ class _MultiNodeOptimizer:
         finally:
             if device_state is not None:
                 actual._opt_state = device_state
-        if self.zero_sharding and not serializer.is_writer \
+        if self._sharded_update and not serializer.is_writer \
                 and actual._opt_state is not None \
                 and self._zero_layout is not None:
             actual._opt_state = self._commit_opt_state_to_mesh(
@@ -719,7 +810,15 @@ class _MultiNodeOptimizer:
             sub = serializer["stale_grads"]
             if serializer.is_writer:
                 if self._stale_grads is not None:
-                    serialize_flat_tree(sub, self._stale_grads, "n", "g")
+                    # reduce-scatter double buffering on a real
+                    # multi-controller mesh: the stale buffer is
+                    # P(axis)-sharded (each process holds its 1/n
+                    # chunk) and np.asarray on it raises — same
+                    # host-gather the opt_state write gets above
+                    serialize_flat_tree(
+                        sub,
+                        self._gather_opt_state_to_host(self._stale_grads),
+                        "n", "g")
                 return
             if actual.target is None:
                 return  # target-less load: base serialize skipped too
@@ -727,8 +826,47 @@ class _MultiNodeOptimizer:
             if not params or any(v is None for v in params.values()):
                 super().__setattr__("_stale_grads", None)
                 return
-            template = jax.tree.map(jnp.zeros_like, params)
+            if self._sharded_update:
+                # reduce-scatter double buffering: the stale buffer is
+                # the flat padded mean-gradient vector, not a per-param
+                # tree.  Its length is derivable from params alone, so
+                # compute it directly rather than depending on the
+                # opt-state pre-seed having run.
+                if self._zero_layout is not None:
+                    _, n, n_pad = self._zero_layout
+                else:
+                    from .communicators._memory_utility import tree_pack
+                    n = tree_pack(params)[0].shape[0]
+                    size = self.communicator.size
+                    n_pad = -(-n // size) * size
+                template = jnp.zeros((n_pad,), jnp.float32)
+            else:
+                template = jax.tree.map(jnp.zeros_like, params)
             restored = deserialize_flat_tree(sub, template, "n", "g")
+            if self._sharded_update and restored is not None and not (
+                    isinstance(restored, jax.Array)
+                    and not restored.is_fully_addressable):
+                if restored.shape != template.shape \
+                        and restored.shape[0] >= n:
+                    # saved under a DIFFERENT communicator size: the
+                    # vector is padded to the old size's multiple, but
+                    # content length n is invariant — slice and re-pad,
+                    # the same size-changed resume contract
+                    # _commit_opt_state_to_mesh gives the flat opt-state
+                    # leaves
+                    restored = jnp.pad(jnp.asarray(restored)[:n],
+                                       (0, n_pad - n))
+                # commit to the P(axis) layout the compiled step's
+                # shard_map expects — on a real multi-controller mesh the
+                # host-replicated restore cannot be auto-sharded at
+                # dispatch (same reason the opt-state restore goes
+                # through _commit_opt_state_to_mesh)
+                host = np.asarray(restored)
+                sharding = jax.sharding.NamedSharding(
+                    self.communicator.mesh,
+                    P(self.communicator.axis_name))
+                restored = jax.make_array_from_callback(
+                    host.shape, sharding, lambda idx: host[idx])
             # None restored = snapshot predates stale-grad saving (or was
             # taken before the first update): fresh zero-seed semantics
             super().__setattr__("_stale_grads", restored)

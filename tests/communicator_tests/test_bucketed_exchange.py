@@ -1,0 +1,146 @@
+"""Bucketed gradient exchange: the plan's cross-rank contract.
+
+The per-bucket collectives only line up across ranks because every rank
+traces the IDENTICAL partition from the identical (shapes, dtypes,
+bound) inputs — these tests pin the properties that contract rests on
+(ISSUE 5 satellite: every leaf in exactly one bucket, deterministic
+order), plus the knob plumbing.  Numeric equivalence of the exchange
+flavors lives in tests/core_tests/test_exchange_equivalence.py.
+"""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as ct
+from chainermn_tpu.communicators._memory_utility import (
+    DEFAULT_BUCKET_MB, bucket_table, exchanged_bytes, plan_buckets)
+
+
+def _random_cases(n_cases=30, seed=0):
+    rng = np.random.RandomState(seed)
+    dtypes = ["float32", "bfloat16", "float16", "int32"]
+    for _ in range(n_cases):
+        n = int(rng.randint(1, 40))
+        shapes = []
+        dts = []
+        for _ in range(n):
+            nd = int(rng.randint(0, 4))
+            shapes.append(tuple(int(s) for s in rng.randint(1, 40, nd)))
+            dts.append(dtypes[int(rng.randint(len(dtypes)))])
+        bound = int(rng.choice([64, 512, 4096, 1 << 20]))
+        yield shapes, dts, bound
+
+
+def test_every_leaf_in_exactly_one_bucket():
+    for shapes, dts, bound in _random_cases():
+        buckets = plan_buckets(shapes, dts, bound)
+        flat = [i for b in buckets for i in b]
+        assert sorted(flat) == list(range(len(shapes))), \
+            (shapes, dts, bound)
+
+
+def test_reverse_registration_order():
+    """Buckets are emitted last-registered-parameter first, and leaves
+    within and across buckets stay in strict reverse leaf order — the
+    property that lets early buckets close while earlier layers'
+    gradients are still being computed."""
+    for shapes, dts, bound in _random_cases(seed=1):
+        buckets = plan_buckets(shapes, dts, bound)
+        flat = [i for b in buckets for i in b]
+        assert flat == list(reversed(range(len(shapes))))
+
+
+def test_deterministic_across_calls():
+    """Pure function of the inputs: two traces (two ranks) produce the
+    identical plan."""
+    for shapes, dts, bound in _random_cases(n_cases=10, seed=2):
+        assert plan_buckets(shapes, dts, bound) == \
+            plan_buckets(list(shapes), list(dts), bound)
+
+
+def test_size_bound_and_dtype_purity():
+    import jax.numpy as jnp
+    for shapes, dts, bound in _random_cases(seed=3):
+        for b in plan_buckets(shapes, dts, bound):
+            leaf_bytes = [int(np.prod(shapes[i]))
+                          * jnp.dtype(dts[i]).itemsize for i in b]
+            # a bucket exceeds the bound only as a single oversize leaf
+            assert sum(leaf_bytes) <= bound or len(b) == 1
+            assert len({jnp.dtype(dts[i]) for i in b}) == 1
+
+
+def test_bucket_table_accounts_every_byte():
+    shapes = [(128, 4), (33,), (), (256,)]
+    dts = ["float32"] * 4
+    rows = bucket_table(shapes, dts, 1024)
+    assert sum(r["elems"] for r in rows) == sum(
+        int(np.prod(s)) for s in shapes)
+    assert all(r["bytes"] == r["elems"] * 4 for r in rows)
+
+
+def test_plan_rejects_nonpositive_bound():
+    with pytest.raises(ValueError):
+        plan_buckets([(4,)], ["float32"], 0)
+
+
+def test_exchanged_bytes_formulas():
+    # ring accounting: allreduce = 2·(n-1)/n, rs/ag = (n-1)/n, 1 rank = 0
+    assert exchanged_bytes(800, 8, "psum") == 1400
+    assert exchanged_bytes(800, 8, "reduce_scatter") == 700
+    assert exchanged_bytes(800, 8, "all_gather") == 700
+    assert exchanged_bytes(800, 1, "psum") == 0
+    with pytest.raises(ValueError):
+        exchanged_bytes(8, 8, "alltoall")
+
+
+def test_communicator_bucket_knobs():
+    comm = ct.create_communicator("jax_ici",
+                                  batch_collectives="bucketed")
+    assert comm.exchange == "bucketed"
+    assert comm.bucket_mb == DEFAULT_BUCKET_MB
+    comm = ct.create_communicator("jax_ici",
+                                  batch_collectives="bucketed",
+                                  bucket_mb=0.5)
+    assert comm.bucket_mb == 0.5
+    assert ct.create_communicator("jax_ici").exchange == "flat"
+    assert ct.create_communicator("naive").exchange == "per_leaf"
+    with pytest.raises(ValueError, match="batch_collectives"):
+        ct.create_communicator("jax_ici", batch_collectives="chunky")
+    with pytest.raises(ValueError, match="bucket_mb"):
+        ct.create_communicator("jax_ici", bucket_mb=-1)
+
+
+def test_bucket_mb_env_knob(monkeypatch):
+    monkeypatch.setenv("CHAINERMN_TPU_BUCKET_MB", "2.5")
+    comm = ct.create_communicator("jax_ici",
+                                  batch_collectives="bucketed")
+    assert comm.bucket_mb == 2.5
+    # explicit argument wins over the env
+    comm = ct.create_communicator("jax_ici",
+                                  batch_collectives="bucketed",
+                                  bucket_mb=1.0)
+    assert comm.bucket_mb == 1.0
+
+
+def test_split_propagates_bucket_config():
+    comm = ct.create_communicator("jax_ici",
+                                  batch_collectives="bucketed",
+                                  bucket_mb=2.0)
+    subs = comm.split_all(0, 0)
+    assert all(s.batch_collectives == "bucketed" and s.bucket_mb == 2.0
+               for s in subs)
+
+
+def test_grad_buckets_matches_plan():
+    """grad_buckets (what probes/tests census) is the SAME plan the hot
+    path traces, for all three exchange flavors."""
+    shapes = [(100,), (200,), (300,)]
+    dts = ["float32"] * 3
+    comm = ct.create_communicator("jax_ici", batch_collectives="bucketed",
+                                  bucket_mb=1600 / 2 ** 20)
+    assert comm.grad_buckets(shapes, dts) == \
+        plan_buckets(shapes, dts, 1600)
+    flat = ct.create_communicator("jax_ici")
+    assert flat.grad_buckets(shapes, dts) == [[2, 1, 0]]
+    naive = ct.create_communicator("naive")
+    assert naive.grad_buckets(shapes, dts) == [[2], [1], [0]]

@@ -1,0 +1,189 @@
+"""Golden equality of every gradient-exchange variant (ISSUE 5).
+
+The exchange structure — per-leaf psums, one flat bucket, K size-bounded
+buckets, or reduce-scatter + shard update + all-gather — changes the
+SCHEDULE of the DP step, never its math.  Golden rule (SURVEY §4): each
+variant's trajectory must EQUAL the single-device run on the merged
+batch; the allreduce packings must be BITWISE equal to each other
+(pmean is elementwise), and the reduce-scatter update must match to
+f32 reduction-order noise.  Composition axes from the ISSUE grid:
+{donation, double buffering, compressed dtype} × the four exchanges.
+
+Compile budget: every run here is a small MLP step (~1 s CPU compile);
+the grid is kept to ~a dozen compiles so the suite stays tier-1-cheap.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu.core.optimizer import SGD, MomentumSGD
+from chainermn_tpu.models import Classifier, MLP
+
+STEPS = 3
+#: tiny bound so even the toy MLP splits into several buckets
+TINY_BUCKET_MB = 2000 / 2 ** 20
+
+_BC = {"per_leaf": False, "flat": True, "bucketed": "bucketed"}
+
+
+def _data(seed=0, n=32, d=8, k=4):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32)),
+            jnp.asarray(rng.randint(0, k, n).astype(np.int32)))
+
+
+def _model():
+    return Classifier(MLP(n_units=16, n_out=4, seed=0))
+
+
+def _run(exchange, double_buffering=False, donate=True, grad_dtype=None,
+         steps=STEPS, opt_cls=MomentumSGD, **opt_kw):
+    """Trajectory (losses, params) of one exchange variant.
+
+    ``exchange``: per_leaf | flat | bucketed (communicator flavors of
+    the allreduce) | reduce_scatter (the optimizer-level step variant).
+    """
+    opt_kw = opt_kw or dict(lr=0.1, momentum=0.9)
+    comm = ct.create_communicator(
+        "jax_ici",
+        batch_collectives=_BC.get(exchange, True),
+        bucket_mb=TINY_BUCKET_MB if exchange == "bucketed" else None,
+        allreduce_grad_dtype=grad_dtype)
+    model = _model()
+    comm.bcast_data(model)
+    inner = opt_cls(**opt_kw)
+    inner.donate_params = donate
+    opt = ct.create_multi_node_optimizer(
+        inner, comm, double_buffering=double_buffering,
+        exchange="reduce_scatter" if exchange == "reduce_scatter"
+        else "allreduce").setup(model)
+    x, t = _data()
+    losses = [float(opt.update(model, x, t)) for _ in range(steps)]
+    return losses, [np.asarray(p.array) for p in model.params()], opt
+
+
+def _golden(steps=STEPS, opt_cls=MomentumSGD, **opt_kw):
+    """Single-device trajectory on the merged batch (the golden rule's
+    reference point — no communicator at all)."""
+    opt_kw = opt_kw or dict(lr=0.1, momentum=0.9)
+    model = _model()
+    opt = opt_cls(**opt_kw).setup(model)
+    x, t = _data()
+    losses = [float(opt.update(model, x, t)) for _ in range(steps)]
+    return losses, [np.asarray(p.array) for p in model.params()]
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return _golden()
+
+
+@pytest.mark.parametrize("exchange",
+                         ["per_leaf", "flat", "bucketed",
+                          "reduce_scatter"])
+def test_exchange_matches_single_device_golden(exchange, golden):
+    """Acceptance bar: all exchange variants golden-equal to the
+    single-device trajectory on the CPU mesh."""
+    glosses, gparams = golden
+    losses, params, _ = _run(exchange)
+    np.testing.assert_allclose(losses, glosses, rtol=1e-5, atol=1e-7,
+                               err_msg=f"{exchange} losses diverged")
+    for a, g in zip(params, gparams):
+        np.testing.assert_allclose(a, g, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{exchange} params diverged")
+
+
+def test_allreduce_packings_bitwise_equal():
+    """per-leaf == flat == bucketed BITWISE: packing changes the
+    schedule, not the math (pmean is elementwise)."""
+    ref = _run("per_leaf")
+    for exchange in ("flat", "bucketed"):
+        losses, params, _ = _run(exchange)
+        assert losses == ref[0], f"{exchange} losses differ bitwise"
+        for a, b in zip(params, ref[1]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_double_buffering_grid_equal():
+    """Double buffering × {flat, bucketed, reduce_scatter}: the
+    one-step-stale semantics are exchange-independent (first update
+    applies zeros, update t applies grads of t-1) — including the
+    reduce-scatter variant, whose stale buffer is the sharded chunk."""
+    ref = _run("flat", double_buffering=True, steps=4)
+    # stale application is observable: step 2's loss equals step 1's
+    assert ref[0][0] == ref[0][1]
+    for exchange in ("bucketed", "reduce_scatter"):
+        losses, params, _ = _run(exchange, double_buffering=True, steps=4)
+        np.testing.assert_allclose(losses, ref[0], rtol=1e-5, atol=1e-7,
+                                   err_msg=f"db×{exchange} diverged")
+        for a, b in zip(params, ref[1]):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_donation_off_matches_donation_on():
+    """The donation axis of the grid, on the new reduce-scatter step:
+    buffer aliasing must not change the trajectory."""
+    on = _run("reduce_scatter", donate=True)
+    off = _run("reduce_scatter", donate=False)
+    np.testing.assert_allclose(on[0], off[0], rtol=1e-6, atol=1e-8)
+    for a, b in zip(on[1], off[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_compressed_dtype_composes():
+    """bf16 gradient compression per bucket: bucketed and flat compress
+    identically (bitwise — same cast, same elementwise mean), and the
+    compressed reduce-scatter step stays finite and learns.  bf16 is
+    NOT golden-exact vs f32 by design, so no golden assert here."""
+    flat = _run("flat", grad_dtype="bfloat16")
+    bucketed = _run("bucketed", grad_dtype="bfloat16")
+    assert flat[0] == bucketed[0]
+    for a, b in zip(flat[1], bucketed[1]):
+        np.testing.assert_array_equal(a, b)
+    rs_losses, _, _ = _run("reduce_scatter", grad_dtype="bfloat16",
+                           steps=5)
+    assert np.isfinite(rs_losses).all() and rs_losses[-1] < rs_losses[0]
+
+
+def test_reduce_scatter_grad_not_populated():
+    """The documented sharded-update contract holds for the plain-DP
+    reduce-scatter step too: the full mean gradient never materializes,
+    so Parameter.grad stays None."""
+    _, _, opt = _run("reduce_scatter")
+    assert all(p.grad is None for p in opt.target.params())
+
+
+def test_reduce_scatter_update_scan_continues_trajectory(golden):
+    """exchange="reduce_scatter" × fused K-step dispatch: the scan
+    continues the SAME trajectory as the golden run's steps 4-5."""
+    glosses, _ = _golden(steps=5)
+    losses, _, opt = _run("reduce_scatter", steps=3)
+    x, t = _data()
+    scan_losses = np.asarray(opt.update_scan(
+        opt.target, jnp.stack([x, x]), jnp.stack([t, t])))
+    np.testing.assert_allclose(list(losses) + list(scan_losses), glosses,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_double_buffered_reduce_scatter_resume_bit_exact(tmp_path):
+    """Serialize → restore → continue must be bit-exact for the
+    reduce-scatter double-buffering pair: the stale CHUNK is observable
+    state (without it a resumed run would apply zeros on its first
+    update)."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    losses_a, _, opt = _run("reduce_scatter", double_buffering=True,
+                            steps=2)
+    save_npz(path, opt)
+    cont_ref = [float(opt.update(opt.target, x, t)) for _ in range(2)]
+
+    _, _, fresh = _run("reduce_scatter", double_buffering=True, steps=1)
+    load_npz(path, fresh)
+    cont = [float(fresh.update(fresh.target, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_ref, rtol=0, atol=0)

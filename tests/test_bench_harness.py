@@ -58,6 +58,16 @@ def _last_line(capsys):
     return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
 
 
+def _warm(model="resnet50"):
+    """Stamp the (tmp-redirected) prewarm sentinel: the stale-serving
+    scenarios model a WARM environment — an earlier run succeeded and
+    cached its datum.  Without the sentinel the run is first contact,
+    where the stale re-serve is refused by design (ISSUE 5 satellite:
+    three straight rounds of first-contact stale re-serves)."""
+    with open(bench._prewarm_sentinel(model), "w") as f:
+        f.write("warm 0\n")
+
+
 def test_cacheable_accepts_only_default_config_accelerator_runs():
     assert bench._cacheable(TPU_RESULT)
     assert not bench._cacheable(CPU_SMOKE)
@@ -222,6 +232,7 @@ def test_poisoned_tmp_slot_does_not_mask_repo_datum(cache_path, capsys,
     one slot further down."""
     monkeypatch.delenv("BENCH_MODEL", raising=False)
     monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    _warm()
     with open(cache_path, "w") as f:
         json.dump({"run_id": "plant", "saved_at": 0.0,
                    "result": CPU_SMOKE}, f)
@@ -378,6 +389,7 @@ def test_load_cache_backfills_fingerprint_missing_model_key(
     (the docstring's fingerprint-schema-bump tolerance)."""
     monkeypatch.delenv("BENCH_MODEL", raising=False)
     monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    _warm()
     fp = {k: v for k, v in
           bench._DEFAULT_FINGERPRINTS["resnet50"].items()
           if k != "model"}
@@ -410,6 +422,7 @@ def test_stale_reemit_serves_real_tpu_datum(cache_path, capsys,
                                             monkeypatch):
     monkeypatch.delenv("BENCH_MODEL", raising=False)
     monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    _warm()
     with open(cache_path, "w") as f:
         json.dump({"run_id": "earlier-run", "saved_at": 0.0,
                    "result": TPU_RESULT}, f)
@@ -442,6 +455,7 @@ def test_stale_reemit_serves_new_format_default_entry(cache_path, capsys,
                                                       monkeypatch):
     monkeypatch.delenv("BENCH_MODEL", raising=False)
     monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    _warm()
     with open(cache_path, "w") as f:
         json.dump({"entries": {TPU_RESULT["metric"]: {
             "run_id": "earlier-run", "saved_at": 0.0,
@@ -463,6 +477,7 @@ def test_stale_fp_override_restores_fallback_reserve(cache_path, capsys,
     monkeypatch.delenv("BENCH_MODEL", raising=False)
     monkeypatch.setenv("BENCH_RUN_ID", "current-run")
     monkeypatch.setenv("BENCH_BS", "8")  # the fallback child's cpu knob
+    _warm()
     with open(cache_path, "w") as f:
         json.dump({"entries": {TPU_RESULT["metric"]: {
             "run_id": "earlier-run", "saved_at": 0.0,
@@ -1171,3 +1186,133 @@ def test_longcontext_cpu_smoke_end_to_end(tmp_path):
     assert summary["rows"] and summary["xla_contrast"]["T"] == 64
     # the smoke must not have persisted anything as flagship data
     assert not os.path.exists(str(tmp_path / "cache.json"))
+
+
+# -- ISSUE 5: first-contact staleness + exchange variants --------------------
+
+
+def test_first_contact_refuses_stale_reserve(cache_path, capsys,
+                                             monkeypatch):
+    """VERDICT r5 Weak #1 (third straight stale round): with NO warm-
+    cache sentinel — a first-contact invocation — the stale path must
+    NOT re-serve the cached flagship, however valid.  Honest value:null
+    with the first-contact label instead."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setenv("BENCH_RUN_ID", "current-run")
+    with open(cache_path, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "earlier-run", "saved_at": 0.0,
+            "fingerprint": bench._DEFAULT_FINGERPRINTS["resnet50"],
+            "result": TPU_RESULT}}}, f)
+    bench._emit_stale_or_error("relay wedged")
+    out = _last_line(capsys)
+    assert out["value"] is None
+    assert "stale" not in out
+    assert out["first_contact"] is True
+    assert out["error"] == "relay wedged"
+    # the same cache WITH the sentinel still serves (warm-path contract)
+    _warm()
+    bench._emit_stale_or_error("relay wedged")
+    out = _last_line(capsys)
+    assert out["stale"] is True and out["value"] == TPU_RESULT["value"]
+
+
+def test_effective_steps_first_contact_short_steps(cache_path,
+                                                   monkeypatch):
+    """First contact + a deadline tighter than the first-contact default
+    clamps to the short-steps count (a FRESH row instead of measuring
+    into the deadline); a warm sentinel or an explicit BENCH_STEPS
+    restores full steps."""
+    monkeypatch.delenv("BENCH_STEPS", raising=False)
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    monkeypatch.setattr(bench, "_DEADLINE_S", 270.0)
+    assert bench._effective_steps(40) == (4, True)
+    monkeypatch.setenv("BENCH_SHORT_STEPS", "6")
+    assert bench._effective_steps(40) == (6, True)
+    monkeypatch.delenv("BENCH_SHORT_STEPS", raising=False)
+    # explicit BENCH_STEPS always wins
+    monkeypatch.setenv("BENCH_STEPS", "17")
+    assert bench._effective_steps(40) == (17, False)
+    monkeypatch.delenv("BENCH_STEPS", raising=False)
+    # a deadline at/above the first-contact default is not "tight"
+    monkeypatch.setattr(bench, "_DEADLINE_S", 480.0)
+    assert bench._effective_steps(40) == (40, False)
+    # warm sentinel: full steps even under the tight window
+    monkeypatch.setattr(bench, "_DEADLINE_S", 270.0)
+    _warm()
+    assert bench._effective_steps(40) == (40, False)
+
+
+def test_short_steps_row_never_flagship_cacheable(cache_path,
+                                                  monkeypatch):
+    """The short-steps fallback row measures a different amortization
+    regime: the payload gates must refuse it for the last-good cache
+    exactly like the recovery queue's BENCH_STEPS=4 prewarm."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    for name in ("BENCH_BS", "BENCH_STEPS", "BENCH_SCAN", "BENCH_EXCHANGE",
+                 "BENCH_BUCKET_MB"):
+        monkeypatch.delenv(name, raising=False)
+    short_row = dict(TPU_RESULT, n_steps=4, short_steps=True)
+    assert not bench._cacheable(short_row)
+    assert bench._cacheable(dict(TPU_RESULT, n_steps=40))
+
+
+@pytest.mark.slow
+def test_first_contact_wedge_never_returns_stale_rc0(tmp_path):
+    """The fault-injection pin: a first-contact invocation (no
+    sentinel) whose child wedges before any output, with a VALID cached
+    flagship available, exits rc=0 with an honest value:null line —
+    never '"stale": true'."""
+    cache = tmp_path / "cache.json"
+    with open(cache, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "earlier-run", "saved_at": 0.0,
+            "fingerprint": bench._DEFAULT_FINGERPRINTS["resnet50"],
+            "result": TPU_RESULT}}}, f)
+    out, _elapsed, _ = _run_supervised_wedge(
+        tmp_path, "1",
+        extra_env={"BENCH_PREWARM_SENTINEL": str(tmp_path / "prewarmed")})
+    assert out["value"] is None
+    assert "stale" not in out
+    assert out["first_contact"] is True
+
+
+@pytest.mark.slow
+def test_warm_wedge_still_serves_stale(tmp_path):
+    """Regression guard for the warm path: the SAME wedge with the
+    sentinel present must keep serving the cached flagship stale (the
+    outage resilience the cache exists for)."""
+    cache = tmp_path / "cache.json"
+    with open(cache, "w") as f:
+        json.dump({"entries": {TPU_RESULT["metric"]: {
+            "run_id": "earlier-run", "saved_at": 0.0,
+            "fingerprint": bench._DEFAULT_FINGERPRINTS["resnet50"],
+            "result": TPU_RESULT}}}, f)
+    (tmp_path / "prewarmed.resnet50").write_text("warm 0\n")
+    out, _elapsed, _ = _run_supervised_wedge(
+        tmp_path, "1",
+        extra_env={"BENCH_PREWARM_SENTINEL": str(tmp_path / "prewarmed")})
+    assert out["value"] == TPU_RESULT["value"]
+    assert out["stale"] is True
+
+
+def test_cacheable_rejects_exchange_variants(cache_path, monkeypatch):
+    """BENCH_EXCHANGE variants (the bucket sweep / reduce-scatter A/B
+    legs) compile different collective structures — never flagship
+    data, on either the fingerprint or the payload gate."""
+    monkeypatch.delenv("BENCH_MODEL", raising=False)
+    for name in ("BENCH_BS", "BENCH_STEPS", "BENCH_SCAN"):
+        monkeypatch.delenv(name, raising=False)
+    flagship = dict(TPU_RESULT, n_steps=40)
+    # env fingerprint gate
+    monkeypatch.setenv("BENCH_EXCHANGE", "bucketed")
+    monkeypatch.setenv("BENCH_BUCKET_MB", "8")
+    assert not bench._cacheable(dict(flagship, exchange="bucketed",
+                                     bucket_mb=8.0))
+    monkeypatch.delenv("BENCH_EXCHANGE", raising=False)
+    monkeypatch.delenv("BENCH_BUCKET_MB", raising=False)
+    # payload gate (a planted row claiming a variant exchange)
+    assert not bench._cacheable(dict(flagship, exchange="reduce_scatter"))
+    assert bench._cacheable(dict(flagship, exchange="flat"))
+    # legacy rows without the key were flat by construction
+    assert bench._cacheable(flagship)

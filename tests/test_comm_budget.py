@@ -1,0 +1,142 @@
+"""Gradient-exchange budget gate (ISSUE 5: the comm structure can't rot).
+
+Mirrors tests/test_flash_budget.py: tools/comm_budgets.json commits the
+DP step's collective structure and this gate holds every future PR to
+it.  Two layers:
+
+* STRUCTURE (backend-neutral, checked here on the simulated CPU mesh):
+  a jaxpr census of the REAL compiled step per exchange config —
+  per-leaf/flat/bucketed psum counts, the reduce-scatter step's
+  reduce_scatter+all_gather replacing the full-gradient allreduce, and
+  the exchanged-bytes accounting (gradient bytes exactly halved).
+  Verified against the traced program, not against documentation.
+* NUMBERS (measured on chip by the recovery queue's bucket sweep /
+  exposed-comm A/B): dormant while ``sweep.status`` is
+  ``pending_on_chip``; arms when rows are stamped ``measured``.
+
+The census traces all five committed configs over ONE shared vertical
+(model built once per process — see comm_census._Vertical), so the
+whole gate costs seconds, not minutes, of tier-1 time.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import comm_census  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def budgets():
+    with open(comm_census.BUDGETS_PATH) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def live(budgets):
+    """The live census of every committed config, traced once."""
+    import jax
+    assert len(jax.devices()) == budgets["vertical"]["n_devices"], \
+        "census devices != committed vertical (conftest pins 8)"
+    return {name: comm_census.config_row(name)
+            for name in comm_census.CONFIGS}
+
+
+def test_budget_schema(budgets):
+    assert set(budgets["structure"]) == set(comm_census.CONFIGS)
+    assert budgets["grad_elems_floor"] == comm_census.GRAD_ELEMS_FLOOR
+    v = budgets["vertical"]
+    assert {k: v[k] for k in comm_census.VERTICAL} == comm_census.VERTICAL
+    assert budgets["sweep"]["status"] in ("pending_on_chip", "measured")
+
+
+def test_structure_census_matches_committed(budgets, live):
+    """The machine check: the committed structure is what the step
+    TRACES today, config by config.  A PR that changes bucketing, the
+    packing, or the reduce-scatter wiring must regenerate the budgets
+    (tools/comm_census.py --write-budgets) and own the diff."""
+    for name, row in live.items():
+        committed = dict(budgets["structure"][name])
+        committed.pop("config", None)
+        assert row == committed, (
+            f"{name}: exchange structure drifted.\n traced    {row}\n "
+            f"committed {committed}\nRegenerate tools/comm_budgets.json "
+            "via `python tools/comm_census.py --write-budgets` if the "
+            "change is intentional.")
+
+
+def test_flat_is_one_collective(live):
+    assert live["flat"]["grad_collectives"] == {"psum": 1}
+
+
+def test_per_leaf_is_one_collective_per_param(live):
+    vert = comm_census._Vertical.get()
+    assert live["per_leaf"]["grad_collectives"]["psum"] == \
+        sum(1 for _ in vert.model.params())
+
+
+def test_bucketed_emits_multiple_bounded_buckets(budgets, live):
+    """The acceptance bar: K>1 collectives at the DEFAULT bucket size on
+    the transformer vertical, every bucket within the bound (a single
+    oversize leaf may exceed it alone — the embed/head matrices here
+    do, by design of the plan)."""
+    from chainermn_tpu.communicators._memory_utility import DEFAULT_BUCKET_MB
+    row = live["bucketed"]
+    k = row["grad_collectives"]["psum"]
+    assert k > 1, "bucketed exchange collapsed to one collective"
+    import jax.numpy as jnp
+    import numpy as np
+    bound = DEFAULT_BUCKET_MB * 2 ** 20
+    itemsize = jnp.dtype(row["grad_dtype"] or "float32").itemsize
+    sizes = [e * itemsize for e in row["grad_collective_elems"]["psum"]]
+    vert = comm_census._Vertical.get()
+    max_leaf = max(itemsize * int(np.prod(p.shape))
+                   for p in vert.model.params())
+    for s in sizes:
+        assert s <= max(bound, max_leaf)
+    # all leaves land in buckets: bucket elems sum to the param count
+    assert sum(row["grad_collective_elems"]["psum"]) == vert.n_params
+
+
+def test_compression_composes_with_bucketing(live):
+    """bf16 buckets carry bf16 payloads: exchanged gradient bytes halve
+    vs the f32 bucketed config."""
+    assert live["bucketed_bf16"]["exchanged_gradient_bytes_per_replica"] \
+        * 2 == live["bucketed"]["exchanged_gradient_bytes_per_replica"]
+
+
+def test_reduce_scatter_replaces_allreduce_and_halves_gradient_bytes(live):
+    """The tentpole relation, machine-checked: the reduce-scatter DP
+    step's census shows NO full-gradient psum — one reduce_scatter (the
+    gradient's single wire crossing) + one all_gather (the params
+    rebuild) — and per-replica exchanged GRADIENT bytes are exactly
+    half the flat allreduce's."""
+    rs = live["reduce_scatter"]
+    assert rs["grad_collectives"] == {"reduce_scatter": 1, "all_gather": 1}
+    flat = live["flat"]
+    assert rs["exchanged_gradient_bytes_per_replica"] * 2 == \
+        flat["exchanged_gradient_bytes_per_replica"]
+    # the params all-gather is accounted separately, never hidden
+    assert rs["exchanged_param_bytes_per_replica"] > 0
+
+
+def test_measured_sweep_meets_tolerance_when_present(budgets):
+    sweep = budgets["sweep"]
+    if sweep["status"] != "measured":
+        return  # pending_on_chip: the numeric half is dormant
+    rows = sweep.get("rows", [])
+    flat = [r for r in rows if r.get("exchange") == "flat"]
+    bucketed = [r for r in rows if r.get("exchange") == "bucketed"]
+    assert flat and bucketed, "measured sweep lacks flat/bucketed rows"
+    tol = 1.0 - sweep.get("regression_tolerance_pct", 2.0) / 100.0
+    best_flat = max(r["value"] for r in flat)
+    best_bucketed = max(r["value"] for r in bucketed)
+    assert best_bucketed >= tol * best_flat, (
+        f"bucketed flagship {best_bucketed} fell more than the "
+        f"tolerated margin below flat {best_flat} — record the "
+        "refutation in BENCH_NOTES before re-committing")

@@ -24,7 +24,11 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}"'"}'
+    ;;
+  *bench_scaling.py*)
+    echo "gloo curve header text"
+    echo '{"gloo": "'"${@: -1}"'"}'
     ;;
   *probe_perf.py*)
     echo "flashcmp header text"
@@ -74,23 +78,35 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 12 bench steps recorded, each once, in queue order
+    # all 16 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # prewarm (default knobs)
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1",   # flagship default
-        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0",   # donation A/B leg
-        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1",  # headroom probe
-        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1",   # real input pipeline
-        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1",    # full remat
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1",  # dots policy
-        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1",  # flash 16k/32k rows
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # prewarm
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # flagship
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd",  # donation A/B
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # headroom
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd",  # input pipeline
+        # ISSUE 5: bucket-MB sweep + reduce-scatter A/B legs
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd",
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd",  # remat
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd",  # dots
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # flash rows
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
+    # exposed-comm A/B (ISSUE 5): three gloo curves (flat, bucketed,
+    # reduce_scatter), folded in their own section after the main fold
+    assert [ln for ln in notes_text.splitlines() if '"gloo"' in ln] == [
+        '{"gloo": "flat"}', '{"gloo": "bucketed"}',
+        '{"gloo": "reduce_scatter"}']
+    assert notes_text.index("On-chip results") \
+        < notes_text.index("Exposed-comm A/B rows")
     # flashcmp rows recorded in their own section AFTER the main fold
     # (the fold must precede the unsupervised wedge-capable steps)
     assert notes_text.count('"flash_vs_xla"') == 2
@@ -154,5 +170,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 12
+                if '"final"' in ln]) == 16
     assert "Flash-vs-XLA" not in notes_text

@@ -1,0 +1,287 @@
+"""Collective census of the compiled DP train step (ISSUE 5).
+
+The gradient-exchange structure — how many collectives the step emits,
+over which buffers, in which pattern — is a property of what the
+framework TRACES, identical on every backend.  This tool extracts it
+from the step's jaxpr and commits it to ``tools/comm_budgets.json``,
+where ``tests/test_comm_budget.py`` holds every future PR to it
+(mirroring tools/flash_budgets.json / tools/hbm_budgets.json):
+
+* ``per_leaf``      — one mean-``psum`` per parameter leaf
+* ``flat``          — ONE monolithic flat-bucket ``psum``
+* ``bucketed``      — K size-bounded bucket ``psum``s (default ~4 MB,
+                      reverse registration order — the schedulable units
+                      XLA's async scheduler overlaps with backward)
+* ``bucketed_bf16`` — the same composed with dtype compression
+* ``reduce_scatter`` — ``reduce_scatter(grads) → shard update →
+                      all_gather(params)``: the full-gradient allreduce
+                      is GONE from the census and per-replica exchanged
+                      gradient bytes halve
+
+The census runs on the CPU mesh (tests/conftest.py's simulated 8
+devices) over a small-but-real transformer vertical whose gradients
+exceed the default bucket bound, so ``bucketed`` provably emits K>1
+collectives at the DEFAULT bucket size.
+
+Unlike the flash/HBM budgets' measured halves, the structure section
+here may be (re)generated off-chip — it is a trace property —
+``python tools/comm_census.py --write-budgets``.  The ``sweep`` section
+(on-chip bucket-MB sweep + the ≥2-host exposed-comm A/B) is measured:
+its rows are appended by the recovery queue and the numeric gate arms
+only when its status says ``measured``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "comm_budgets.json")
+
+#: collective primitives the census recognizes (jaxpr names; ``pmean``
+#: lowers to ``psum`` + divide, so the mean collectives appear as psum)
+COLLECTIVE_PRIMS = ("psum", "reduce_scatter", "all_gather", "all_to_all",
+                    "ppermute")
+
+#: operand-element floor separating GRADIENT-exchange collectives from
+#: bookkeeping ones (loss/observation pmeans are scalars; the smallest
+#: parameter leaf of the vertical is a 256-wide bias) — well between 1
+#: and 256, robust to both drifting
+GRAD_ELEMS_FLOOR = 16
+
+#: the committed vertical: small enough to trace in seconds on CPU,
+#: large enough that f32 AND bf16 gradients exceed the default 4 MB
+#: bucket bound (param count ~5.8M → ~23 MB f32 / ~11.6 MB bf16)
+VERTICAL = dict(n_vocab=8192, d_model=256, n_heads=4, n_layers=2,
+                max_len=64, bs=8, seq=32)
+
+CONFIGS = {
+    "per_leaf": dict(batch_collectives=False, grad_dtype=None,
+                     exchange="allreduce"),
+    "flat": dict(batch_collectives=True, grad_dtype=None,
+                 exchange="allreduce"),
+    "bucketed": dict(batch_collectives="bucketed", grad_dtype=None,
+                     exchange="allreduce"),
+    "bucketed_bf16": dict(batch_collectives="bucketed",
+                          grad_dtype="bfloat16", exchange="allreduce"),
+    "reduce_scatter": dict(batch_collectives=True, grad_dtype=None,
+                           exchange="reduce_scatter"),
+}
+
+
+def _walk_jaxpr(jaxpr, visit):
+    """Depth-first visit of every eqn of ``jaxpr`` and its sub-jaxprs
+    (pjit/shard_map/scan/remat/custom-vjp bodies)."""
+    import jax
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for value in eqn.params.values():
+            stack = [value]
+            while stack:
+                v = stack.pop()
+                if isinstance(v, (list, tuple)):
+                    stack.extend(v)
+                elif isinstance(v, jax.core.ClosedJaxpr):
+                    _walk_jaxpr(v.jaxpr, visit)
+                elif isinstance(v, jax.core.Jaxpr):
+                    _walk_jaxpr(v, visit)
+
+
+def collective_census(jaxpr):
+    """All collective eqns in the (closed) jaxpr: list of
+    ``{"prim", "elems", "dtype"}``, one row per operand."""
+    import jax
+    if isinstance(jaxpr, jax.core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    rows = []
+
+    def visit(eqn):
+        if eqn.primitive.name not in COLLECTIVE_PRIMS:
+            return
+        for var in eqn.invars:
+            aval = getattr(var, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            rows.append({"prim": eqn.primitive.name,
+                         "elems": int(np.prod(aval.shape, dtype=np.int64)),
+                         "dtype": str(aval.dtype)})
+
+    _walk_jaxpr(jaxpr, visit)
+    return rows
+
+
+class _Vertical:
+    """The traced transformer DP vertical, built once per process."""
+
+    _cached = None
+
+    @classmethod
+    def get(cls):
+        if cls._cached is None:
+            cls._cached = cls()
+        return cls._cached
+
+    def __init__(self):
+        import jax.numpy as jnp
+        from chainermn_tpu.models import TransformerLM
+        from chainermn_tpu.core.link import extract_state
+        v = VERTICAL
+        self.model = TransformerLM(
+            n_vocab=v["n_vocab"], d_model=v["d_model"],
+            n_heads=v["n_heads"], n_layers=v["n_layers"],
+            max_len=v["max_len"], seed=0)
+        rng = np.random.RandomState(0)
+        self.x = jnp.asarray(
+            rng.randint(0, v["n_vocab"], (v["bs"], v["seq"]))
+            .astype(np.int32))
+        self.t = jnp.asarray(np.roll(np.asarray(self.x), -1, axis=1))
+        params = extract_state(self.model)["params"]
+        self.n_params = sum(int(np.prod(p.shape)) for p in params.values())
+        self.param_bytes = sum(
+            int(np.prod(p.shape)) * p.dtype.itemsize
+            for p in params.values())
+
+
+def trace_step(exchange="allreduce", batch_collectives=True,
+               grad_dtype=None, bucket_mb=None):
+    """Jaxpr of the REAL compiled multi-node train step for one config
+    — the exact step makers ``update()`` dispatches, traced instead of
+    executed (no XLA compile; CPU-safe)."""
+    import jax
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.link import extract_state
+
+    vert = _Vertical.get()
+    comm = ct.create_communicator(
+        "jax_ici", batch_collectives=batch_collectives,
+        allreduce_grad_dtype=grad_dtype, bucket_mb=bucket_mb)
+    comm.bcast_data(vert.model)
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    inner = MomentumSGD(lr=0.1, momentum=0.9)
+    opt = ct.create_multi_node_optimizer(inner, comm,
+                                         exchange=exchange)
+    opt.setup(vert.model)
+    state = extract_state(vert.model)
+    params, pstate = state["params"], state["state"]
+    args, kwargs = (vert.x, vert.t), {}
+    if opt._sharded_update:
+        opt_state = opt._ensure_zero_opt_state(params)
+        step = opt._make_zero_step(vert.model, args, kwargs)
+    else:
+        opt_state = inner._ensure_opt_state(params)
+        step = opt._make_step(vert.model, args, kwargs)
+    operands = (params, pstate, opt_state, inner._hyper_values(),
+                inner._next_rng_key(), (), args, kwargs)
+    return jax.make_jaxpr(step)(*operands), comm
+
+
+def config_row(name):
+    """Computed census row for one committed config."""
+    from chainermn_tpu.communicators._memory_utility import exchanged_bytes
+    cfg = CONFIGS[name]
+    bucket_mb = cfg.get("bucket_mb")
+    jaxpr, comm = trace_step(exchange=cfg["exchange"],
+                             batch_collectives=cfg["batch_collectives"],
+                             grad_dtype=cfg["grad_dtype"],
+                             bucket_mb=bucket_mb)
+    census = collective_census(jaxpr)
+    grad = [r for r in census if r["elems"] >= GRAD_ELEMS_FLOOR]
+    counts = {}
+    elems = {}
+    for r in grad:
+        counts[r["prim"]] = counts.get(r["prim"], 0) + 1
+        elems.setdefault(r["prim"], []).append(r["elems"])
+    for v in elems.values():
+        v.sort(reverse=True)
+    import jax.numpy as jnp
+    grad_itemsize = jnp.dtype(cfg["grad_dtype"] or "float32").itemsize
+    size = comm.size
+    # accounting: psum rows are gradient allreduces; reduce_scatter rows
+    # are the gradient's single crossing; all_gather rows are the params
+    # rebuild (param dtype, not grad dtype)
+    grad_bytes = sum(
+        exchanged_bytes(r["elems"] * grad_itemsize, size, "psum")
+        for r in grad if r["prim"] == "psum")
+    grad_bytes += sum(
+        exchanged_bytes(r["elems"] * grad_itemsize, size, "reduce_scatter")
+        for r in grad if r["prim"] == "reduce_scatter")
+    # all_gather operands are the per-rank CHUNK; the ring accounting is
+    # over the full gathered buffer (chunk × size), in the operand dtype
+    param_bytes = sum(
+        exchanged_bytes(
+            r["elems"] * size * jnp.dtype(r["dtype"]).itemsize,
+            size, "all_gather")
+        for r in grad if r["prim"] == "all_gather")
+    return {
+        "exchange": cfg["exchange"],
+        "batch_collectives": cfg["batch_collectives"],
+        "grad_dtype": cfg["grad_dtype"],
+        "bucket_mb": bucket_mb,
+        "grad_collectives": counts,
+        "grad_collective_elems": elems,
+        "n_buckets": counts.get("psum", 0),
+        "exchanged_gradient_bytes_per_replica": int(grad_bytes),
+        "exchanged_param_bytes_per_replica": int(param_bytes),
+    }
+
+
+def build_structure():
+    vert = _Vertical.get()
+    structure = {name: config_row(name) for name in CONFIGS}
+    return {
+        "vertical": dict(VERTICAL, n_devices=_n_devices(),
+                         params=vert.n_params,
+                         param_bytes=vert.param_bytes),
+        "grad_elems_floor": GRAD_ELEMS_FLOOR,
+        "structure": structure,
+    }
+
+
+def _n_devices():
+    import jax
+    return len(jax.devices())
+
+
+def load_budgets(path=None):
+    with open(path or BUDGETS_PATH) as f:
+        return json.load(f)
+
+
+def main(argv):
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("PROBE_PLATFORM") or "cpu")
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", ""))
+    built = build_structure()
+    for name, row in built["structure"].items():
+        print(json.dumps(dict(row, config=name)), flush=True)
+    if "--write-budgets" not in argv:
+        return 0
+    try:
+        budgets = load_budgets()
+    except Exception:
+        budgets = {}
+    budgets.update(built)
+    budgets.setdefault("sweep", {
+        "status": "pending_on_chip",
+        "note": "bucket-MB sweep + >=2-host exposed-comm A/B queued in "
+                "tools/tpu_recovery_queue.sh; rows land here when the "
+                "relay recovers",
+    })
+    with open(BUDGETS_PATH, "w") as f:
+        json.dump(budgets, f, indent=1)
+        f.write("\n")
+    print(f"wrote {BUDGETS_PATH}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

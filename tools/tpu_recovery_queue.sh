@@ -70,6 +70,26 @@ run_one "resnet bs512 NHWC (donation headroom probe)" \
 # (uint8 C++ gather -> async device placement -> in-graph cast)
 run_one "resnet bs64 real input pipeline (uint8 native gather)" \
   BENCH_INPUT_PIPELINE=1 BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+# ISSUE 5: on-chip bucket-MB sweep — the bucketed exchange's K
+# size-bounded collectives vs the flat single transfer, on the resnet
+# flagship config.  Delta vs the bs64 flagship (flat) row = the
+# overlap payoff per bucket bound; the winning bound goes into
+# tools/comm_budgets.json `sweep` (status -> measured, rows carry
+# exchange/bucket_mb/value) and the tier-1 numeric gate arms.
+# BENCH_EXCHANGE is fingerprint-excluded from the last-good cache.
+run_one "resnet bs64 bucketed exchange 1MB (comm sweep)" \
+  BENCH_EXCHANGE=bucketed BENCH_BUCKET_MB=1 BENCH_DEADLINE_S=600 \
+  BENCH_TRIALS=3
+run_one "resnet bs64 bucketed exchange 4MB (comm sweep, default)" \
+  BENCH_EXCHANGE=bucketed BENCH_BUCKET_MB=4 BENCH_DEADLINE_S=600 \
+  BENCH_TRIALS=3
+run_one "resnet bs64 bucketed exchange 16MB (comm sweep)" \
+  BENCH_EXCHANGE=bucketed BENCH_BUCKET_MB=16 BENCH_DEADLINE_S=600 \
+  BENCH_TRIALS=3
+# reduce-scatter DP update A/B: halved per-replica exchanged gradient
+# bytes + sharded update compute vs the flat allreduce row
+run_one "resnet bs64 reduce-scatter update (comm A/B)" \
+  BENCH_EXCHANGE=reduce_scatter BENCH_DEADLINE_S=600 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 # seq-8192 remat rows LAST among the benches, with compile headroom:
@@ -108,6 +128,37 @@ run_one "longcontext flash 16k/32k + xla contrast (fused bwd)" \
   echo '```'
 } >> "$NOTES"
 
+echo "--- exposed-comm A/B: bucketed vs flat across process boundaries ---"
+# ISSUE 5: the >=2-host exchange A/B.  On a single-host box the gloo
+# 2-process curve is the stand-in (REAL cross-process collectives over
+# loopback — an upper bound on the exchange's exposed cost; on a pod,
+# rerun with the real process count).  One curve per exchange flavor;
+# the bucketed-vs-flat step_ms delta at 2 processes is the overlap
+# payoff the census structure promises.
+stepf=$STEPDIR/step_commab.log
+{
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange flat
+  # sub-MB bound: the gloo MLP's gradient is only ~1.2 MB f32, so the
+  # default 4 MB bound would swallow it into ONE bucket — structurally
+  # identical to the flat leg, and the A/B delta would be pure noise
+  CHAINERMN_TPU_BUCKET_MB=0.25 \
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange bucketed
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange reduce_scatter
+} > "$stepf" 2>&1 || true
+cat "$stepf"
+if grep -q '^{' "$stepf"; then
+  {
+    echo ""
+    echo "Exposed-comm A/B rows (gloo 2-process, per exchange):"
+    echo ""
+    echo '```'
+    grep '^{' "$stepf"
+    echo '```'
+  } >> "$NOTES"
+fi
 echo "--- flash vs xla attention T=1024/2048/4096/8192 (unsupervised: may wedge) ---"
 stepf=$STEPDIR/step_flashcmp.log
 # T=1024 decides whether flash should defer to XLA at the flagship
