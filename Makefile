@@ -89,11 +89,13 @@ sweep-flash:      ## on-chip flash fwd/bwd/fwd+bwd tile sweep; regenerates tools
 probe-flash:      ## committed flash budgets joined with live fused-vs-split rows (cpu = smoke)
 	PROBE=flash PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
-probe-comm:       ## committed gradient-exchange budgets + live per-bucket table (no chip)
+probe-comm:       ## committed gradient-exchange budgets + live per-bucket/per-hop tables (no chip)
 	@# jaxpr collective census per exchange config (per_leaf / flat /
-	@# bucketed / bucketed_bf16 / reduce_scatter) joined with
-	@# tools/comm_budgets.json, plus the live bucket plan at
-	@# PROBE_BUCKET_MB (default 4).  Trace property — chip-free.
+	@# bucketed / bucketed_bf16 / reduce_scatter / hierarchical*)
+	@# joined with tools/comm_budgets.json, the live bucket plan at
+	@# PROBE_BUCKET_MB (default 4), and the hierarchical configs'
+	@# per-hop table (hop, collective, bytes, dtype) on the simulated
+	@# 2-host split.  Trace property — chip-free.
 	PROBE=comm PROBE_PLATFORM=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 $$XLA_FLAGS" $(PY) tools/probe_perf.py
 
 audit:            ## StableHLO dtype census, resnet + transformer (no chip)

@@ -72,12 +72,19 @@ a variant that is excluded from the last-good cache):
                 BENCH_DONATE=0 (A/B leg: disable params/opt-state
                 buffer donation — never cached as flagship data),
                 BENCH_MEMSTATS=0 (skip the memory_analysis row fields),
-                BENCH_EXCHANGE (per_leaf|flat|bucketed|reduce_scatter —
-                gradient-exchange structure of the DP step; default
-                flat, the historical flagship config; any other value
-                is a variant excluded from the last-good cache),
+                BENCH_EXCHANGE (per_leaf|flat|bucketed|reduce_scatter|
+                hierarchical|hierarchical_rs — gradient-exchange
+                structure of the DP step; default flat, the historical
+                flagship config; any other value is a variant excluded
+                from the last-good cache; the hierarchical legs run
+                the two-level ici × dcn exchange and carry
+                topology/ici_size/dcn_size + per-hop exchanged-byte
+                columns),
                 BENCH_BUCKET_MB (bucket bound for bucketed, default 4;
                 the recovery queue sweeps 1/4/16),
+                BENCH_INTER_SIZE (hierarchical legs: force a dcn × ici
+                split of the local chips — the on-host structural A/B;
+                default: one dcn group per controller process),
                 BENCH_SHORT_STEPS (first-contact fallback steps/trial,
                 default 4 — see the staleness note below)
   staleness     a FIRST-CONTACT run (no warm-cache sentinel for the
@@ -351,7 +358,7 @@ _DEFAULT_FINGERPRINTS = {
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
                  "input_pipeline": False, "donate": True,
-                 "exchange": "flat", "bucket_mb": 0},
+                 "exchange": "flat", "bucket_mb": 0, "inter_size": 0},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -359,7 +366,7 @@ _DEFAULT_FINGERPRINTS = {
                     "remat": False, "remat_policy": "",
                     "n_steps": DEFAULT_TF_STEPS,
                     "flash_blocks": ":", "donate": True,
-                    "exchange": "flat", "bucket_mb": 0},
+                    "exchange": "flat", "bucket_mb": 0, "inter_size": 0},
 }
 
 def _env_float(name, default):
@@ -428,6 +435,7 @@ def _config_fingerprint(model=None):
             # not flagship data
             "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
             "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
+            "inter_size": _env_int("BENCH_INTER_SIZE", 0),
         }
     return {
         "model": "resnet50",
@@ -442,6 +450,7 @@ def _config_fingerprint(model=None):
         "donate": os.environ.get("BENCH_DONATE", "1") == "1",
         "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
         "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
+        "inter_size": _env_int("BENCH_INTER_SIZE", 0),
     }
 
 
@@ -776,13 +785,20 @@ def _exchange_config():
 
 def _make_dp_optimizer(inner, model, exchange, bucket_mb):
     """Communicator + multi-node wrapper for the requested gradient
-    exchange (flagship bf16 gradient compression on every flavor)."""
+    exchange (flagship bf16 gradient compression on every flavor).
+    The hierarchical legs honor BENCH_INTER_SIZE (force a dcn × ici
+    split of the local chips — the on-host structural A/B the queue
+    runs as 2×4; default: infer from the controller topology, i.e. a
+    real multi-host run gets one dcn group per host)."""
     import chainermn_tpu as ct
-    bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
-    comm = ct.create_communicator("jax_ici",
+    comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
+    inter_size = _env_int("BENCH_INTER_SIZE", 0) or None
+    comm = ct.create_communicator(comm_name,
                                   allreduce_grad_dtype="bfloat16",
                                   batch_collectives=bc,
-                                  bucket_mb=bucket_mb)
+                                  bucket_mb=bucket_mb,
+                                  inter_size=inter_size
+                                  if comm_name == "hierarchical" else None)
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(inner, comm,
                                          exchange=opt_exchange)
@@ -790,10 +806,13 @@ def _make_dp_optimizer(inner, model, exchange, bucket_mb):
 
 
 def _exchange_row_fields(model, comm, exchange):
-    """Row fields documenting the exchange: structure knobs plus the
-    per-replica wire-byte accounting (ring decomposition — the same
-    formulas tools/comm_budgets.json commits; 0 on a single chip)."""
-    from chainermn_tpu.communicators._memory_utility import exchanged_bytes
+    """Row fields documenting the exchange: structure knobs, the
+    TOPOLOGY columns (ici/dcn split — 1×N on flat communicators), and
+    the per-replica wire-byte accounting (ring decomposition — the
+    same formulas tools/comm_budgets.json commits; 0 on a single chip;
+    hierarchical legs additionally split the bill by hop)."""
+    from chainermn_tpu.communicators._memory_utility import (
+        exchanged_bytes, hierarchical_exchanged_bytes)
     arrays = [p.array for p in model.params() if p.array is not None]
     n_params = sum(int(np.prod(a.shape)) for a in arrays)
     param_bytes = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
@@ -804,7 +823,43 @@ def _exchange_row_fields(model, comm, exchange):
     size = comm.size
     fields = {"exchange": exchange,
               "bucket_mb": comm.bucket_mb if exchange == "bucketed"
-              else None}
+              else None,
+              "topology": comm.topology,
+              "ici_size": comm.ici_size,
+              "dcn_size": comm.dcn_size}
+    if comm.hierarchy is not None:
+        # per-hop split.  The accounting pads ELEMENTS exactly like the
+        # wire does (pad_to_multiple on the packed vector: to intra for
+        # the per-bucket exchange, to the full size for the sharded
+        # update), then prices each hop in its own wire dtype — the dcn
+        # dtype may differ from the ici wire dtype.
+        intra, inter = comm.ici_size, comm.dcn_size
+        coll = ("reduce_scatter"
+                if exchange in ("reduce_scatter", "hierarchical_rs")
+                else "psum")
+        multiple = intra * inter if coll == "reduce_scatter" else intra
+        n_pad = -(-n_params // multiple) * multiple
+        wire_itemsize = gdtype.itemsize if gdtype is not None else 4
+        dcn_itemsize = (comm.dcn_grad_dtype.itemsize
+                        if comm.dcn_grad_dtype is not None
+                        else wire_itemsize)
+        hops = hierarchical_exchanged_bytes(
+            n_pad * wire_itemsize, intra, inter, coll,
+            dcn_n_bytes=n_pad // intra * dcn_itemsize)
+        fields["exchanged_grad_bytes"] = hops["ici"] + hops["dcn"]
+        fields["exchanged_dcn_bytes"] = hops["dcn"]
+        fields["exchanged_ici_bytes"] = hops["ici"]
+        fields["exchanged_bytes"] = fields["exchanged_grad_bytes"]
+        if coll == "reduce_scatter":
+            # params rebuild: the sharded update all-gathers the PACKED
+            # flat params vector (tree_pack's concatenate promotes to
+            # one dtype — f32 on the bench models)
+            p_hops = hierarchical_exchanged_bytes(n_pad * 4, intra,
+                                                  inter, "all_gather")
+            fields["exchanged_bytes"] += p_hops["ici"] + p_hops["dcn"]
+            fields["exchanged_dcn_bytes"] += p_hops["dcn"]
+            fields["exchanged_ici_bytes"] += p_hops["ici"]
+        return fields
     if exchange == "reduce_scatter":
         grad = exchanged_bytes(grad_bytes, size, "reduce_scatter")
         fields["exchanged_bytes"] = grad + exchanged_bytes(

@@ -146,10 +146,13 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
 
     # exchange selects the gradient-exchange structure under test (the
     # ISSUE 5 exposed-comm A/B: bucketed vs flat across REAL process
-    # boundaries); reduce_scatter routes through the optimizer-level
-    # step variant, zero keeps the ZeRO-1 contract
-    bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
-    comm = ct.create_communicator("jax_ici", batch_collectives=bc)
+    # boundaries; ISSUE 6 adds the hierarchical two-level legs — with
+    # one device per process the split infers to dcn=nprocs × ici=1, so
+    # the DCN hop is the one crossing the real process boundary);
+    # reduce_scatter routes through the optimizer-level step variant,
+    # zero keeps the ZeRO-1 contract
+    comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
+    comm = ct.create_communicator(comm_name, batch_collectives=bc)
     assert comm.size == nprocs == jax.device_count()
     model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
     comm.bcast_data(model)
@@ -184,6 +187,8 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
             "processes": nprocs, "per_rank_bs": per_rank_bs,
             "zero_sharding": bool(zero),
             "exchange": exchange,
+            "topology": comm.topology,
+            "ici_size": comm.ici_size, "dcn_size": comm.dcn_size,
             "grad_payload_mb": round(n_params * 4 / 1e6, 2),
             "step_ms": round(dt / steps * 1e3, 3),
             "examples_per_sec": round(steps * gbs / dt, 1)}
@@ -362,13 +367,17 @@ def main():
                              " on time-sliced hosts)")
     parser.add_argument("--gloo-exchange", default="flat",
                         help="gradient-exchange structure under test: "
-                             "per_leaf|flat|bucketed|reduce_scatter "
-                             "(validated against communicators."
-                             "EXCHANGES — the ISSUE 5 exposed-comm "
-                             "A/B: run the curve once with flat, once "
-                             "with bucketed — the delta across real "
-                             "process boundaries is the overlap "
-                             "payoff)")
+                             "per_leaf|flat|bucketed|reduce_scatter|"
+                             "hierarchical|hierarchical_rs (validated "
+                             "against communicators.EXCHANGES — the "
+                             "ISSUE 5 exposed-comm A/B: run the curve "
+                             "once with flat, once with bucketed — the "
+                             "delta across real process boundaries is "
+                             "the overlap payoff.  The ISSUE 6 "
+                             "hierarchical legs run the two-level "
+                             "exchange with the DCN hop on the real "
+                             "process boundary: dcn=P × ici=1 at one "
+                             "device per process)")
     args = parser.parse_args()
 
     if args.gloo_worker:
@@ -387,14 +396,15 @@ def main():
             parser.error(f"unknown --gloo-exchange "
                          f"{args.gloo_exchange!r} "
                          f"({'|'.join(EXCHANGES)})")
-        if args.gloo_zero and args.gloo_exchange == "reduce_scatter":
+        if args.gloo_zero and args.gloo_exchange in ("reduce_scatter",
+                                                     "hierarchical_rs"):
             # fail before any worker spawns: every worker would raise
             # create_multi_node_optimizer's zero×reduce_scatter
             # ValueError after ports are bound and gloo is up — in the
             # unattended queue that burns the slot with no datum
             parser.error("--gloo-zero already exchanges gradients via "
                          "reduce-scatter; drop --gloo-exchange "
-                         "reduce_scatter")
+                         f"{args.gloo_exchange}")
         counts = [int(c) for c in args.gloo_procs.split(",")]
         _run_gloo_curve(counts, args.per_chip_bs, args.gloo_hidden,
                         args.steps, zero=args.gloo_zero,

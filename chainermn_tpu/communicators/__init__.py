@@ -16,8 +16,27 @@ name                 TPU realization
                      reference's SIZE-BOUNDED bucket pipeline — K
                      ``bucket_mb``-bounded collectives in reverse
                      registration order, overlappable with backward)
-``hierarchical``     alias of ``pure_nccl`` (XLA handles torus hierarchy)
-``two_dimensional``  alias of ``pure_nccl``
+``hierarchical``     REAL two-level (dcn × ici) exchange (ISSUE 6, no
+                     longer an alias): intra-host reduce-scatter over
+                     ICI → inter-host allreduce over DCN on the 1/intra
+                     chunk → intra-host all-gather, so DCN only ever
+                     carries ``1/ici_size`` of the gradient bytes.  The
+                     split is inferred from process_count × local
+                     devices, forced with ``intra_size=``/
+                     ``inter_size=``, or taken from a 2-axis mesh via
+                     ``MeshCommunicator.from_mesh_axis(mesh, (dcn,
+                     ici))``.  Pays off whenever the mesh spans >1 DCN
+                     hop (multi-host pods/slices); on one host it
+                     degenerates to a size-1 DCN axis (measure — the
+                     schedule is free there, not harmful).  Per-hop
+                     compression: ``allreduce_grad_dtype={"dcn":
+                     "bfloat16"}``.  ``CHAINERMN_TPU_HIERARCHY=flat``
+                     is the escape hatch back to the flat alias.
+``two_dimensional``  same two-level exchange as ``hierarchical`` (the
+                     reference's leader-staged vs chunked-2D variants
+                     collapse on TPU: every chip is DCN-attached, so
+                     the chunked form strictly dominates — kept as a
+                     distinct name for reference parity)
 ``single_node``      asserts one host, otherwise ``pure_nccl``
 ``non_cuda_aware``   alias of ``naive`` (host staging has no TPU analog)
 ``jax_ici``          canonical native name (= ``pure_nccl`` defaults)
@@ -54,40 +73,62 @@ _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
 
 #: gradient-exchange vocabulary shared by bench rows, the gloo A/B, and
 #: tools/comm_budgets.json configs
-EXCHANGES = ("per_leaf", "flat", "bucketed", "reduce_scatter")
+EXCHANGES = ("per_leaf", "flat", "bucketed", "reduce_scatter",
+             "hierarchical", "hierarchical_rs")
 
 
 def exchange_knobs(exchange):
-    """``(batch_collectives, optimizer exchange=)`` pair for a named
-    gradient-exchange structure — the ONE mapping bench.py's on-chip
-    rows and bench_scaling.py's gloo A/B share, so the same name always
-    measures the same collective structure on both surfaces.
-    ``reduce_scatter`` keeps a flat communicator: the optimizer-level
-    step variant owns its collective structure (the communicator's
-    packing only affects eager-mode collectives there)."""
+    """``(communicator name, batch_collectives, optimizer exchange=)``
+    triple for a named gradient-exchange structure — the ONE mapping
+    bench.py's on-chip rows and bench_scaling.py's gloo A/B share, so
+    the same name always measures the same collective structure on both
+    surfaces.  ``reduce_scatter`` keeps a flat communicator: the
+    optimizer-level step variant owns its collective structure (the
+    communicator's packing only affects eager-mode collectives there).
+    ``hierarchical`` is the two-level (ici × dcn) allreduce exchange;
+    ``hierarchical_rs`` composes it with the reduce-scatter DP update
+    (both hops reduce-scatter the gradient, both all-gather the
+    params)."""
     try:
-        bc = {"per_leaf": False, "flat": True, "bucketed": "bucketed",
-              "reduce_scatter": True}[exchange]
+        name, bc = {
+            "per_leaf": ("jax_ici", False),
+            "flat": ("jax_ici", True),
+            "bucketed": ("jax_ici", "bucketed"),
+            "reduce_scatter": ("jax_ici", True),
+            "hierarchical": ("hierarchical", True),
+            "hierarchical_rs": ("hierarchical", True),
+        }[exchange]
     except KeyError:
         raise ValueError(f"unknown exchange {exchange!r} "
                          f"({'|'.join(EXCHANGES)})") from None
-    return bc, ("reduce_scatter" if exchange == "reduce_scatter"
-                else "allreduce")
+    return name, bc, ("reduce_scatter"
+                      if exchange in ("reduce_scatter", "hierarchical_rs")
+                      else "allreduce")
 
 
 def create_communicator(communicator_name="jax_ici", devices=None,
                         axis_name="mn_world", allreduce_grad_dtype=None,
                         batch_collectives=None, bucket_mb=None,
-                        fault_schedule=None, **kwargs):
+                        fault_schedule=None, intra_size=None,
+                        inter_size=None, **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
-    (reference fp16 path; bf16 recommended on TPU).  ``devices``: subset of
-    ``jax.devices()`` (default all).  ``batch_collectives``: ``False``
-    (per-leaf collectives), ``True`` (one flat bucket — the per-name
-    default for the fused flavors) or ``"bucketed"`` (K size-bounded
-    buckets, the reference pure_nccl pipeline; ``bucket_mb`` /
-    ``CHAINERMN_TPU_BUCKET_MB`` bounds each bucket, default ~4 MB).
+    (reference fp16 path; bf16 recommended on TPU).  On the hierarchical
+    flavors a ``{"ici": ..., "dcn": ...}`` dict compresses per hop
+    (lossless ICI + bf16 DCN is the interesting point).  ``devices``:
+    subset of ``jax.devices()`` (default all).  ``batch_collectives``:
+    ``False`` (per-leaf collectives), ``True`` (one flat bucket — the
+    per-name default for the fused flavors) or ``"bucketed"`` (K
+    size-bounded buckets, the reference pure_nccl pipeline; ``bucket_mb``
+    / ``CHAINERMN_TPU_BUCKET_MB`` bounds each bucket, default ~4 MB —
+    composes with the hierarchical flavors: each bucket runs the
+    two-level rs/allreduce/ag).  ``intra_size``/``inter_size``: force
+    the (dcn, ici) split of the hierarchical flavors instead of
+    inferring it from the controller topology (the simulated-multihost
+    knob tier-1 uses).  ``CHAINERMN_TPU_HIERARCHY=flat`` collapses
+    ``hierarchical``/``two_dimensional`` back to the flat one-axis
+    alias (sizes ignored) — the no-code-change escape hatch.
     ``fault_schedule`` (``fault`` name only): a :class:`FaultSchedule` or
     spec dict; defaults to ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the
     environment — the chaos harness's entry point (see
@@ -117,7 +158,7 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             "jax_ici", devices=devices, axis_name=axis_name,
             allreduce_grad_dtype=allreduce_grad_dtype,
             batch_collectives=batch_collectives, bucket_mb=bucket_mb,
-            **kwargs)
+            intra_size=intra_size, inter_size=inter_size, **kwargs)
         # the hc.* transport hook gets its own schedule CLONE (same
         # specs + seed, separate RNG stream/counters): transport call
         # counts are inherently per-rank asymmetric (root puts,
@@ -144,11 +185,45 @@ def create_communicator(communicator_name="jax_ici", devices=None,
         raise ValueError(
             f"allreduce_grad_dtype is supported by the fused-bucket "
             f"communicators, not {name!r} (reference: pure_nccl-only)")
+    if isinstance(allreduce_grad_dtype, dict) \
+            and name not in ("hierarchical", "two_dimensional") \
+            and intra_size is None and inter_size is None:
+        # an explicit intra/inter split makes ANY fused flavor
+        # hierarchical (MeshCommunicator's own contract), so the dict
+        # is only nonsense when the result will be a flat one-hop mesh
+        raise ValueError(
+            f"per-hop allreduce_grad_dtype dicts are a hierarchical-"
+            f"communicator knob, not {name!r} without an intra_size/"
+            f"inter_size split (a flat exchange has one hop)")
     if batch_collectives is None:
         batch_collectives = name in ("flat", "pure_nccl", "jax_ici",
                                      "hierarchical", "two_dimensional",
                                      "single_node")
+    if name in ("hierarchical", "two_dimensional"):
+        import os
+        if os.environ.get("CHAINERMN_TPU_HIERARCHY", "") \
+                .strip().lower() in ("flat", "off", "0"):
+            # escape hatch (docs/performance.md §8): flat one-axis alias,
+            # split knobs dropped — one env var, zero call-site edits
+            intra_size = inter_size = None
+            if isinstance(axis_name, (tuple, list)):
+                # a (dcn, ici) tuple would re-trigger the two-level
+                # split inside MeshCommunicator — flatten the name too
+                axis_name = "_".join(axis_name)
+            if isinstance(allreduce_grad_dtype, dict):
+                # the flat alias has one hop; keep whatever compression
+                # the dict asked for on it — the DCN entry wins (the
+                # slow-hop intent), else the ICI entry — never a silent
+                # drop to lossless (wire bytes must not silently grow)
+                allreduce_grad_dtype = (allreduce_grad_dtype.get("dcn")
+                                        or allreduce_grad_dtype.get("ici"))
+            return MeshCommunicator(
+                devices=devices, axis_name=axis_name,
+                allreduce_grad_dtype=allreduce_grad_dtype,
+                batch_collectives=batch_collectives,
+                bucket_mb=bucket_mb, name="jax_ici")
     return MeshCommunicator(devices=devices, axis_name=axis_name,
                             allreduce_grad_dtype=allreduce_grad_dtype,
                             batch_collectives=batch_collectives,
-                            bucket_mb=bucket_mb, name=name)
+                            bucket_mb=bucket_mb, name=name,
+                            intra_size=intra_size, inter_size=inter_size)

@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
-           "plan_buckets", "bucket_table", "exchanged_bytes"]
+           "plan_buckets", "bucket_table", "hop_schedule",
+           "exchanged_bytes", "hierarchical_exchanged_bytes",
+           "pad_to_multiple"]
 
 #: default bucket bound (MB) for the bucketed exchange —
 #: ``CHAINERMN_TPU_BUCKET_MB`` overrides (reference: pure_nccl's
@@ -94,6 +96,50 @@ def plan_buckets(shapes, dtypes, bucket_bytes):
     return buckets
 
 
+def hop_schedule(n_buckets):
+    """Emission schedule of the two-level (ici × dcn) bucketed exchange:
+    ordered ``(op, bucket)`` pairs the hierarchical ``grad_transform``
+    follows literally, so the slow-hop-first property is a tested pure
+    function rather than an accident of loop structure.
+
+    Ops per bucket: ``"ici_reduce_scatter"`` (fast hop, full bucket) →
+    ``"dcn_exchange"`` (slow hop, the 1/intra chunk) →
+    ``"ici_all_gather"`` (fast hop, rebuild).  Ordering contract
+    (HiCCL / the multi-process-per-GPU allreduce paper's hop-overlap
+    result — ROADMAP item 1):
+
+    * within a bucket: reduce_scatter < dcn_exchange < all_gather
+      (dataflow);
+    * buckets enter the schedule in PLAN order (reverse registration —
+      the first bucket to close in backward reaches the wire first);
+    * EVERY slow-hop op precedes EVERY fast-hop all_gather: all DCN
+      transfers are issued before any ICI rebuild, so the slow hop
+      starts as early as dataflow allows and the ICI all-gathers
+      overlap the remaining DCN traffic instead of serializing ahead
+      of it.
+    """
+    if n_buckets < 0:
+        raise ValueError(f"n_buckets must be >= 0, got {n_buckets}")
+    schedule = []
+    for b in range(n_buckets):
+        schedule.append(("ici_reduce_scatter", b))
+        schedule.append(("dcn_exchange", b))
+    for b in range(n_buckets):
+        schedule.append(("ici_all_gather", b))
+    return schedule
+
+
+def pad_to_multiple(flat, multiple):
+    """Zero-pad a 1-D vector up to the next multiple (a tiled
+    ``psum_scatter``/``all_gather`` needs the scattered dim divisible by
+    the axis size).  Returns ``(padded, true_length)``."""
+    n = flat.shape[0]
+    n_pad = -(-n // multiple) * multiple
+    if n_pad == n:
+        return flat, n
+    return jnp.pad(flat, (0, n_pad - n)), n
+
+
 def bucket_table(shapes, dtypes, bucket_bytes):
     """Human/probe-facing accounting of a bucket plan: one row per
     bucket with its leaf count, element count, bytes, and dtype."""
@@ -130,6 +176,52 @@ def exchanged_bytes(n_bytes, size, collective):
         return int(2 * n_bytes * frac)
     if collective in ("reduce_scatter", "all_gather"):
         return int(n_bytes * frac)
+    raise ValueError(f"unknown collective {collective!r}")
+
+
+def hierarchical_exchanged_bytes(n_bytes, intra_size, inter_size,
+                                 collective="psum", dcn_n_bytes=None):
+    """Per-replica wire bytes of the two-level (ici × dcn) exchange on an
+    ``n_bytes`` FULL buffer, split by hop: ``{"ici": ..., "dcn": ...}``.
+
+    The slow hop only ever sees the 1/intra chunk the ICI reduce-scatter
+    leaves on each device — the tentpole's byte contract (DCN payload =
+    ``n_bytes / intra_size``).  ``dcn_n_bytes`` overrides that chunk's
+    byte count for the per-hop-dtype variant (bf16 over DCN while ICI
+    stays lossless: half the chunk bytes on the slow hop only).
+
+    * ``"psum"`` (the hierarchical allreduce exchange):
+      ICI carries the reduce-scatter AND the all-gather phase
+      (``2·n·(intra-1)/intra``); DCN carries a chunk allreduce
+      (``2·chunk·(inter-1)/inter``).
+    * ``"reduce_scatter"`` / ``"all_gather"`` (the hierarchical DP
+      update's gradient / params-rebuild halves): one crossing per hop
+      (``n·(intra-1)/intra`` over ICI, ``chunk·(inter-1)/inter`` over
+      DCN).
+
+    Identity, pinned by tests: with matching dtypes the hop totals sum
+    to the flat ring figure over ``intra·inter`` ranks —
+    ``2n(intra-1)/intra + 2(n/intra)(inter-1)/inter =
+    2n(intra·inter-1)/(intra·inter)`` — the hierarchy relocates bytes
+    onto the fast wires, it does not add any.
+    """
+    if intra_size < 1 or inter_size < 1:
+        raise ValueError(
+            f"intra_size/inter_size must be >= 1, got "
+            f"{intra_size}/{inter_size}")
+    if n_bytes % intra_size:
+        # callers pad buckets to a multiple of intra before the wire
+        raise ValueError(
+            f"n_bytes={n_bytes} not divisible by intra_size={intra_size} "
+            f"(pad_to_multiple the bucket first — the accounting must "
+            f"match the traced buffer)")
+    chunk = n_bytes // intra_size if dcn_n_bytes is None else dcn_n_bytes
+    ici = exchanged_bytes(n_bytes, intra_size, "reduce_scatter")
+    dcn = exchanged_bytes(chunk, inter_size, "reduce_scatter")
+    if collective == "psum":
+        return {"ici": 2 * ici, "dcn": 2 * dcn}
+    if collective in ("reduce_scatter", "all_gather"):
+        return {"ici": ici, "dcn": dcn}
     raise ValueError(f"unknown collective {collective!r}")
 
 

@@ -23,10 +23,19 @@ are aliases of this class with their distinguishing knobs preserved:
   overlap with the remaining backward compute (the reference hid its
   NCCL allreduces behind backward the same way; see
   docs/performance.md §7 and tools/comm_budgets.json).
-* ``hierarchical``/``two_dimensional``'s reduce-scatter structure → XLA
-  already decomposes large ``psum``s bandwidth-optimally over the torus;
-  the explicit reduce-scatter DP update lives one level up
-  (``create_multi_node_optimizer(exchange="reduce_scatter")``).
+* ``hierarchical``/``two_dimensional`` → a REAL two-level ``(dcn, ici)``
+  mesh axis split (ISSUE 6; no longer aliases of the flat path): the
+  gradient exchange composes with the machine topology as intra-host
+  ``reduce_scatter`` over ICI → inter-host exchange over DCN on the
+  1/intra chunk → intra-host ``all_gather`` over ICI, so the slow DCN
+  hop only ever carries ``1/ici_size`` of the gradient bytes.  The
+  split is inferred from the controller topology (``process_count`` ×
+  local devices), forced with ``intra_size=``/``inter_size=`` (the
+  simulated-2-host tier-1 grid), or taken from two named axes of an
+  existing mesh (:meth:`from_mesh_axis` with a 2-tuple).  Per-hop
+  compression: ``allreduce_grad_dtype={"dcn": "bfloat16"}`` lowers DCN
+  traffic while ICI stays lossless.  ``CHAINERMN_TPU_HIERARCHY=flat``
+  is the escape hatch back to the one-axis alias behavior.
 
 Two operating modes (see ``communicator_base`` docstring): eager host-mode
 collectives on stacked arrays, and in-step ``lax`` collectives inside
@@ -66,17 +75,68 @@ class MeshCommunicator(CommunicatorBase):
 
     def __init__(self, devices=None, axis_name="mn_world",
                  allreduce_grad_dtype=None, batch_collectives=False,
-                 bucket_mb=None, name="jax_ici", _mesh=None):
+                 bucket_mb=None, name="jax_ici", _mesh=None,
+                 intra_size=None, inter_size=None):
         self.name = name
-        self.axis_name = axis_name
+        self.hierarchy = None
+        self._hier_sizes = None
+        want_hier = (name in ("hierarchical", "two_dimensional")
+                     or intra_size is not None or inter_size is not None
+                     or isinstance(axis_name, (tuple, list)))
+        if isinstance(axis_name, (tuple, list)):
+            names = tuple(axis_name)
+            if len(names) != 2:
+                raise ValueError(
+                    f"a hierarchical axis_name is a (dcn, ici) 2-tuple; "
+                    f"got {names!r}")
+        elif want_hier:
+            names = ("dcn", "ici")
         if _mesh is not None:
             self.mesh = _mesh
             self._devices = list(np.asarray(_mesh.devices).reshape(-1))
         else:
             self._devices = list(devices) if devices is not None else list(jax.devices())
-            self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
-        self.allreduce_grad_dtype = (None if allreduce_grad_dtype is None
-                                     else jnp.dtype(allreduce_grad_dtype))
+            if want_hier:
+                inter, intra = self._resolve_hierarchy(
+                    len(self._devices), intra_size, inter_size)
+                self.mesh = Mesh(np.asarray(self._devices)
+                                 .reshape(inter, intra), names)
+            else:
+                self.mesh = Mesh(np.asarray(self._devices), (axis_name,))
+        if want_hier:
+            self.hierarchy = names
+            self._hier_sizes = (int(self.mesh.shape[names[0]]),
+                                int(self.mesh.shape[names[1]]))
+            axis_name = names
+        self.axis_name = axis_name
+        self.dcn_grad_dtype = None
+        if isinstance(allreduce_grad_dtype, dict):
+            # per-hop compression (ISSUE 6): lossless ICI + compressed
+            # DCN is the interesting point — the slow hop's bytes halve
+            # while the fast hop keeps full precision
+            if self.hierarchy is None:
+                raise ValueError(
+                    "per-hop allreduce_grad_dtype={'ici': ..., 'dcn': ...} "
+                    "needs a hierarchical communicator "
+                    "(name='hierarchical'/'two_dimensional' or an "
+                    "intra_size/inter_size split)")
+            unknown = set(allreduce_grad_dtype) - {"ici", "dcn"}
+            if unknown:
+                raise ValueError(
+                    f"unknown per-hop dtype keys {sorted(unknown)} "
+                    f"(hops are 'ici' and 'dcn')")
+            ici_dt = allreduce_grad_dtype.get("ici")
+            dcn_dt = allreduce_grad_dtype.get("dcn")
+            self.allreduce_grad_dtype = (None if ici_dt is None
+                                         else jnp.dtype(ici_dt))
+            self.dcn_grad_dtype = (None if dcn_dt is None
+                                   else jnp.dtype(dcn_dt))
+        else:
+            self.allreduce_grad_dtype = (None if allreduce_grad_dtype is None
+                                         else jnp.dtype(allreduce_grad_dtype))
+            if self.hierarchy is not None:
+                # a scalar dtype compresses BOTH hops (flat-path parity)
+                self.dcn_grad_dtype = self.allreduce_grad_dtype
         if batch_collectives not in (False, True, "bucketed"):
             raise ValueError(
                 f"batch_collectives must be False (per-leaf collectives), "
@@ -133,9 +193,60 @@ class MeshCommunicator(CommunicatorBase):
         # list, mailboxes) — model deepcopies (create_mnbn_model) share them
         return self
 
+    @staticmethod
+    def _resolve_hierarchy(n_devices, intra_size, inter_size):
+        """``(inter, intra)`` of the two-level split: explicit sizes win
+        (the simulated-multihost knob); otherwise the controller
+        topology decides — one DCN group per controller process, ICI =
+        the devices each drives.  Validated so a bad split fails at
+        construction, not as a reshape error inside the first traced
+        step."""
+        if intra_size is not None and inter_size is not None:
+            if intra_size * inter_size != n_devices:
+                raise ValueError(
+                    f"intra_size({intra_size}) × inter_size({inter_size})"
+                    f" != device count {n_devices}")
+            return int(inter_size), int(intra_size)
+        if inter_size is not None:
+            if inter_size < 1 or n_devices % inter_size:
+                raise ValueError(
+                    f"inter_size={inter_size} does not divide the "
+                    f"device count {n_devices}")
+            return int(inter_size), n_devices // int(inter_size)
+        if intra_size is not None:
+            if intra_size < 1 or n_devices % intra_size:
+                raise ValueError(
+                    f"intra_size={intra_size} does not divide the "
+                    f"device count {n_devices}")
+            return n_devices // int(intra_size), int(intra_size)
+        inter = jax.process_count()
+        if n_devices % inter:
+            # ragged host layouts (devices= subsets) have no canonical
+            # split; require the explicit knob rather than guessing
+            raise ValueError(
+                f"cannot infer a (dcn, ici) split: {n_devices} devices "
+                f"over {inter} processes; pass intra_size=/inter_size=")
+        return inter, n_devices // inter
+
     @classmethod
-    def from_mesh_axis(cls, mesh: Mesh, axis_name: str, **kwargs):
-        """Communicator over one named axis of an existing N-D mesh."""
+    def from_mesh_axis(cls, mesh: Mesh, axis_name, **kwargs):
+        """Communicator over one named axis of an existing N-D mesh —
+        or, with a ``(dcn, ici)`` 2-tuple of axis names, a HIERARCHICAL
+        communicator over that two-level sub-topology (the ISSUE 6
+        construction path for meshes that already carry the split)."""
+        if isinstance(axis_name, (tuple, list)):
+            dcn, ici = tuple(axis_name)
+            sub = np.moveaxis(
+                mesh.devices,
+                (mesh.axis_names.index(dcn), mesh.axis_names.index(ici)),
+                (0, 1))
+            grid = sub.reshape(sub.shape[0], sub.shape[1], -1)[:, :, 0]
+            comm = cls(devices=list(grid.reshape(-1)),
+                       axis_name=(dcn, ici),
+                       inter_size=int(grid.shape[0]),
+                       intra_size=int(grid.shape[1]), **kwargs)
+            comm.mesh = mesh  # collectives address the enclosing mesh's axes
+            return comm
         sub = np.moveaxis(mesh.devices,
                           mesh.axis_names.index(axis_name), 0)
         comm = cls(devices=list(sub.reshape(sub.shape[0], -1)[:, 0]),
@@ -168,7 +279,13 @@ class MeshCommunicator(CommunicatorBase):
     def intra_size(self):
         """Device slots this host contributes (DEVICE-SLOT units, like
         ``intra_rank``): local device count × co-located controller
-        processes (reference: ranks per node)."""
+        processes (reference: ranks per node).  On a hierarchical
+        communicator this is the ICI axis size — the mesh's own view of
+        "ranks per node", which equals the controller-derived figure on
+        a real multihost run and stays correct under the simulated
+        splits (``inter_size=`` on one controller)."""
+        if self.hierarchy is not None:
+            return self._hier_sizes[1]
         n_local_procs = self._intra[1] if self._intra is not None else 1
         return jax.local_device_count() * n_local_procs
 
@@ -178,7 +295,56 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def inter_size(self):
+        """Number of controller PROCESSES — the host/object-channel view
+        (scatter_dataset, checkpoint consensus, multi-node iterators key
+        off this).  The device-mesh view of the two-level split lives on
+        ``dcn_size``/``ici_size``; the two coincide on a real multihost
+        run and deliberately differ under a single-controller simulated
+        split (one controller still feeds the whole global batch)."""
         return jax.process_count()
+
+    # -- two-level (ici × dcn) topology (ISSUE 6) --------------------------
+    @property
+    def dcn_axis(self):
+        """Slow-hop mesh axis name (``None`` on flat communicators)."""
+        return self.hierarchy[0] if self.hierarchy is not None else None
+
+    @property
+    def ici_axis(self):
+        """Fast-hop mesh axis name (``None`` on flat communicators)."""
+        return self.hierarchy[1] if self.hierarchy is not None else None
+
+    @property
+    def dcn_size(self):
+        """Groups on the slow hop (1 on flat communicators)."""
+        return self._hier_sizes[0] if self.hierarchy is not None else 1
+
+    @property
+    def ici_size(self):
+        """Devices per slow-hop group (== ``size`` on flat
+        communicators: the whole world is one fast-hop group)."""
+        return self._hier_sizes[1] if self.hierarchy is not None \
+            else self.size
+
+    def chunk_axes(self):
+        """Axis names of the gradient reduce-scatter chain, FAST hop
+        first — the full buffer crosses the cheap wire, the slow hop
+        only ever sees the 1/ici chunk.  ``(axis,)`` on flat
+        communicators; ``(ici, dcn)`` on hierarchical ones.  The
+        optimizer's sharded update chains ``psum_scatter`` in this
+        order and ``all_gather`` in reverse."""
+        if self.hierarchy is not None:
+            return (self.ici_axis, self.dcn_axis)
+        return (self.axis_name,)
+
+    def flat_chunk_spec(self):
+        """``PartitionSpec`` of a flat padded vector sharded one chunk
+        per rank in the layout the chained reduce-scatter of
+        :meth:`chunk_axes` produces (fast hop major) — what the sharded
+        optimizer state and the reduce-scatter stale buffer use."""
+        if self.hierarchy is not None:
+            return P((self.ici_axis, self.dcn_axis))
+        return P(self.axis_name)
 
     # -- mode dispatch ---------------------------------------------------------
     def _axis_index(self):
@@ -525,6 +691,14 @@ class MeshCommunicator(CommunicatorBase):
             return "bucketed"
         return "flat" if self.batch_collectives else "per_leaf"
 
+    @property
+    def topology(self):
+        """``"hierarchical"`` (two-level ici × dcn exchange) or
+        ``"flat"`` (one mesh axis) — the topology column bench rows and
+        the census carry, orthogonal to :attr:`exchange` (bucketing
+        composes with either topology)."""
+        return "hierarchical" if self.hierarchy is not None else "flat"
+
     def grad_buckets(self, shapes, dtypes):
         """The bucket plan this communicator's ``grad_transform`` traces
         for leaves of the given shapes/dtypes (post dtype-compression):
@@ -584,6 +758,8 @@ class MeshCommunicator(CommunicatorBase):
         — the one pack/unpack implementation (shared with ZeRO and the
         reduce-scatter update).
         """
+        if self.hierarchy is not None:
+            return self._hierarchical_grad_transform()
         axis = self.axis_name
         dtype = self.allreduce_grad_dtype
         comm = self
@@ -609,6 +785,80 @@ class MeshCommunicator(CommunicatorBase):
                     flat, spec = tree_pack([leaves[i] for i in idx])
                     flat = lax.pmean(flat, axis)
                     for i, g in zip(idx, tree_unpack(flat, spec)):
+                        out[i] = g
+            leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
+            return jax.tree.unflatten(treedef, leaves)
+
+        return transform
+
+    def _hierarchical_grad_transform(self):
+        """The two-level exchange (ISSUE 6): per bucket, intra-host
+        ``psum_scatter`` over ICI → inter-host allreduce over DCN on the
+        1/ici chunk → intra-host ``all_gather`` over ICI.  DCN — the hop
+        that is an order of magnitude slower on a real pod — only ever
+        carries ``1/ici_size`` of the gradient bytes.
+
+        Emission follows ``_memory_utility.hop_schedule`` literally:
+        each bucket's DCN collective is issued right after its ICI
+        reduce-scatter (in reverse-registration plan order, so the
+        first bucket backward closes reaches the slow wire first), and
+        ALL DCN ops precede ALL ICI all-gathers — the slow hop starts
+        as early as dataflow allows and the fast-hop rebuilds overlap
+        the remaining DCN traffic (the hop-overlap schedule HiCCL and
+        the multi-process-per-GPU allreduce paper measure; pinned by
+        the ordered census in tests/test_comm_budget.py).
+
+        Per-hop compression: ``allreduce_grad_dtype`` casts the leaves
+        for the ICI hop (as on the flat path); ``dcn_grad_dtype`` —
+        ``allreduce_grad_dtype={"dcn": ...}`` — additionally compresses
+        only the chunk crossing DCN, so ICI stays lossless while the
+        slow hop's bytes halve (the first brick of ROADMAP item 2).
+        The mean divide happens once, on the 1/ici chunk (fewer flops,
+        same math).
+        """
+        ici, dcn = self.ici_axis, self.dcn_axis
+        intra = self.ici_size
+        size = self.size
+        dtype = self.allreduce_grad_dtype
+        dcn_dtype = self.dcn_grad_dtype
+        comm = self
+
+        def transform(grads):
+            from ._memory_utility import (hop_schedule, pad_to_multiple,
+                                          tree_pack, tree_unpack)
+            leaves, treedef = jax.tree.flatten(grads)
+            if not leaves:
+                return grads
+            orig_dtypes = [g.dtype for g in leaves]
+            if dtype is not None:
+                leaves = [g.astype(dtype) for g in leaves]
+            buckets = comm.grad_buckets([g.shape for g in leaves],
+                                        [g.dtype for g in leaves])
+            out = [None] * len(leaves)
+            specs = {}
+            chunks = {}
+            for op, b in hop_schedule(len(buckets)):
+                idx = buckets[b]
+                if op == "ici_reduce_scatter":
+                    with jax.named_scope("mn_hier_rs_ici"):
+                        flat, spec = tree_pack([leaves[i] for i in idx])
+                        flat, n_true = pad_to_multiple(flat, intra)
+                        specs[b] = (spec, n_true)
+                        chunks[b] = lax.psum_scatter(
+                            flat, ici, scatter_dimension=0, tiled=True)
+                elif op == "dcn_exchange":
+                    with jax.named_scope("mn_hier_allreduce_dcn"):
+                        c = chunks[b]
+                        wire = c.dtype
+                        if dcn_dtype is not None:
+                            c = c.astype(dcn_dtype)
+                        c = lax.psum(c, dcn)
+                        chunks[b] = c.astype(wire) / size
+                else:  # ici_all_gather
+                    with jax.named_scope("mn_hier_ag_ici"):
+                        full = lax.all_gather(chunks[b], ici, tiled=True)
+                    spec, n_true = specs[b]
+                    for i, g in zip(idx, tree_unpack(full[:n_true], spec)):
                         out[i] = g
             leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
             return jax.tree.unflatten(treedef, leaves)
@@ -655,9 +905,13 @@ class MeshCommunicator(CommunicatorBase):
         between eager and traced collectives, and exception control
         flow here would silently flip modes under a jax behavior change
         (VERDICT open item 7; pinned by
-        ``tests/communicator_tests/test_axis_in_scope.py``)."""
+        ``tests/communicator_tests/test_axis_in_scope.py``).  A
+        hierarchical communicator binds TWO axes; both must be in scope
+        (a partial binding cannot host the two-level exchange)."""
         from chainermn_tpu.utils.compat import axis_env_contains
-        return axis_env_contains(self.axis_name)
+        names = self.axis_name if isinstance(self.axis_name, tuple) \
+            else (self.axis_name,)
+        return all(axis_env_contains(n) for n in names)
 
     # -- split ------------------------------------------------------------------------
     def split(self, color, key):
@@ -690,12 +944,25 @@ class MeshCommunicator(CommunicatorBase):
         return comms[sorted(set(colors)).index(my_color)]
 
     def split_all(self, color, key):
-        """All sub-communicators of the split, ordered by sorted color."""
+        """All sub-communicators of the split, ordered by sorted color.
+
+        Sub-communicators are FLAT (one axis): an arbitrary color
+        partition has no canonical two-level structure, so a
+        hierarchical parent's split members drop the (dcn, ici) split —
+        rebuild one with ``intra_size=``/``inter_size=`` if a subgroup
+        spans hosts and needs it.  A hierarchical parent's per-hop
+        compression degrades onto the subgroup's single hop — the DCN
+        entry wins (slow-hop intent), else the ICI entry (the same
+        keep-the-bytes-low convention as the
+        ``CHAINERMN_TPU_HIERARCHY=flat`` escape hatch) — never silently
+        to lossless."""
         size = self.size
         colors = [color] * size if np.isscalar(color) else list(color)
         keys = [key] * size if np.isscalar(key) else list(key)
         if len(colors) != size or len(keys) != size:
             raise ValueError("color/key must be scalars or length-size")
+        base = self.axis_name if isinstance(self.axis_name, str) \
+            else "_".join(self.axis_name)
         groups = {}
         for i, (c, k) in enumerate(zip(colors, keys)):
             groups.setdefault(c, []).append((k, i))
@@ -704,17 +971,26 @@ class MeshCommunicator(CommunicatorBase):
             members = [i for _, i in sorted(groups[c])]
             comms.append(MeshCommunicator(
                 devices=[self._devices[i] for i in members],
-                axis_name=f"{self.axis_name}_s{c}",
-                allreduce_grad_dtype=self.allreduce_grad_dtype,
+                axis_name=f"{base}_s{c}",
+                allreduce_grad_dtype=(
+                    self.dcn_grad_dtype or self.allreduce_grad_dtype
+                    if self.hierarchy is not None
+                    else self.allreduce_grad_dtype),
                 batch_collectives=self.batch_collectives,
                 bucket_mb=self.bucket_mb,
-                name=self.name))
+                # a hierarchical name would re-trigger the two-level
+                # split on the subgroup's arbitrary device subset
+                name="jax_ici" if self.hierarchy is not None
+                else self.name))
         return comms
 
     # -- diagnostics --------------------------------------------------------------------
     def __repr__(self):
+        topo = (f" hierarchy={self.dcn_size}x{self.ici_size}"
+                if self.hierarchy is not None else "")
         return (f"<{type(self).__name__} name={self.name!r} size={self.size} "
-                f"axis={self.axis_name!r} grad_dtype={self.allreduce_grad_dtype}>")
+                f"axis={self.axis_name!r}{topo} "
+                f"grad_dtype={self.allreduce_grad_dtype}>")
 
     def _check_stacked(self, x, what):
         if x.ndim == 0 or x.shape[0] != self.size:

@@ -24,6 +24,14 @@ all_gather(params)``: per-replica exchanged gradient bytes are halved
 shard-local, and — unlike ``zero_sharding`` — it composes with double
 buffering (the stale buffer is the 1/n mean-gradient chunk).
 
+On a HIERARCHICAL communicator (ISSUE 6: a real (dcn, ici) two-level
+mesh) every exchange composes with the topology: the allreduce path's
+``grad_transform`` runs intra-host reduce-scatter → DCN chunk
+allreduce → intra-host all-gather per bucket, and the sharded-update
+path chains ``psum_scatter`` fast-hop-first (``comm.chunk_axes()``) so
+the slow DCN wire only ever carries ``1/ici_size`` of the bytes in
+either direction (docs/performance.md §8).
+
 Batch convention (single-controller translation of "each rank feeds its
 local batch"): ``update(lossfun, *args)`` receives the *global* batch
 (leading dim divisible by ``comm.size``); the shard_map in_spec splits it
@@ -280,12 +288,16 @@ class _MultiNodeOptimizer:
             actual._opt_state = self._zero_transform().init(flat)
         return actual._opt_state
 
-    def _zero_state_spec(self, opt_state, axis):
-        """P(axis) for flat param-length leaves, replicated otherwise
-        (e.g. Adam's step count)."""
+    def _zero_state_spec(self, opt_state):
+        """Chunk spec for flat param-length leaves, replicated otherwise
+        (e.g. Adam's step count).  The chunk layout is the
+        communicator's (``flat_chunk_spec``): one axis on flat
+        communicators, fast-hop-major over (ici, dcn) on hierarchical
+        ones — the layout the chained reduce-scatter produces."""
         _, n, n_pad = self._zero_layout
+        chunk_spec = self.communicator.flat_chunk_spec()
         return jax.tree.map(
-            lambda leaf: P(axis) if getattr(leaf, "ndim", 0) == 1
+            lambda leaf: chunk_spec if getattr(leaf, "ndim", 0) == 1
             and leaf.shape[0] == n_pad else P(), opt_state)
 
     def _make_zero_update(self):
@@ -302,16 +314,31 @@ class _MultiNodeOptimizer:
         while this step's fresh chunk is returned to become the next
         stale buffer — the reference's one-step-stale semantics at 1/n
         of the stale-buffer footprint.
+
+        On a HIERARCHICAL communicator the single reduce-scatter /
+        all-gather becomes the hop chain ``comm.chunk_axes()`` traces
+        fast-hop-first (ISSUE 6): ``psum_scatter`` over ICI on the full
+        gradient, ``psum_scatter`` over DCN on the 1/ici chunk (the slow
+        wire never sees more than 1/ici of the bytes; ``dcn_grad_dtype``
+        can compress just that crossing), the chunk update, then
+        ``all_gather`` over DCN first and ICI last — the params rebuild
+        likewise puts only 1/ici of the parameter bytes on DCN.  The
+        chunk layout is fast-hop-major (``comm.flat_chunk_spec()``);
+        the chained index below addresses the same layout the gathers
+        reassemble.
         """
         from .communicators._memory_utility import tree_pack, tree_unpack
         from .core.optimizer import apply_transform_update
         comm = self.communicator
         tx = self._zero_transform()
-        axis = comm.axis_name
         size = comm.size
         spec, n, n_pad = self._zero_layout
         chunk = n_pad // size
         grad_dtype = comm.allreduce_grad_dtype
+        dcn_dtype = getattr(comm, "dcn_grad_dtype", None)
+        rs_axes = comm.chunk_axes()
+        axis_sizes = [int(comm.mesh.shape[a]) for a in rs_axes]
+        slow_axis = rs_axes[-1] if len(rs_axes) > 1 else None
 
         def zero_update(params, grads, opt_state, hyper, stale_chunk=None):
             with jax.named_scope("zero_reduce_scatter_grad"):
@@ -319,20 +346,29 @@ class _MultiNodeOptimizer:
                 gflat = jnp.pad(gflat, (0, n_pad - n))
                 if grad_dtype is not None:
                     gflat = gflat.astype(grad_dtype)
-                gchunk = lax.psum_scatter(gflat, axis, scatter_dimension=0,
-                                          tiled=True)
+                gchunk = gflat
+                for a in rs_axes:
+                    if a == slow_axis and dcn_dtype is not None:
+                        gchunk = gchunk.astype(dcn_dtype)
+                    gchunk = lax.psum_scatter(
+                        gchunk, a, scatter_dimension=0, tiled=True)
                 gchunk = gchunk.astype(jnp.float32) / size
             with jax.named_scope("zero_shard_update"):
                 pflat, _ = tree_pack(params)
                 pflat = jnp.pad(pflat, (0, n_pad - n))
+                idx = jnp.int32(0)
+                for a, a_size in zip(rs_axes, axis_sizes):
+                    idx = idx * a_size + lax.axis_index(a)
                 pchunk = lax.dynamic_slice_in_dim(
-                    pflat, lax.axis_index(axis) * chunk, chunk)
+                    pflat, idx * chunk, chunk)
                 new_pchunk, new_opt_state = apply_transform_update(
                     tx, gchunk if stale_chunk is None else stale_chunk,
                     opt_state, pchunk, hyper["lr"],
                     hyper.get("decoupled_wd", 0.0))
             with jax.named_scope("zero_all_gather_params"):
-                new_flat = lax.all_gather(new_pchunk, axis, tiled=True)
+                new_flat = new_pchunk
+                for a in reversed(rs_axes):
+                    new_flat = lax.all_gather(new_flat, a, tiled=True)
                 new_params = tree_unpack(new_flat, spec)
             return new_params, new_opt_state, gchunk
 
@@ -373,9 +409,9 @@ class _MultiNodeOptimizer:
             lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
-        opt_specs = self._zero_state_spec(actual._opt_state, axis)
+        opt_specs = self._zero_state_spec(actual._opt_state)
         # the stale chunk is sharded like the opt state's flat leaves
-        stale_spec = P(axis) if double_buffering else P()
+        stale_spec = comm.flat_chunk_spec() if double_buffering else P()
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
             in_specs=(P(), P(), opt_specs, P(), P(), stale_spec,
@@ -662,7 +698,7 @@ class _MultiNodeOptimizer:
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_kwargs)
-        opt_specs = self._zero_state_spec(actual._opt_state, axis)
+        opt_specs = self._zero_state_spec(actual._opt_state)
         mapped = shard_map(
             rank_scan, mesh=comm.mesh,
             in_specs=(P(), P(), opt_specs, P(), P(), args_specs,
@@ -733,7 +769,7 @@ class _MultiNodeOptimizer:
         the true parameter length ``n`` and re-padded to this mesh's
         ``n_pad`` first — the host-gathered snapshots are full vectors,
         so size-changed resume is well-defined."""
-        axis = self.communicator.axis_name
+        chunk_spec = self.communicator.flat_chunk_spec()
         mesh = self.communicator.mesh
         _, n, n_pad = self._zero_layout
 
@@ -750,7 +786,7 @@ class _MultiNodeOptimizer:
                     return leaf  # not a flat param vector
                 leaf = jnp.pad(jnp.asarray(leaf)[:n], (0, n_pad - n))
             host = np.asarray(leaf)
-            sharding = jax.sharding.NamedSharding(mesh, P(axis))
+            sharding = jax.sharding.NamedSharding(mesh, chunk_spec)
             return jax.make_array_from_callback(
                 host.shape, sharding, lambda idx: host[idx])
 
@@ -864,7 +900,7 @@ class _MultiNodeOptimizer:
                 host = np.asarray(restored)
                 sharding = jax.sharding.NamedSharding(
                     self.communicator.mesh,
-                    P(self.communicator.axis_name))
+                    self.communicator.flat_chunk_spec())
                 restored = jax.make_array_from_callback(
                     host.shape, sharding, lambda idx: host[idx])
             # None restored = snapshot predates stale-grad saving (or was

@@ -352,6 +352,119 @@ def test_split_subcomm_spmd_inside_own_mesh():
     np.testing.assert_allclose(totals[1], base + 100.0 * half)
 
 
+def test_hierarchical_communicator_is_two_level():
+    """ISSUE 6: 'hierarchical'/'two_dimensional' are REAL two-level
+    communicators (not aliases of the flat path): a (dcn, ici) mesh,
+    tuple axis binding, and the per-hop grad exchange."""
+    for name in ("hierarchical", "two_dimensional"):
+        comm = create_communicator(name, inter_size=2)
+        assert comm.hierarchy == ("dcn", "ici")
+        assert comm.topology == "hierarchical"
+        assert comm.axis_name == ("dcn", "ici")
+        assert comm.dcn_size == 2 and comm.ici_size == 4
+        assert tuple(comm.mesh.axis_names) == ("dcn", "ici")
+    # the default split on one controller: a degenerate size-1 dcn axis
+    # (structure kept; a real multihost run infers one group per host)
+    comm = create_communicator("hierarchical")
+    assert comm.dcn_size == 1 and comm.ici_size == comm.size
+    # invalid splits fail at construction, not inside the first trace
+    with pytest.raises(ValueError, match="divide"):
+        create_communicator("hierarchical", inter_size=3)
+    with pytest.raises(ValueError, match="device count"):
+        create_communicator("hierarchical", inter_size=2, intra_size=2)
+
+
+def test_hierarchy_escape_hatch(monkeypatch):
+    """CHAINERMN_TPU_HIERARCHY=flat collapses the hierarchical names
+    back to the flat one-axis alias (sizes ignored) — the no-code-change
+    rollback documented in docs/performance.md §8."""
+    monkeypatch.setenv("CHAINERMN_TPU_HIERARCHY", "flat")
+    comm = create_communicator("hierarchical", inter_size=2)
+    assert comm.hierarchy is None
+    assert comm.topology == "flat"
+    assert isinstance(comm.axis_name, str)
+    # a (dcn, ici) axis_name tuple must not re-trigger the split
+    # through the hatch (it would silently ignore the rollback)
+    comm = create_communicator("hierarchical", inter_size=2,
+                               axis_name=("dcn", "ici"))
+    assert comm.hierarchy is None and isinstance(comm.axis_name, str)
+    # per-hop dict intent degrades onto the single hop: the dcn entry
+    # wins, else the ici entry — never a silent drop to lossless
+    comm = create_communicator(
+        "hierarchical", allreduce_grad_dtype={"dcn": "bfloat16"})
+    assert comm.allreduce_grad_dtype == jnp.bfloat16
+    comm = create_communicator(
+        "hierarchical", allreduce_grad_dtype={"ici": "bfloat16"})
+    assert comm.allreduce_grad_dtype == jnp.bfloat16
+
+
+def test_per_hop_dtype_validation():
+    comm = create_communicator(
+        "hierarchical", inter_size=2,
+        allreduce_grad_dtype={"dcn": "bfloat16"})
+    assert comm.allreduce_grad_dtype is None  # ici lossless
+    assert comm.dcn_grad_dtype == jnp.bfloat16
+    # scalar dtype compresses BOTH hops (flat-path parity)
+    comm = create_communicator("hierarchical", inter_size=2,
+                               allreduce_grad_dtype="bfloat16")
+    assert comm.allreduce_grad_dtype == jnp.bfloat16
+    assert comm.dcn_grad_dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="hierarchical"):
+        create_communicator("jax_ici",
+                            allreduce_grad_dtype={"dcn": "bfloat16"})
+    with pytest.raises(ValueError, match="hops"):
+        create_communicator("hierarchical", inter_size=2,
+                            allreduce_grad_dtype={"ici": None,
+                                                  "wan": "bfloat16"})
+
+
+def test_hierarchical_split_flattens():
+    """split() of a hierarchical communicator returns FLAT sub-groups
+    (documented: an arbitrary color partition has no canonical
+    two-level structure) — and their collectives stay correct."""
+    comm = create_communicator("hierarchical", inter_size=2)
+    subs = comm.split_all([i % 2 for i in range(comm.size)],
+                          list(range(comm.size)))
+    assert len(subs) == 2
+    for sub in subs:
+        assert sub.hierarchy is None and sub.size == comm.size // 2
+    # per-hop compression intent survives the flatten: the subgroup's
+    # single hop gets the parent's DCN entry, never silently lossless
+    hcomm = create_communicator("hierarchical", inter_size=2,
+                                allreduce_grad_dtype={"dcn": "bfloat16"})
+    for sub in hcomm.split_all(0, 0):
+        assert sub.allreduce_grad_dtype == jnp.bfloat16
+    # an explicit split on any fused name may carry the per-hop dict too
+    comm2 = create_communicator("jax_ici", inter_size=2,
+                                allreduce_grad_dtype={"dcn": "bfloat16"})
+    assert comm2.hierarchy == ("dcn", "ici")
+    assert comm2.dcn_grad_dtype == jnp.bfloat16
+    x = jnp.asarray(np.arange(subs[0].size, dtype=np.float32))
+    np.testing.assert_allclose(
+        np.asarray(subs[0].allreduce(x, op="sum")),
+        sum(range(subs[0].size)))
+
+
+def test_exchange_knobs_vocabulary():
+    """The one exchange-name mapping bench.py and bench_scaling share:
+    (communicator name, batch_collectives, optimizer exchange)."""
+    from chainermn_tpu.communicators import EXCHANGES, exchange_knobs
+    assert exchange_knobs("flat") == ("jax_ici", True, "allreduce")
+    assert exchange_knobs("bucketed") == \
+        ("jax_ici", "bucketed", "allreduce")
+    assert exchange_knobs("reduce_scatter") == \
+        ("jax_ici", True, "reduce_scatter")
+    assert exchange_knobs("hierarchical") == \
+        ("hierarchical", True, "allreduce")
+    assert exchange_knobs("hierarchical_rs") == \
+        ("hierarchical", True, "reduce_scatter")
+    assert set(EXCHANGES) == {"per_leaf", "flat", "bucketed",
+                              "reduce_scatter", "hierarchical",
+                              "hierarchical_rs"}
+    with pytest.raises(ValueError, match="unknown exchange"):
+        exchange_knobs("chunky")
+
+
 def test_hierarchical_two_level_reduction_matches_global():
     """Reference 'hierarchical' structure as an explicit two-level
     reduction over split() groups: intra-group mean → leader-level mean

@@ -83,6 +83,95 @@ def test_tree_pack_empty_tree():
     assert tree_unpack(flat, spec) == {}
 
 
+def test_pad_to_multiple():
+    from chainermn_tpu.communicators._memory_utility import pad_to_multiple
+    v = jnp.arange(10.0)
+    padded, n = pad_to_multiple(v, 4)
+    assert padded.shape == (12,) and n == 10
+    np.testing.assert_array_equal(np.asarray(padded[10:]), 0.0)
+    same, n = pad_to_multiple(v, 5)
+    assert same is v and n == 10  # already a multiple: no copy
+
+
+def test_hierarchical_exchanged_bytes_per_hop():
+    """ISSUE 6 satellite: the per-hop rs+ag byte accounting — DCN only
+    ever carries the 1/intra chunk."""
+    from chainermn_tpu.communicators._memory_utility import (
+        hierarchical_exchanged_bytes)
+    # 800 bytes over 4×2: ici rs+ag = 2·800·3/4 = 1200; dcn allreduce
+    # on the 200-byte chunk = 2·200·1/2 = 200
+    hops = hierarchical_exchanged_bytes(800, 4, 2, "psum")
+    assert hops == {"ici": 1200, "dcn": 200}
+    # reduce-scatter / all-gather: one crossing per hop
+    assert hierarchical_exchanged_bytes(800, 4, 2, "reduce_scatter") == \
+        {"ici": 600, "dcn": 100}
+    assert hierarchical_exchanged_bytes(800, 4, 2, "all_gather") == \
+        {"ici": 600, "dcn": 100}
+
+
+def test_hierarchical_bytes_ring_identity():
+    """The hierarchy relocates bytes onto the fast wires without adding
+    any: hop totals equal the flat ring figure over intra·inter ranks."""
+    from chainermn_tpu.communicators._memory_utility import (
+        exchanged_bytes, hierarchical_exchanged_bytes)
+    for n, intra, inter in ((1 << 20, 4, 2), (1 << 16, 8, 4),
+                            (960, 4, 4)):
+        hops = hierarchical_exchanged_bytes(n, intra, inter, "psum")
+        assert hops["ici"] + hops["dcn"] == \
+            exchanged_bytes(n, intra * inter, "psum"), (n, intra, inter)
+
+
+def test_hierarchical_bytes_degenerate_and_dtype():
+    from chainermn_tpu.communicators._memory_utility import (
+        hierarchical_exchanged_bytes)
+    # one host (inter=1): nothing crosses DCN
+    assert hierarchical_exchanged_bytes(800, 4, 1, "psum")["dcn"] == 0
+    # one device per host (intra=1): ICI moves nothing, DCN carries all
+    hops = hierarchical_exchanged_bytes(800, 1, 8, "psum")
+    assert hops["ici"] == 0 and hops["dcn"] == 1400
+    # per-hop dtype override: a bf16 DCN chunk halves only the slow hop
+    f32 = hierarchical_exchanged_bytes(800, 4, 2, "psum")
+    bf16 = hierarchical_exchanged_bytes(800, 4, 2, "psum",
+                                        dcn_n_bytes=100)
+    assert bf16["ici"] == f32["ici"]
+    assert bf16["dcn"] * 2 == f32["dcn"]
+    # guardrails
+    import pytest
+    with pytest.raises(ValueError, match="divisible"):
+        hierarchical_exchanged_bytes(801, 4, 2)
+    with pytest.raises(ValueError, match="collective"):
+        hierarchical_exchanged_bytes(800, 4, 2, "alltoall")
+    with pytest.raises(ValueError, match=">= 1"):
+        hierarchical_exchanged_bytes(800, 0, 2)
+
+
+def test_hop_schedule_slow_hop_first():
+    """The emission schedule the hierarchical grad_transform follows:
+    per-bucket dataflow order, buckets in plan order, and EVERY dcn op
+    before ANY fast-hop all_gather."""
+    from chainermn_tpu.communicators._memory_utility import hop_schedule
+    assert hop_schedule(0) == []
+    for k in (1, 2, 5):
+        sched = hop_schedule(k)
+        assert len(sched) == 3 * k
+        pos = {}
+        for i, (op, b) in enumerate(sched):
+            pos[(op, b)] = i
+        for b in range(k):
+            assert pos[("ici_reduce_scatter", b)] \
+                < pos[("dcn_exchange", b)] \
+                < pos[("ici_all_gather", b)]
+            if b:
+                assert pos[("dcn_exchange", b - 1)] \
+                    < pos[("dcn_exchange", b)]
+        last_dcn = max(pos[("dcn_exchange", b)] for b in range(k))
+        first_ag = min(pos[("ici_all_gather", b)] for b in range(k))
+        assert last_dcn < first_ag
+    import pytest
+    with pytest.raises(ValueError):
+        hop_schedule(-1)
+
+
 def test_orthogonal_initializer():
     from chainermn_tpu.nn.initializers import Orthogonal
     W = Orthogonal()((6, 6), np.float32, np.random.RandomState(0))

@@ -145,6 +145,112 @@ def test_multi_node_iterator_replica_follows_master():
     np.testing.assert_array_equal(batch_m, batch_r)
     assert replica.epoch_detail == master.epoch_detail
 
+class _FakeHostHierComm(_FakeHostComm):
+    """Two-host harness view of a HIERARCHICAL communicator: the device
+    mesh carries the (dcn, ici) split while the host-level overrides
+    present the matching 2-controller topology — the configuration a
+    real 2-host pod reports."""
+
+    def __init__(self, host, peer_box):
+        super().__init__(host, peer_box, name="hierarchical",
+                         inter_size=2)
+
+
+def test_from_mesh_axes_two_level_topology():
+    """MeshCommunicator.from_mesh_axis on a 2-axis mesh (ISSUE 6
+    satellite): a (dcn, ici) tuple builds a hierarchical communicator
+    whose intra/inter views match the mesh split, independent of the
+    mesh's own axis order."""
+    import jax as _jax
+    from jax.sharding import Mesh
+    devs = np.asarray(_jax.devices())
+    if devs.size < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(devs.reshape(2, 4), ("dcn", "ici"))
+    comm = MeshCommunicator.from_mesh_axis(mesh, ("dcn", "ici"))
+    assert comm.hierarchy == ("dcn", "ici")
+    assert comm.topology == "hierarchical"
+    assert comm.size == 8
+    assert comm.dcn_size == 2 and comm.ici_size == 4
+    assert comm.intra_size == 4  # mesh view of "ranks per node"
+    assert comm.chunk_axes() == ("ici", "dcn")
+    # the collectives address the ENCLOSING mesh (from_mesh_axis
+    # contract) — its axes must carry the hierarchy's names
+    assert comm.mesh is mesh
+
+    # mesh declared in the REVERSED axis order: the communicator's
+    # (dcn, ici) request must still resolve each axis by NAME
+    mesh_r = Mesh(devs.reshape(4, 2), ("ici", "dcn"))
+    comm_r = MeshCommunicator.from_mesh_axis(mesh_r, ("dcn", "ici"))
+    assert comm_r.dcn_size == 2 and comm_r.ici_size == 4
+    assert comm_r.hierarchy == ("dcn", "ici")
+
+    # device grid ordering: group g of the dcn axis holds the devices
+    # of mesh column/row g — the (dcn-major, ici-minor) flatten
+    grid = np.asarray(comm._devices).reshape(2, 4)
+    for d in range(2):
+        assert {dev.id for dev in grid[d]} == \
+            {dev.id for dev in mesh.devices[d]}
+
+
+def test_from_mesh_axes_on_wider_mesh_picks_representatives():
+    """On a 3-axis mesh the 2-tuple path spans (dcn, ici) and takes one
+    representative device per remaining-axis position — same contract
+    as the 1-axis from_mesh_axis."""
+    import jax as _jax
+    from jax.sharding import Mesh
+    devs = np.asarray(_jax.devices())
+    if devs.size < 8:
+        pytest.skip("needs 8 devices")
+    mesh = Mesh(devs.reshape(2, 2, 2), ("dcn", "ici", "mp"))
+    comm = MeshCommunicator.from_mesh_axis(mesh, ("dcn", "ici"))
+    assert comm.size == 4
+    assert comm.dcn_size == 2 and comm.ici_size == 2
+    got = {d.id for d in comm._devices}
+    assert got == {int(mesh.devices[i, j, 0].id)
+                   for i in range(2) for j in range(2)}
+
+
+def test_hierarchical_ranks_under_two_host_harness():
+    """intra_rank/inter_rank/intra_size/inter_size of a hierarchical
+    communicator under the simulated 2-host topology: the host-level
+    view (inter_*) matches the dcn split, the device-level view
+    (intra_*) matches the ici split, and the reference slot arithmetic
+    holds on both hosts."""
+    box = {}
+    a = _FakeHostHierComm(0, box)
+    b = _FakeHostHierComm(1, box)
+    for host, comm in enumerate((a, b)):
+        assert comm.inter_rank == host
+        assert comm.inter_size == 2 == comm.dcn_size
+        assert comm.intra_size == 4 == comm.ici_size
+        assert comm.intra_rank == 0  # first slot this controller drives
+        assert 0 <= comm.intra_rank < comm.intra_size
+        assert comm.inter_rank * comm.intra_size + comm.intra_rank \
+            < comm.size
+    # host-level object ops still shard by CONTROLLER rank: the
+    # hierarchy must not break scatter_dataset's per-host split
+    data = np.arange(64)
+    shard_a = ct.scatter_dataset(data, a, shuffle=True, seed=7)
+    shard_b = ct.scatter_dataset(data, b, shuffle=True, seed=7)
+    assert len(shard_a) == len(shard_b) == 32
+    assert not ({int(x) for x in shard_a} & {int(x) for x in shard_b})
+
+
+def test_hierarchical_simulated_split_keeps_host_semantics():
+    """A SIMULATED split (inter_size=2 on one controller) changes only
+    the device-mesh view: the host/object-channel view stays
+    single-controller, so scatter_dataset still feeds the full dataset
+    (the compiled step expects the global batch) — the trap the
+    dcn_size/inter_size separation exists to avoid."""
+    comm = ct.create_communicator("hierarchical", inter_size=2)
+    assert comm.dcn_size == 2 and comm.ici_size == comm.size // 2
+    assert comm.inter_size == 1  # one controller process
+    data = np.arange(48)
+    shard = ct.scatter_dataset(data, comm)
+    assert len(shard) == 48
+
+
 def test_evaluator_weighted_by_sample_counts():
     """Cross-host metric reduction weights by per-key observation counts
     (VERDICT r1 Weak #6: ragged shards skewed the unweighted mean)."""

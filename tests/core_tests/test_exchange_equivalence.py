@@ -1,13 +1,17 @@
-"""Golden equality of every gradient-exchange variant (ISSUE 5).
+"""Golden equality of every gradient-exchange variant (ISSUE 5 + 6).
 
 The exchange structure — per-leaf psums, one flat bucket, K size-bounded
-buckets, or reduce-scatter + shard update + all-gather — changes the
-SCHEDULE of the DP step, never its math.  Golden rule (SURVEY §4): each
+buckets, reduce-scatter + shard update + all-gather, or the two-level
+hierarchical (ici × dcn) composition of either — changes the SCHEDULE
+of the DP step, never its math.  Golden rule (SURVEY §4): each
 variant's trajectory must EQUAL the single-device run on the merged
 batch; the allreduce packings must be BITWISE equal to each other
-(pmean is elementwise), and the reduce-scatter update must match to
-f32 reduction-order noise.  Composition axes from the ISSUE grid:
-{donation, double buffering, compressed dtype} × the four exchanges.
+(pmean is elementwise), and the reduce-scatter / hierarchical updates
+must match to f32 reduction-order noise (chained per-hop sums reorder
+the additions).  Composition axes from the ISSUE grids: {donation,
+double buffering, compressed dtype} × the exchanges; the hierarchical
+legs run on a SIMULATED 2-host split (``inter_size=2`` → dcn 2 × ici
+4) of the 8-device CPU mesh.
 
 Compile budget: every run here is a small MLP step (~1 s CPU compile);
 the grid is kept to ~a dozen compiles so the suite stays tier-1-cheap.
@@ -27,7 +31,11 @@ STEPS = 3
 #: tiny bound so even the toy MLP splits into several buckets
 TINY_BUCKET_MB = 2000 / 2 ** 20
 
-_BC = {"per_leaf": False, "flat": True, "bucketed": "bucketed"}
+_BC = {"per_leaf": False, "flat": True, "bucketed": "bucketed",
+       "hierarchical_bucketed": "bucketed"}
+#: exchange names that run on the two-level communicator (simulated
+#: 2-host split); *_rs routes through the sharded-update step
+_HIER = ("hierarchical", "hierarchical_bucketed", "hierarchical_rs")
 
 
 def _data(seed=0, n=32, d=8, k=4):
@@ -45,13 +53,16 @@ def _run(exchange, double_buffering=False, donate=True, grad_dtype=None,
     """Trajectory (losses, params) of one exchange variant.
 
     ``exchange``: per_leaf | flat | bucketed (communicator flavors of
-    the allreduce) | reduce_scatter (the optimizer-level step variant).
+    the allreduce) | reduce_scatter (the optimizer-level step variant)
+    | hierarchical / hierarchical_bucketed / hierarchical_rs (the same
+    structures on the two-level communicator, simulated 2-host split).
     """
     opt_kw = opt_kw or dict(lr=0.1, momentum=0.9)
     comm = ct.create_communicator(
-        "jax_ici",
+        "hierarchical" if exchange in _HIER else "jax_ici",
+        inter_size=2 if exchange in _HIER else None,
         batch_collectives=_BC.get(exchange, True),
-        bucket_mb=TINY_BUCKET_MB if exchange == "bucketed" else None,
+        bucket_mb=TINY_BUCKET_MB if "bucketed" in exchange else None,
         allreduce_grad_dtype=grad_dtype)
     model = _model()
     comm.bcast_data(model)
@@ -59,7 +70,8 @@ def _run(exchange, double_buffering=False, donate=True, grad_dtype=None,
     inner.donate_params = donate
     opt = ct.create_multi_node_optimizer(
         inner, comm, double_buffering=double_buffering,
-        exchange="reduce_scatter" if exchange == "reduce_scatter"
+        exchange="reduce_scatter"
+        if exchange in ("reduce_scatter", "hierarchical_rs")
         else "allreduce").setup(model)
     x, t = _data()
     losses = [float(opt.update(model, x, t)) for _ in range(steps)]
@@ -84,10 +96,12 @@ def golden():
 
 @pytest.mark.parametrize("exchange",
                          ["per_leaf", "flat", "bucketed",
-                          "reduce_scatter"])
+                          "reduce_scatter", "hierarchical",
+                          "hierarchical_bucketed", "hierarchical_rs"])
 def test_exchange_matches_single_device_golden(exchange, golden):
-    """Acceptance bar: all exchange variants golden-equal to the
-    single-device trajectory on the CPU mesh."""
+    """Acceptance bar: all exchange variants — including the two-level
+    hierarchical ones on the simulated 2-host mesh — golden-equal to
+    the single-device trajectory on the CPU mesh."""
     glosses, gparams = golden
     losses, params, _ = _run(exchange)
     np.testing.assert_allclose(losses, glosses, rtol=1e-5, atol=1e-7,
@@ -109,14 +123,17 @@ def test_allreduce_packings_bitwise_equal():
 
 
 def test_double_buffering_grid_equal():
-    """Double buffering × {flat, bucketed, reduce_scatter}: the
-    one-step-stale semantics are exchange-independent (first update
-    applies zeros, update t applies grads of t-1) — including the
-    reduce-scatter variant, whose stale buffer is the sharded chunk."""
+    """Double buffering × {flat, bucketed, reduce_scatter,
+    hierarchical, hierarchical_rs}: the one-step-stale semantics are
+    exchange-independent (first update applies zeros, update t applies
+    grads of t-1) — including the reduce-scatter variants, whose stale
+    buffer is the sharded chunk (on the hierarchical mesh: the
+    1/(ici·dcn) chunk in the fast-hop-major layout)."""
     ref = _run("flat", double_buffering=True, steps=4)
     # stale application is observable: step 2's loss equals step 1's
     assert ref[0][0] == ref[0][1]
-    for exchange in ("bucketed", "reduce_scatter"):
+    for exchange in ("bucketed", "reduce_scatter", "hierarchical",
+                     "hierarchical_rs"):
         losses, params, _ = _run(exchange, double_buffering=True, steps=4)
         np.testing.assert_allclose(losses, ref[0], rtol=1e-5, atol=1e-7,
                                    err_msg=f"db×{exchange} diverged")
@@ -124,11 +141,12 @@ def test_double_buffering_grid_equal():
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
-def test_donation_off_matches_donation_on():
-    """The donation axis of the grid, on the new reduce-scatter step:
-    buffer aliasing must not change the trajectory."""
-    on = _run("reduce_scatter", donate=True)
-    off = _run("reduce_scatter", donate=False)
+@pytest.mark.parametrize("exchange", ["reduce_scatter", "hierarchical"])
+def test_donation_off_matches_donation_on(exchange):
+    """The donation axis of the grid, on the sharded-update and
+    two-level steps: buffer aliasing must not change the trajectory."""
+    on = _run(exchange, donate=True)
+    off = _run(exchange, donate=False)
     np.testing.assert_allclose(on[0], off[0], rtol=1e-6, atol=1e-8)
     for a, b in zip(on[1], off[1]):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
@@ -147,6 +165,67 @@ def test_compressed_dtype_composes():
     rs_losses, _, _ = _run("reduce_scatter", grad_dtype="bfloat16",
                            steps=5)
     assert np.isfinite(rs_losses).all() and rs_losses[-1] < rs_losses[0]
+    # hierarchical × bf16 (BOTH hops compressed): chained per-hop sums
+    # reorder bf16 roundings, so equality to the flat bf16 leg is
+    # approximate at bf16 precision — and the run must learn
+    h_losses, _, _ = _run("hierarchical", grad_dtype="bfloat16", steps=5)
+    np.testing.assert_allclose(h_losses[:3], flat[0], rtol=5e-3,
+                               err_msg="hier×bf16 far from flat×bf16")
+    assert np.isfinite(h_losses).all() and h_losses[-1] < h_losses[0]
+
+
+def test_per_hop_dtype_stays_close_to_lossless():
+    """allreduce_grad_dtype={'dcn': 'bfloat16'} (lossless ICI +
+    compressed DCN — the knob that halves only the slow hop's bytes):
+    trajectory stays within bf16 rounding of the f32 hierarchical run
+    and learns."""
+    f32 = _run("hierarchical", steps=5)
+    dcn = _run("hierarchical", grad_dtype={"dcn": "bfloat16"}, steps=5)
+    np.testing.assert_allclose(dcn[0], f32[0], rtol=5e-3,
+                               err_msg="dcn-bf16 far from lossless")
+    assert dcn[0][-1] < dcn[0][0]
+
+
+def test_hierarchical_rs_grad_not_populated():
+    """The sharded-update contract holds on the two-level step too:
+    the full mean gradient never materializes."""
+    _, _, opt = _run("hierarchical_rs")
+    assert all(p.grad is None for p in opt.target.params())
+
+
+def test_hierarchical_update_scan_continues_trajectory():
+    """hierarchical × fused K-step dispatch: the scan continues the
+    SAME trajectory as the golden run's steps 4-5 (both the allreduce
+    and the sharded-update hierarchical steps drive the scan maker)."""
+    glosses, _ = _golden(steps=5)
+    for exchange in ("hierarchical", "hierarchical_rs"):
+        losses, _, opt = _run(exchange, steps=3)
+        x, t = _data()
+        scan_losses = np.asarray(opt.update_scan(
+            opt.target, jnp.stack([x, x]), jnp.stack([t, t])))
+        np.testing.assert_allclose(list(losses) + list(scan_losses),
+                                   glosses, rtol=1e-5, atol=1e-7,
+                                   err_msg=f"{exchange} scan diverged")
+
+
+def test_double_buffered_hierarchical_rs_resume_bit_exact(tmp_path):
+    """Serialize → restore → continue is bit-exact for the
+    hierarchical reduce-scatter double-buffering pair: the stale chunk
+    (fast-hop-major layout) round-trips through the flat-vector
+    serialization exactly like the one-axis layout does."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    losses_a, _, opt = _run("hierarchical_rs", double_buffering=True,
+                            steps=2)
+    save_npz(path, opt)
+    cont_ref = [float(opt.update(opt.target, x, t)) for _ in range(2)]
+
+    _, _, fresh = _run("hierarchical_rs", double_buffering=True, steps=1)
+    load_npz(path, fresh)
+    cont = [float(fresh.update(fresh.target, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_ref, rtol=0, atol=0)
 
 
 def test_reduce_scatter_grad_not_populated():

@@ -9,6 +9,10 @@ it.  Two layers:
   per-leaf/flat/bucketed psum counts, the reduce-scatter step's
   reduce_scatter+all_gather replacing the full-gradient allreduce, and
   the exchanged-bytes accounting (gradient bytes exactly halved).
+  ISSUE 6 adds the hierarchical (ici × dcn) configs on a simulated
+  2-host split: per-hop collective counts resolved from eqn axis
+  names, the DCN gradient payload pinned at exactly 1/intra_size, the
+  slow-hop-first emission order, and per-hop dtype compression.
   Verified against the traced program, not against documentation.
 * NUMBERS (measured on chip by the recovery queue's bucket sweep /
   exposed-comm A/B): dormant while ``sweep.status`` is
@@ -123,6 +127,90 @@ def test_reduce_scatter_replaces_allreduce_and_halves_gradient_bytes(live):
         flat["exchanged_gradient_bytes_per_replica"]
     # the params all-gather is accounted separately, never hidden
     assert rs["exchanged_param_bytes_per_replica"] > 0
+
+
+def test_hierarchical_per_hop_structure(live):
+    """The ISSUE 6 tentpole, machine-checked: the hierarchical step is
+    intra-host reduce_scatter over ICI → chunk allreduce over DCN →
+    intra-host all_gather over ICI — per-hop counts resolved from the
+    eqns' own axis names, never a full-axis gradient collective."""
+    row = live["hierarchical"]
+    assert row["topology"] == "hierarchical"
+    assert row["intra_size"] == 4 and row["inter_size"] == 2
+    assert row["per_hop"]["ici"]["collectives"] == \
+        {"reduce_scatter": 1, "all_gather": 1}
+    assert row["per_hop"]["dcn"]["collectives"] == {"psum": 1}
+    # no hop label beyond ici/dcn: a residual full-axis collective
+    # would surface as a "both"/"world" key here
+    assert set(row["per_hop"]) == {"ici", "dcn"}
+
+
+def test_hierarchical_dcn_payload_ratio_pinned(budgets, live):
+    """Acceptance bar: DCN only ever carries 1/intra_size of the
+    gradient — pinned from the traced operand sizes on every
+    hierarchical config."""
+    for name, row in live.items():
+        if row.get("topology") != "hierarchical":
+            continue
+        assert row["dcn_grad_payload_ratio"] == \
+            pytest.approx(1.0 / row["intra_size"], abs=0), name
+        assert budgets["structure"][name]["dcn_grad_payload_ratio"] == \
+            row["dcn_grad_payload_ratio"]
+
+
+def test_hierarchical_slow_hop_first_schedule(live):
+    """hop_schedule's ordering promise survives tracing: every DCN
+    collective is emitted before ANY fast-hop all_gather (the slow hop
+    starts first; ICI rebuilds overlap the remaining DCN traffic)."""
+    for name, row in live.items():
+        if row.get("topology") == "hierarchical":
+            assert row["hop_ordered"], name
+
+
+def test_hierarchical_buckets_compose_with_topology(live):
+    """PR 5's bucket planner composes with the two-level exchange: K
+    buckets at the default bound → K reduce_scatters, K DCN allreduces,
+    K all_gathers — same K as the flat-topology bucketed config."""
+    k = live["bucketed"]["grad_collectives"]["psum"]
+    row = live["hierarchical_bucketed"]
+    assert row["grad_collectives"] == \
+        {"reduce_scatter": k, "psum": k, "all_gather": k}
+
+
+def test_hierarchical_total_bytes_match_flat_ring(live):
+    """The ring identity: the hierarchy relocates bytes onto the fast
+    wires without adding any — hop totals sum to the flat allreduce's
+    per-replica figure (2n(N-1)/N over N = intra × inter)."""
+    assert live["hierarchical"]["exchanged_gradient_bytes_per_replica"] \
+        == live["flat"]["exchanged_gradient_bytes_per_replica"]
+
+
+def test_per_hop_dtype_halves_only_dcn(live):
+    """allreduce_grad_dtype={'dcn': 'bfloat16'}: the DCN crossing
+    halves, ICI stays lossless byte-for-byte."""
+    f32 = live["hierarchical"]["per_hop"]
+    bf16 = live["hierarchical_dcn_bf16"]["per_hop"]
+    assert bf16["ici"]["exchanged_grad_bytes"] == \
+        f32["ici"]["exchanged_grad_bytes"]
+    assert bf16["dcn"]["exchanged_grad_bytes"] * 2 == \
+        f32["dcn"]["exchanged_grad_bytes"]
+
+
+def test_hierarchical_rs_shards_both_hops(live):
+    """exchange='reduce_scatter' × hierarchical: the gradient crosses
+    each hop ONCE (rs over ici on the full buffer, rs over dcn on the
+    1/intra chunk), the params rebuild all-gathers both hops, and the
+    gradient bytes match the flat reduce-scatter exchange (half the
+    allreduce) while the DCN share is 1/intra of that."""
+    row = live["hierarchical_rs"]
+    assert row["per_hop"]["ici"]["collectives"] == \
+        {"reduce_scatter": 1, "all_gather": 1}
+    assert row["per_hop"]["dcn"]["collectives"] == \
+        {"reduce_scatter": 1, "all_gather": 1}
+    assert row["exchanged_gradient_bytes_per_replica"] == \
+        live["reduce_scatter"]["exchanged_gradient_bytes_per_replica"]
+    assert row["exchanged_param_bytes_per_replica"] == \
+        live["reduce_scatter"]["exchanged_param_bytes_per_replica"]
 
 
 def test_measured_sweep_meets_tolerance_when_present(budgets):

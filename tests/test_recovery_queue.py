@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,33 +78,36 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 16 bench steps recorded, each once, in queue order
+    # all 17 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # prewarm
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # flagship
-        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd",  # donation A/B
-        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # headroom
-        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd",  # input pipeline
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",  # prewarm
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",  # flagship
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd",  # donation
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",  # headroom
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd",  # input
         # ISSUE 5: bucket-MB sweep + reduce-scatter A/B legs
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd",
-        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd",  # remat
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd",  # dots
-        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd",  # flash rows
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd",
+        # ISSUE 6: hierarchical two-level exchange, forced 2x4 split
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2",
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd",
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd",  # flash
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
-    # exposed-comm A/B (ISSUE 5): three gloo curves (flat, bucketed,
-    # reduce_scatter), folded in their own section after the main fold
+    # exposed-comm A/B (ISSUE 5 + 6): four gloo curves (flat, bucketed,
+    # reduce_scatter, hierarchical), folded in their own section after
+    # the main fold
     assert [ln for ln in notes_text.splitlines() if '"gloo"' in ln] == [
         '{"gloo": "flat"}', '{"gloo": "bucketed"}',
-        '{"gloo": "reduce_scatter"}']
+        '{"gloo": "reduce_scatter"}', '{"gloo": "hierarchical"}']
     assert notes_text.index("On-chip results") \
         < notes_text.index("Exposed-comm A/B rows")
     # flashcmp rows recorded in their own section AFTER the main fold
@@ -146,8 +149,8 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the twelve bench rows must
-    already be folded, and NO empty 'Flash-vs-XLA' section may be
+    the queue must still complete (|| true), the seventeen bench rows
+    must already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
     shim.mkdir()
@@ -170,5 +173,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 16
+                if '"final"' in ln]) == 17
     assert "Flash-vs-XLA" not in notes_text

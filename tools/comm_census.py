@@ -17,11 +17,24 @@ where ``tests/test_comm_budget.py`` holds every future PR to it
                       all_gather(params)``: the full-gradient allreduce
                       is GONE from the census and per-replica exchanged
                       gradient bytes halve
+* ``hierarchical*``  — the two-level (ici × dcn) exchange (ISSUE 6) on
+                      a SIMULATED 2-host split of the 8-device mesh
+                      (``inter_size=2`` → dcn 2 × ici 4): per-hop
+                      collectives with axis-name-resolved counts, the
+                      DCN gradient payload pinned at exactly
+                      ``1/ici_size`` of the full gradient, the
+                      slow-hop-first emission order
+                      (``hop_schedule``), and per-hop dtype
+                      (``hierarchical_dcn_bf16`` halves only the DCN
+                      crossing)
 
 The census runs on the CPU mesh (tests/conftest.py's simulated 8
 devices) over a small-but-real transformer vertical whose gradients
 exceed the default bucket bound, so ``bucketed`` provably emits K>1
-collectives at the DEFAULT bucket size.
+collectives at the DEFAULT bucket size.  Every census row resolves the
+collective's mesh AXES, so the hierarchical configs commit which hop
+each transfer rides — the per-hop structure the tentpole promises is
+machine-checked, not narrated.
 
 Unlike the flash/HBM budgets' measured halves, the structure section
 here may be (re)generated off-chip — it is a trace property —
@@ -61,6 +74,10 @@ GRAD_ELEMS_FLOOR = 16
 VERTICAL = dict(n_vocab=8192, d_model=256, n_heads=4, n_layers=2,
                 max_len=64, bs=8, seq=32)
 
+#: simulated 2-host split for the hierarchical configs (8 devices →
+#: dcn 2 × ici 4); the DCN payload ratio below is pinned to 1/ici
+HIER_INTER_SIZE = 2
+
 CONFIGS = {
     "per_leaf": dict(batch_collectives=False, grad_dtype=None,
                      exchange="allreduce"),
@@ -72,6 +89,22 @@ CONFIGS = {
                           grad_dtype="bfloat16", exchange="allreduce"),
     "reduce_scatter": dict(batch_collectives=True, grad_dtype=None,
                            exchange="reduce_scatter"),
+    "hierarchical": dict(batch_collectives=True, grad_dtype=None,
+                         exchange="allreduce", comm="hierarchical",
+                         inter_size=HIER_INTER_SIZE),
+    "hierarchical_bucketed": dict(batch_collectives="bucketed",
+                                  grad_dtype=None, exchange="allreduce",
+                                  comm="hierarchical",
+                                  inter_size=HIER_INTER_SIZE),
+    "hierarchical_dcn_bf16": dict(batch_collectives=True,
+                                  grad_dtype={"dcn": "bfloat16"},
+                                  exchange="allreduce",
+                                  comm="hierarchical",
+                                  inter_size=HIER_INTER_SIZE),
+    "hierarchical_rs": dict(batch_collectives=True, grad_dtype=None,
+                            exchange="reduce_scatter",
+                            comm="hierarchical",
+                            inter_size=HIER_INTER_SIZE),
 }
 
 
@@ -93,9 +126,22 @@ def _walk_jaxpr(jaxpr, visit):
                     _walk_jaxpr(v, visit)
 
 
+def _eqn_axes(eqn):
+    """Mesh axis names a collective eqn runs over, as a sorted list —
+    ``psum`` carries them as ``axes``, ``reduce_scatter``/``all_gather``
+    as ``axis_name`` (possibly a bare string).  The hop resolution the
+    per-hop census rides on."""
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    return sorted(str(a) for a in axes)
+
+
 def collective_census(jaxpr):
-    """All collective eqns in the (closed) jaxpr: list of
-    ``{"prim", "elems", "dtype"}``, one row per operand."""
+    """All collective eqns in the (closed) jaxpr, in PROGRAM ORDER
+    (depth-first emission order — the hop-ordering gate relies on it):
+    list of ``{"prim", "elems", "dtype", "axes"}``, one row per
+    operand."""
     import jax
     if isinstance(jaxpr, jax.core.ClosedJaxpr):
         jaxpr = jaxpr.jaxpr
@@ -110,10 +156,52 @@ def collective_census(jaxpr):
                 continue
             rows.append({"prim": eqn.primitive.name,
                          "elems": int(np.prod(aval.shape, dtype=np.int64)),
-                         "dtype": str(aval.dtype)})
+                         "dtype": str(aval.dtype),
+                         "axes": _eqn_axes(eqn)})
 
     _walk_jaxpr(jaxpr, visit)
     return rows
+
+
+def row_hop(row, comm):
+    """Hop label of a census row: ``dcn``/``ici`` on a hierarchical
+    communicator (resolved from the eqn's own axis names), ``world``
+    on a flat one.  Anything else (e.g. a residual full-axis
+    collective) surfaces as a joined label the per-hop gates reject."""
+    if comm.hierarchy is None:
+        return "world"
+    axes = set(row["axes"])
+    if axes == {comm.dcn_axis}:
+        return "dcn"
+    if axes == {comm.ici_axis}:
+        return "ici"
+    return "+".join(row["axes"])
+
+
+def row_ring(row, comm):
+    """Ring size of a census row's collective: the product of its mesh
+    axis sizes."""
+    out = 1
+    for a in row["axes"]:
+        out *= int(comm.mesh.shape[a])
+    return out
+
+
+def row_wire_bytes(row, comm):
+    """Per-replica wire bytes of one census row under the ring
+    decomposition, in the row's own operand dtype (``all_gather``
+    operands are the per-rank chunk; the accounting is over the full
+    gathered buffer) — the ONE pricing rule config_row and the
+    PROBE=comm per-hop table share."""
+    import jax.numpy as jnp
+    from chainermn_tpu.communicators._memory_utility import exchanged_bytes
+    ring = row_ring(row, comm)
+    n_bytes = row["elems"] * jnp.dtype(row["dtype"]).itemsize
+    if row["prim"] == "all_gather":
+        return exchanged_bytes(n_bytes * ring, ring, "all_gather")
+    if row["prim"] == "psum":
+        return exchanged_bytes(n_bytes, ring, "psum")
+    return exchanged_bytes(n_bytes, ring, "reduce_scatter")
 
 
 class _Vertical:
@@ -149,7 +237,8 @@ class _Vertical:
 
 
 def trace_step(exchange="allreduce", batch_collectives=True,
-               grad_dtype=None, bucket_mb=None):
+               grad_dtype=None, bucket_mb=None, comm_name="jax_ici",
+               inter_size=None):
     """Jaxpr of the REAL compiled multi-node train step for one config
     — the exact step makers ``update()`` dispatches, traced instead of
     executed (no XLA compile; CPU-safe)."""
@@ -159,8 +248,9 @@ def trace_step(exchange="allreduce", batch_collectives=True,
 
     vert = _Vertical.get()
     comm = ct.create_communicator(
-        "jax_ici", batch_collectives=batch_collectives,
-        allreduce_grad_dtype=grad_dtype, bucket_mb=bucket_mb)
+        comm_name, batch_collectives=batch_collectives,
+        allreduce_grad_dtype=grad_dtype, bucket_mb=bucket_mb,
+        inter_size=inter_size)
     comm.bcast_data(vert.model)
     from chainermn_tpu.core.optimizer import MomentumSGD
     inner = MomentumSGD(lr=0.1, momentum=0.9)
@@ -182,14 +272,26 @@ def trace_step(exchange="allreduce", batch_collectives=True,
 
 
 def config_row(name):
-    """Computed census row for one committed config."""
-    from chainermn_tpu.communicators._memory_utility import exchanged_bytes
+    """Computed census row for one committed config.
+
+    Per-row accounting (the shared ``row_hop``/``row_ring``/
+    ``row_wire_bytes`` helpers) resolves each collective's mesh AXES to
+    a ring size and a hop label (``dcn`` / ``ici`` on hierarchical
+    configs, ``world`` on flat ones), in the row's own operand dtype —
+    so the per-hop dtype variant's halved DCN bytes fall out of the
+    trace, not out of config metadata.  Classification: ``psum`` and
+    ``reduce_scatter`` rows carry GRADIENT bytes; ``all_gather`` rows
+    carry the gradient rebuild on the allreduce exchanges (the
+    hierarchical fast-hop gather) and the PARAMS rebuild on the
+    reduce-scatter exchanges."""
     cfg = CONFIGS[name]
     bucket_mb = cfg.get("bucket_mb")
     jaxpr, comm = trace_step(exchange=cfg["exchange"],
                              batch_collectives=cfg["batch_collectives"],
                              grad_dtype=cfg["grad_dtype"],
-                             bucket_mb=bucket_mb)
+                             bucket_mb=bucket_mb,
+                             comm_name=cfg.get("comm", "jax_ici"),
+                             inter_size=cfg.get("inter_size"))
     census = collective_census(jaxpr)
     grad = [r for r in census if r["elems"] >= GRAD_ELEMS_FLOOR]
     counts = {}
@@ -199,36 +301,60 @@ def config_row(name):
         elems.setdefault(r["prim"], []).append(r["elems"])
     for v in elems.values():
         v.sort(reverse=True)
-    import jax.numpy as jnp
-    grad_itemsize = jnp.dtype(cfg["grad_dtype"] or "float32").itemsize
-    size = comm.size
-    # accounting: psum rows are gradient allreduces; reduce_scatter rows
-    # are the gradient's single crossing; all_gather rows are the params
-    # rebuild (param dtype, not grad dtype)
-    grad_bytes = sum(
-        exchanged_bytes(r["elems"] * grad_itemsize, size, "psum")
-        for r in grad if r["prim"] == "psum")
-    grad_bytes += sum(
-        exchanged_bytes(r["elems"] * grad_itemsize, size, "reduce_scatter")
-        for r in grad if r["prim"] == "reduce_scatter")
-    # all_gather operands are the per-rank CHUNK; the ring accounting is
-    # over the full gathered buffer (chunk × size), in the operand dtype
-    param_bytes = sum(
-        exchanged_bytes(
-            r["elems"] * size * jnp.dtype(r["dtype"]).itemsize,
-            size, "all_gather")
-        for r in grad if r["prim"] == "all_gather")
-    return {
+    hier = comm.hierarchy
+    rs_exchange = cfg["exchange"] == "reduce_scatter"
+    per_hop = {}
+    grad_bytes = 0
+    param_bytes = 0
+    for r in grad:
+        wire = row_wire_bytes(r, comm)
+        is_param = rs_exchange and r["prim"] == "all_gather"
+        hop = per_hop.setdefault(row_hop(r, comm), {
+            "collectives": {}, "exchanged_grad_bytes": 0,
+            "exchanged_param_bytes": 0})
+        hop["collectives"][r["prim"]] = \
+            hop["collectives"].get(r["prim"], 0) + 1
+        if is_param:
+            hop["exchanged_param_bytes"] += int(wire)
+            param_bytes += wire
+        else:
+            hop["exchanged_grad_bytes"] += int(wire)
+            grad_bytes += wire
+    row = {
         "exchange": cfg["exchange"],
         "batch_collectives": cfg["batch_collectives"],
         "grad_dtype": cfg["grad_dtype"],
         "bucket_mb": bucket_mb,
+        "topology": comm.topology,
+        "intra_size": comm.ici_size,
+        "inter_size": comm.dcn_size,
         "grad_collectives": counts,
         "grad_collective_elems": elems,
+        "per_hop": per_hop,
         "n_buckets": counts.get("psum", 0),
         "exchanged_gradient_bytes_per_replica": int(grad_bytes),
         "exchanged_param_bytes_per_replica": int(param_bytes),
     }
+    if hier is not None:
+        # the tentpole's byte contract: the largest gradient buffer that
+        # crosses DCN is exactly 1/ici of the full gradient (per bucket:
+        # the reduce-scattered chunk) — pin the ratio from the TRACE
+        vert = _Vertical.get()
+        dcn_grad_rows = [r for r in grad if row_hop(r, comm) == "dcn"
+                         and (r["prim"] in ("psum", "reduce_scatter"))]
+        dcn_payload = sum(r["elems"] for r in dcn_grad_rows)
+        row["dcn_grad_payload_ratio"] = dcn_payload / vert.n_params
+        # slow-hop-first emission (hop_schedule): every DCN collective
+        # precedes every fast-hop all_gather in program order
+        ag_idx = [i for i, r in enumerate(grad)
+                  if r["prim"] == "all_gather"
+                  and row_hop(r, comm) == "ici"]
+        dcn_idx = [i for i, r in enumerate(grad)
+                   if row_hop(r, comm) == "dcn"
+                   and r["prim"] != "all_gather"]
+        row["hop_ordered"] = (not ag_idx or not dcn_idx
+                              or max(dcn_idx) < min(ag_idx))
+    return row
 
 
 def build_structure():

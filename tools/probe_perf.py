@@ -637,6 +637,33 @@ def probe_comm():
                 if k not in ("probe", "config")}
         row["within_structure"] = live == committed
         print(json.dumps(row), flush=True)
+    # per-hop table of the hierarchical configs (ISSUE 6): one row per
+    # (config, hop, collective) with the wire bytes and dtype — read
+    # straight off the traced eqns via the SAME row_hop/row_wire_bytes
+    # helpers config_row prices the committed budgets with (one copy;
+    # the two surfaces cannot drift)
+    for name, cfg in comm_census.CONFIGS.items():
+        if cfg.get("comm") != "hierarchical":
+            continue
+        jaxpr, comm = comm_census.trace_step(
+            exchange=cfg["exchange"],
+            batch_collectives=cfg["batch_collectives"],
+            grad_dtype=cfg["grad_dtype"],
+            comm_name=cfg["comm"], inter_size=cfg.get("inter_size"))
+        rows = [r for r in comm_census.collective_census(jaxpr)
+                if r["elems"] >= comm_census.GRAD_ELEMS_FLOOR]
+        groups = {}
+        for r in rows:
+            key = (comm_census.row_hop(r, comm), r["prim"], r["dtype"])
+            g = groups.setdefault(key, {"count": 0, "elems": 0,
+                                        "bytes": 0})
+            g["count"] += 1
+            g["elems"] += r["elems"]
+            g["bytes"] += int(comm_census.row_wire_bytes(r, comm))
+        for (hop, prim, dtype), g in groups.items():
+            print(json.dumps({"probe": "comm_hop_table", "config": name,
+                              "hop": hop, "collective": prim,
+                              "dtype": dtype, **g}), flush=True)
     # live per-bucket table at the default bound (and PROBE_BUCKET_MB
     # override), leaf by leaf.  grad_transform plans buckets over the
     # POST-compression leaves, so the plan depends on the grad dtype:

@@ -90,6 +90,13 @@ run_one "resnet bs64 bucketed exchange 16MB (comm sweep)" \
 # bytes + sharded update compute vs the flat allreduce row
 run_one "resnet bs64 reduce-scatter update (comm A/B)" \
   BENCH_EXCHANGE=reduce_scatter BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+# ISSUE 6: hierarchical two-level exchange, forced 2x4 on-host split
+# (dcn axis carried by ICI here — a structural A/B of the two-level
+# schedule's cost; the real DCN payoff needs the >=2-host leg below).
+# Delta vs the bs64 flagship (flat) row = the schedule's on-host cost.
+run_one "resnet bs64 hierarchical exchange 2x4 split (comm A/B)" \
+  BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 BENCH_DEADLINE_S=600 \
+  BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 # seq-8192 remat rows LAST among the benches, with compile headroom:
@@ -147,6 +154,12 @@ stepf=$STEPDIR/step_commab.log
     --gloo-exchange bucketed
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
     --gloo-exchange reduce_scatter
+  # ISSUE 6: the >=2-host hierarchical A/B — with one device per
+  # process the DCN hop IS the real process boundary (dcn=2 x ici=1);
+  # the delta vs the flat curve is the two-level schedule's exposed
+  # cost across a genuine slow hop
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange hierarchical
 } > "$stepf" 2>&1 || true
 cat "$stepf"
 if grep -q '^{' "$stepf"; then
