@@ -358,7 +358,8 @@ _DEFAULT_FINGERPRINTS = {
                  "image_size": DEFAULT_SIZE, "layout": "NHWC",
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
                  "input_pipeline": False, "donate": True,
-                 "exchange": "flat", "bucket_mb": 0, "inter_size": 0},
+                 "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
+                 "grad_dtype": "bfloat16", "error_feedback": True},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -366,7 +367,8 @@ _DEFAULT_FINGERPRINTS = {
                     "remat": False, "remat_policy": "",
                     "n_steps": DEFAULT_TF_STEPS,
                     "flash_blocks": ":", "donate": True,
-                    "exchange": "flat", "bucket_mb": 0, "inter_size": 0},
+                    "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
+                    "grad_dtype": "bfloat16", "error_feedback": True},
 }
 
 def _env_float(name, default):
@@ -436,6 +438,12 @@ def _config_fingerprint(model=None):
             "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
             "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
             "inter_size": _env_int("BENCH_INTER_SIZE", 0),
+            # the wire-dtype A/B (int8/fp8/lossless DCN) and the
+            # error-feedback ablation compile different exchanges —
+            # measurements, never flagship data
+            "grad_dtype": os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"),
+            "error_feedback":
+                os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
         }
     return {
         "model": "resnet50",
@@ -451,6 +459,9 @@ def _config_fingerprint(model=None):
         "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
         "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
         "inter_size": _env_int("BENCH_INTER_SIZE", 0),
+        "grad_dtype": os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"),
+        "error_feedback":
+            os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
     }
 
 
@@ -785,20 +796,28 @@ def _exchange_config():
 
 def _make_dp_optimizer(inner, model, exchange, bucket_mb):
     """Communicator + multi-node wrapper for the requested gradient
-    exchange (flagship bf16 gradient compression on every flavor).
-    The hierarchical legs honor BENCH_INTER_SIZE (force a dcn × ici
-    split of the local chips — the on-host structural A/B the queue
-    runs as 2×4; default: infer from the controller topology, i.e. a
-    real multi-host run gets one dcn group per host)."""
+    exchange (flagship bf16 gradient compression on every flavor;
+    BENCH_GRAD_DTYPE overrides — ``none`` for lossless, ``int8`` /
+    ``float8_e4m3`` / ``float8_e5m2`` for the quantized-wire A/B, where
+    a scalar quantized dtype compresses the DCN hop only, per the
+    communicator's own rule; BENCH_ERROR_FEEDBACK=0 is the ablation
+    leg).  The hierarchical legs honor BENCH_INTER_SIZE (force a
+    dcn × ici split of the local chips — the on-host structural A/B the
+    queue runs as 2×4; default: infer from the controller topology,
+    i.e. a real multi-host run gets one dcn group per host)."""
     import chainermn_tpu as ct
     comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
     inter_size = _env_int("BENCH_INTER_SIZE", 0) or None
+    grad_dtype = os.environ.get("BENCH_GRAD_DTYPE", "bfloat16")
+    grad_dtype = None if grad_dtype.lower() in ("none", "") else grad_dtype
     comm = ct.create_communicator(comm_name,
-                                  allreduce_grad_dtype="bfloat16",
+                                  allreduce_grad_dtype=grad_dtype,
                                   batch_collectives=bc,
                                   bucket_mb=bucket_mb,
                                   inter_size=inter_size
-                                  if comm_name == "hierarchical" else None)
+                                  if comm_name == "hierarchical" else None,
+                                  error_feedback=os.environ.get(
+                                      "BENCH_ERROR_FEEDBACK", "1") == "1")
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(inner, comm,
                                          exchange=opt_exchange)
@@ -810,14 +829,24 @@ def _exchange_row_fields(model, comm, exchange):
     TOPOLOGY columns (ici/dcn split — 1×N on flat communicators), and
     the per-replica wire-byte accounting (ring decomposition — the
     same formulas tools/comm_budgets.json commits; 0 on a single chip;
-    hierarchical legs additionally split the bill by hop)."""
+    hierarchical legs additionally split the bill by hop).
+
+    Every crossing is priced at its WIRE dtype — the itemsize of the
+    packed buffer that actually crosses (ISSUE 8 satellite: the old
+    gradient-dtype accounting happened to be right for bf16 casts and
+    wrong for everything else).  Quantized wires change the collective
+    SHAPE too (all_gather of codewords / all_to_all of segments), so
+    they route through ``quantized_hop_bytes``, never the psum ring
+    formula."""
     from chainermn_tpu.communicators._memory_utility import (
-        exchanged_bytes, hierarchical_exchanged_bytes)
+        exchanged_bytes, hierarchical_exchanged_bytes, is_quantized_dtype,
+        quantized_hop_bytes)
     arrays = [p.array for p in model.params() if p.array is not None]
     n_params = sum(int(np.prod(a.shape)) for a in arrays)
     param_bytes = sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
                       for a in arrays)
     gdtype = comm.allreduce_grad_dtype
+    q_wire = comm.quantized_wire_dtype
     grad_bytes = (n_params * gdtype.itemsize if gdtype is not None
                   else param_bytes)  # uncompressed grads ride param dtype
     size = comm.size
@@ -826,7 +855,12 @@ def _exchange_row_fields(model, comm, exchange):
               else None,
               "topology": comm.topology,
               "ici_size": comm.ici_size,
-              "dcn_size": comm.dcn_size}
+              "dcn_size": comm.dcn_size,
+              "grad_dtype": str(gdtype) if gdtype is not None else None,
+              "dcn_wire_dtype": str(comm.dcn_grad_dtype)
+              if comm.dcn_grad_dtype is not None else None,
+              "error_feedback": comm.error_feedback
+              if q_wire is not None else None}
     if comm.hierarchy is not None:
         # per-hop split.  The accounting pads ELEMENTS exactly like the
         # wire does (pad_to_multiple on the packed vector: to intra for
@@ -840,12 +874,20 @@ def _exchange_row_fields(model, comm, exchange):
         multiple = intra * inter if coll == "reduce_scatter" else intra
         n_pad = -(-n_params // multiple) * multiple
         wire_itemsize = gdtype.itemsize if gdtype is not None else 4
-        dcn_itemsize = (comm.dcn_grad_dtype.itemsize
-                        if comm.dcn_grad_dtype is not None
-                        else wire_itemsize)
-        hops = hierarchical_exchanged_bytes(
-            n_pad * wire_itemsize, intra, inter, coll,
-            dcn_n_bytes=n_pad // intra * dcn_itemsize)
+        if q_wire is not None:
+            # quantized DCN: the slow hop is a different collective
+            # shape with its own pricing; ICI keeps the lossless ring
+            hops = hierarchical_exchanged_bytes(
+                n_pad * wire_itemsize, intra, inter, coll)
+            hops["dcn"] = quantized_hop_bytes(
+                n_pad // intra, inter, coll, q_wire)
+        else:
+            dcn_itemsize = (comm.dcn_grad_dtype.itemsize
+                            if comm.dcn_grad_dtype is not None
+                            else wire_itemsize)
+            hops = hierarchical_exchanged_bytes(
+                n_pad * wire_itemsize, intra, inter, coll,
+                dcn_n_bytes=n_pad // intra * dcn_itemsize)
         fields["exchanged_grad_bytes"] = hops["ici"] + hops["dcn"]
         fields["exchanged_dcn_bytes"] = hops["dcn"]
         fields["exchanged_ici_bytes"] = hops["ici"]
@@ -860,7 +902,17 @@ def _exchange_row_fields(model, comm, exchange):
             fields["exchanged_dcn_bytes"] += p_hops["dcn"]
             fields["exchanged_ici_bytes"] += p_hops["ici"]
         return fields
-    if exchange == "reduce_scatter":
+    if is_quantized_dtype(gdtype):
+        # flat quantized exchange: all_gather of codewords (allreduce)
+        # or all_to_all of segments (reduce-scatter update), priced at
+        # the 1-byte wire
+        coll = "reduce_scatter" if exchange == "reduce_scatter" else "psum"
+        grad = quantized_hop_bytes(n_params, size, coll, gdtype)
+        fields["exchanged_grad_bytes"] = grad
+        fields["exchanged_bytes"] = grad + (
+            exchanged_bytes(param_bytes, size, "all_gather")
+            if exchange == "reduce_scatter" else 0)
+    elif exchange == "reduce_scatter":
         grad = exchanged_bytes(grad_bytes, size, "reduce_scatter")
         fields["exchanged_bytes"] = grad + exchanged_bytes(
             param_bytes, size, "all_gather")

@@ -110,13 +110,24 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                         axis_name="mn_world", allreduce_grad_dtype=None,
                         batch_collectives=None, bucket_mb=None,
                         fault_schedule=None, intra_size=None,
-                        inter_size=None, **kwargs):
+                        inter_size=None, error_feedback=True, **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
     (reference fp16 path; bf16 recommended on TPU).  On the hierarchical
     flavors a ``{"ici": ..., "dcn": ...}`` dict compresses per hop
-    (lossless ICI + bf16 DCN is the interesting point).  ``devices``:
+    (lossless ICI + bf16 DCN is the interesting point).  ISSUE 8 adds
+    the QUANTIZED wires ``"int8"`` / ``"float8_e4m3"`` /
+    ``"float8_e5m2"``: per-bucket symmetric-scale quantization of the
+    slow hop (the DCN crossing on hierarchical flavors — a scalar
+    quantized dtype compresses DCN only, ICI stays lossless; the whole
+    exchange on flat ones), with ``error_feedback=True`` (default)
+    carrying the quantization residual in a persistent buffer so the
+    error telescopes instead of accumulating (docs/performance.md §9;
+    convergence is parity-gated, not bit-exact).
+    ``CHAINERMN_TPU_COMPRESS=off`` is the factory-level escape hatch:
+    quantized wires fall back to lossless (bf16 casts untouched).
+    ``devices``:
     subset of ``jax.devices()`` (default all).  ``batch_collectives``:
     ``False`` (per-leaf collectives), ``True`` (one flat bucket — the
     per-name default for the fused flavors) or ``"bucketed"`` (K
@@ -158,7 +169,8 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             "jax_ici", devices=devices, axis_name=axis_name,
             allreduce_grad_dtype=allreduce_grad_dtype,
             batch_collectives=batch_collectives, bucket_mb=bucket_mb,
-            intra_size=intra_size, inter_size=inter_size, **kwargs)
+            intra_size=intra_size, inter_size=inter_size,
+            error_feedback=error_feedback, **kwargs)
         # the hc.* transport hook gets its own schedule CLONE (same
         # specs + seed, separate RNG stream/counters): transport call
         # counts are inherently per-rank asymmetric (root puts,
@@ -214,16 +226,52 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                 # the flat alias has one hop; keep whatever compression
                 # the dict asked for on it — the DCN entry wins (the
                 # slow-hop intent), else the ICI entry — never a silent
-                # drop to lossless (wire bytes must not silently grow)
+                # drop to lossless (wire bytes must not silently grow).
+                # The degradation is NOT silent (ISSUE 8 satellite): the
+                # per-hop intent cannot survive a one-hop mesh, so name
+                # what was kept and what was dropped, once per distinct
+                # dict
+                chosen_key = "dcn" if allreduce_grad_dtype.get("dcn") \
+                    is not None else "ici"
+                dropped = sorted(k for k, v in allreduce_grad_dtype.items()
+                                 if k != chosen_key and v is not None)
+                _warn_hierarchy_flat_dict_degraded(
+                    allreduce_grad_dtype, chosen_key, dropped)
                 allreduce_grad_dtype = (allreduce_grad_dtype.get("dcn")
                                         or allreduce_grad_dtype.get("ici"))
             return MeshCommunicator(
                 devices=devices, axis_name=axis_name,
                 allreduce_grad_dtype=allreduce_grad_dtype,
                 batch_collectives=batch_collectives,
-                bucket_mb=bucket_mb, name="jax_ici")
+                bucket_mb=bucket_mb, name="jax_ici",
+                error_feedback=error_feedback)
     return MeshCommunicator(devices=devices, axis_name=axis_name,
                             allreduce_grad_dtype=allreduce_grad_dtype,
                             batch_collectives=batch_collectives,
                             bucket_mb=bucket_mb, name=name,
-                            intra_size=intra_size, inter_size=inter_size)
+                            intra_size=intra_size, inter_size=inter_size,
+                            error_feedback=error_feedback)
+
+
+#: distinct degraded dicts already warned about (one-time per intent —
+#: a training loop constructing communicators repeatedly must not spam)
+_WARNED_FLAT_DICTS = set()
+
+
+def _warn_hierarchy_flat_dict_degraded(dtype_dict, chosen_key, dropped):
+    import warnings
+    key = tuple(sorted((k, str(v)) for k, v in dtype_dict.items()))
+    if key in _WARNED_FLAT_DICTS:
+        return
+    _WARNED_FLAT_DICTS.add(key)
+    kept = dtype_dict.get(chosen_key)
+    detail = (f"dropped per-hop entries {dropped} "
+              if dropped else "per-hop structure dropped ")
+    warnings.warn(
+        f"CHAINERMN_TPU_HIERARCHY=flat degrades per-hop "
+        f"allreduce_grad_dtype={dtype_dict!r} to its {chosen_key!r} "
+        f"entry ({kept!r}) on the ONE flat hop: {detail}— the full "
+        f"gradient now rides the {chosen_key} compression instead of "
+        f"only that hop's chunk.  Unset CHAINERMN_TPU_HIERARCHY to "
+        f"restore the two-level exchange.",
+        UserWarning, stacklevel=3)

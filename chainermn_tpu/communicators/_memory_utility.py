@@ -29,7 +29,10 @@ import jax.numpy as jnp
 __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
            "plan_buckets", "bucket_table", "hop_schedule",
            "exchanged_bytes", "hierarchical_exchanged_bytes",
-           "pad_to_multiple"]
+           "pad_to_multiple", "QUANTIZED_DTYPES", "resolve_grad_dtype",
+           "is_quantized_dtype", "quantize_symmetric",
+           "dequantize_symmetric", "quantization_residual",
+           "quantized_hop_bytes"]
 
 #: default bucket bound (MB) for the bucketed exchange —
 #: ``CHAINERMN_TPU_BUCKET_MB`` overrides (reference: pure_nccl's
@@ -140,6 +143,162 @@ def pad_to_multiple(flat, multiple):
     return jnp.pad(flat, (0, n_pad - n)), n
 
 
+# -- quantized wire dtypes (ISSUE 8) ----------------------------------------
+#: wire dtypes the compressed gradient exchange quantizes to, mapped to
+#: the largest magnitude each can represent (the symmetric-scale
+#: target).  int8 uses the symmetric range ±127 (−128 is never emitted
+#: — a symmetric codebook keeps Q(−v) == −Q(v), so the residual math
+#: telescopes without a sign bias).  The fp8 names follow the ISSUE's
+#: spelling; jax's dtype is the OCP ``e4m3fn`` variant (finite-only,
+#: max 448) and ``e5m2`` (max 57344).
+QUANTIZED_DTYPES = {
+    "int8": 127.0,
+    "float8_e4m3": 448.0,
+    "float8_e5m2": 57344.0,
+}
+
+def resolve_grad_dtype(dtype):
+    """``allreduce_grad_dtype`` entry → jnp dtype, accepting the
+    quantized wire names (``"float8_e4m3"`` resolves to jax's
+    ``float8_e4m3fn``).  ``None`` passes through (lossless)."""
+    if dtype is None:
+        return None
+    name = str(dtype)
+    if name in ("float8_e4m3", "float8_e4m3fn"):
+        return jnp.dtype(jnp.float8_e4m3fn)
+    if name == "float8_e5m2":
+        return jnp.dtype(jnp.float8_e5m2)
+    return jnp.dtype(dtype)
+
+
+def _quant_key(dtype):
+    """Canonical QUANTIZED_DTYPES key of a dtype, or ``None``."""
+    if dtype is None:
+        return None
+    name = str(jnp.dtype(dtype) if not isinstance(dtype, str) else dtype)
+    name = {"float8_e4m3fn": "float8_e4m3"}.get(name, name)
+    return name if name in QUANTIZED_DTYPES else None
+
+
+def is_quantized_dtype(dtype):
+    """True for the int8/fp8 wire dtypes the quantized exchange owns
+    (bf16/fp16 are plain casts — they ride the lossy-cast path, not the
+    scale+residual machinery)."""
+    return _quant_key(dtype) is not None
+
+
+def quantize_symmetric(v, wire_dtype):
+    """Per-bucket symmetric quantization: ``(q, scale)`` with
+    ``q ≈ v / scale`` stored in ``wire_dtype`` and
+    ``scale = absmax(v) / qmax``.
+
+    Contract (pinned by tests/communicator_tests/test_quantization.py):
+
+    * **deterministic** — a pure elementwise function of ``v``; every
+      rank quantizing the same buffer computes the same ``(q, scale)``
+      (the cross-rank agreement the dequantize-sum relies on);
+    * **zero-safe** — an all-zero (or empty) bucket quantizes to zeros
+      with ``scale = 1`` (never a 0/0);
+    * **non-finite-safe** — ``±inf`` saturates to ``±qmax`` (the scale
+      is computed over the FINITE values only, so one overflowed
+      gradient cannot zero out the rest of the bucket); ``NaN`` encodes
+      as 0.  The residual for non-finite inputs is defined as 0 by
+      :func:`quantization_residual` — error feedback must not turn one
+      bad step into a permanently poisoned buffer.
+
+    Round-trip bound: for finite ``v``, ``|v − q·scale| ≤ scale/2``
+    per element for int8 (round-to-nearest on a uniform codebook) and
+    ``≤ absmax · 2^−m`` relative for fp8 with ``m`` mantissa bits.
+    """
+    wire = resolve_grad_dtype(wire_dtype)
+    qmax = QUANTIZED_DTYPES[_quant_key(wire)]
+    v = v.astype(jnp.float32)
+    finite = jnp.isfinite(v)
+    absmax = jnp.max(jnp.abs(jnp.where(finite, v, 0.0))) \
+        if v.size else jnp.float32(0.0)
+    scale = jnp.where(absmax > 0, absmax / qmax, 1.0).astype(jnp.float32)
+    scaled = jnp.clip(jnp.where(jnp.isnan(v), 0.0, v) / scale,
+                      -qmax, qmax)
+    if jnp.issubdtype(wire, jnp.integer):
+        q = jnp.round(scaled).astype(wire)
+    else:
+        q = scaled.astype(wire)
+    return q, scale
+
+
+def dequantize_symmetric(q, scale):
+    """Inverse of :func:`quantize_symmetric`: ``q·scale`` in f32."""
+    return q.astype(jnp.float32) * scale
+
+
+def quantization_residual(v, q, scale):
+    """Error-feedback residual ``v − Q(v)``, sanitized: positions where
+    ``v`` was non-finite carry 0 (their information is unrepresentable —
+    carrying ±inf/NaN forward would poison every later step)."""
+    v = v.astype(jnp.float32)
+    r = v - dequantize_symmetric(q, scale)
+    return jnp.where(jnp.isfinite(v) & jnp.isfinite(r), r, 0.0)
+
+
+def quantize_with_feedback(v, residual, wire_dtype):
+    """The one quantization prologue every compressed hop shares (flat
+    transform, hierarchical DCN branch, sharded-update slow hop):
+    ``v`` is accumulated in f32, the carried ``residual`` (or ``None``
+    when error feedback is off) is added before quantizing, and the new
+    residual ``v − Q(v)`` is returned (``None`` without feedback).
+    Returns ``(q, scale, new_residual)``."""
+    v = v.astype(jnp.float32)
+    if residual is not None:
+        v = v + residual
+    q, scale = quantize_symmetric(v, wire_dtype)
+    new_residual = quantization_residual(v, q, scale) \
+        if residual is not None else None
+    return q, scale, new_residual
+
+
+def dequantize_sum(q_stacked, scales):
+    """Sum of per-rank dequantized buffers: ``q_stacked`` is the
+    gathered ``(size, n)`` codewords, ``scales`` the gathered ``(size,)``
+    per-rank scales — each rank's codewords decode with ITS OWN scale
+    before the f32 accumulation (summing codewords directly would be
+    meaningless across scales)."""
+    return jnp.sum(dequantize_symmetric(q_stacked, scales[:, None]),
+                   axis=0)
+
+
+def quantized_hop_bytes(chunk_elems, size, collective, wire_dtype):
+    """Per-replica wire bytes of the QUANTIZED slow-hop exchange on a
+    ``chunk_elems`` per-rank chunk over ``size`` ranks, priced at the
+    wire dtype's itemsize (the packed buffer that actually crosses —
+    never the gradient dtype's):
+
+    * ``"psum"`` (the hierarchical allreduce's DCN hop): implemented as
+      an ``all_gather`` of the quantized chunk + dequantize-sum —
+      ``chunk_q · (size−1)`` per replica.  vs the f32 chunk allreduce's
+      ``8 · chunk · (size−1)/size`` this is ``itemsize·size/8`` of the
+      lossless crossing: exactly the quantized fraction at ``size=2``
+      (1/4 for int8), break-even at ``size = 8/itemsize`` — the
+      decision table in docs/performance.md §9.
+    * ``"reduce_scatter"`` (the sharded-update DCN hop): an
+      ``all_to_all`` of the quantized chunk's segments —
+      ``chunk_q · (size−1)/size``: exactly the quantized fraction of
+      the f32 reduce-scatter crossing at ANY ``size``.
+
+    The per-bucket scale scalars also cross (one f32 ``all_gather`` per
+    bucket) — O(buckets), excluded here as they are from the census's
+    gradient rows (below ``GRAD_ELEMS_FLOOR``).
+    """
+    if size <= 1:
+        return 0
+    itemsize = resolve_grad_dtype(wire_dtype).itemsize
+    n_bytes = chunk_elems * itemsize
+    if collective == "psum":
+        return int(n_bytes * (size - 1))
+    if collective == "reduce_scatter":
+        return int(n_bytes * (size - 1) / size)
+    raise ValueError(f"unknown quantized collective {collective!r}")
+
+
 def bucket_table(shapes, dtypes, bucket_bytes):
     """Human/probe-facing accounting of a bucket plan: one row per
     bucket with its leaf count, element count, bytes, and dtype."""
@@ -163,6 +322,10 @@ def exchanged_bytes(n_bytes, size, collective):
       (reduce-scatter phase + all-gather phase)
     * ``reduce_scatter``     → ``n · (size-1)/size``
     * ``all_gather``         → ``n · (size-1)/size``
+    * ``all_to_all``         → ``n · (size-1)/size``
+      (each rank keeps its own segment; the quantized reduce-scatter
+      rides this — every segment crosses once, priced at the operand's
+      own wire dtype)
 
     This is why the reduce-scatter update halves per-replica exchanged
     GRADIENT bytes vs allreduce: the gradient crosses the wire once
@@ -174,7 +337,7 @@ def exchanged_bytes(n_bytes, size, collective):
     frac = (size - 1) / size
     if collective == "psum":
         return int(2 * n_bytes * frac)
-    if collective in ("reduce_scatter", "all_gather"):
+    if collective in ("reduce_scatter", "all_gather", "all_to_all"):
         return int(n_bytes * frac)
     raise ValueError(f"unknown collective {collective!r}")
 

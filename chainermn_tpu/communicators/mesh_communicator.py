@@ -63,6 +63,32 @@ def _is_traced(*xs):
                for x in xs for leaf in jax.tree.leaves(x))
 
 
+_warned_inert_ef = False
+
+
+def _warn_inert_error_feedback():
+    """A quantized transform was invoked through the legacy 1-arg form
+    while the communicator asked for error feedback: quantization still
+    happens, but the residual is DISCARDED — the exact EF-off mode the
+    parity ablation shows drifting from the lossless trajectory.  Warn
+    once per process (trace-time, so the hot path never pays): callers
+    that cannot thread the residual should construct the communicator
+    with error_feedback=False to make the ablation explicit."""
+    global _warned_inert_ef
+    if _warned_inert_ef:
+        return
+    _warned_inert_ef = True
+    import warnings
+    warnings.warn(
+        "quantized grad_transform called without a residual while "
+        "error_feedback=True: the quantization error is being discarded "
+        "(error feedback is inert on this call path — e.g. the DCGAN "
+        "updater's direct grad_transform use).  Pass "
+        "error_feedback=False at communicator construction to make the "
+        "ablation explicit, or use the multi-node optimizer, which "
+        "threads the residual.", UserWarning, stacklevel=3)
+
+
 class MeshCommunicator(CommunicatorBase):
     """Communicator over a 1-D device mesh axis.
 
@@ -76,7 +102,7 @@ class MeshCommunicator(CommunicatorBase):
     def __init__(self, devices=None, axis_name="mn_world",
                  allreduce_grad_dtype=None, batch_collectives=False,
                  bucket_mb=None, name="jax_ici", _mesh=None,
-                 intra_size=None, inter_size=None):
+                 intra_size=None, inter_size=None, error_feedback=True):
         self.name = name
         self.hierarchy = None
         self._hier_sizes = None
@@ -110,6 +136,8 @@ class MeshCommunicator(CommunicatorBase):
             axis_name = names
         self.axis_name = axis_name
         self.dcn_grad_dtype = None
+        self.error_feedback = bool(error_feedback)
+        from ._memory_utility import is_quantized_dtype, resolve_grad_dtype
         if isinstance(allreduce_grad_dtype, dict):
             # per-hop compression (ISSUE 6): lossless ICI + compressed
             # DCN is the interesting point — the slow hop's bytes halve
@@ -127,16 +155,38 @@ class MeshCommunicator(CommunicatorBase):
                     f"(hops are 'ici' and 'dcn')")
             ici_dt = allreduce_grad_dtype.get("ici")
             dcn_dt = allreduce_grad_dtype.get("dcn")
-            self.allreduce_grad_dtype = (None if ici_dt is None
-                                         else jnp.dtype(ici_dt))
-            self.dcn_grad_dtype = (None if dcn_dt is None
-                                   else jnp.dtype(dcn_dt))
+            if is_quantized_dtype(ici_dt):
+                # the fast hop is lossless BY DESIGN (ISSUE 8): its
+                # bytes are nearly free and a second quantization point
+                # would need a second residual for no wire win
+                raise ValueError(
+                    f"quantized ici dtype {ici_dt!r}: the ICI hop is "
+                    f"lossless by design — int8/fp8 compression is a "
+                    f"slow-hop (dcn) knob")
+            self.allreduce_grad_dtype = resolve_grad_dtype(ici_dt)
+            self.dcn_grad_dtype = resolve_grad_dtype(dcn_dt)
         else:
-            self.allreduce_grad_dtype = (None if allreduce_grad_dtype is None
-                                         else jnp.dtype(allreduce_grad_dtype))
+            self.allreduce_grad_dtype = resolve_grad_dtype(
+                allreduce_grad_dtype)
             if self.hierarchy is not None:
-                # a scalar dtype compresses BOTH hops (flat-path parity)
                 self.dcn_grad_dtype = self.allreduce_grad_dtype
+                if is_quantized_dtype(self.allreduce_grad_dtype):
+                    # a scalar CAST dtype (bf16) compresses BOTH hops
+                    # (flat-path parity), but a scalar QUANTIZED dtype
+                    # compresses the DCN crossing only (ISSUE 8:
+                    # lossless over ICI, compressed over DCN by
+                    # default — int8 cannot ride a psum_scatter anyway)
+                    self.allreduce_grad_dtype = None
+        if self._compress_disabled():
+            # CHAINERMN_TPU_COMPRESS=off — the factory-level escape
+            # hatch (ISSUE 8): quantized wires fall back to LOSSLESS
+            # (never to a silently different lossy dtype); plain cast
+            # compression (bf16/fp16) is untouched — it predates the
+            # quantized path and has its own knobs
+            if is_quantized_dtype(self.allreduce_grad_dtype):
+                self.allreduce_grad_dtype = None
+            if is_quantized_dtype(self.dcn_grad_dtype):
+                self.dcn_grad_dtype = None
         if batch_collectives not in (False, True, "bucketed"):
             raise ValueError(
                 f"batch_collectives must be False (per-leaf collectives), "
@@ -192,6 +242,12 @@ class MeshCommunicator(CommunicatorBase):
         # communicators are process-global transport handles (mesh, device
         # list, mailboxes) — model deepcopies (create_mnbn_model) share them
         return self
+
+    @staticmethod
+    def _compress_disabled():
+        import os
+        return os.environ.get("CHAINERMN_TPU_COMPRESS", "") \
+            .strip().lower() in ("off", "0", "none")
 
     @staticmethod
     def _resolve_hierarchy(n_devices, intra_size, inter_size):
@@ -660,7 +716,11 @@ class MeshCommunicator(CommunicatorBase):
         fn = self._jit_cache.get(("mean_eager", key))
         if fn is None:
             size = self.size
-            dtype = self.allreduce_grad_dtype
+            from ._memory_utility import is_quantized_dtype
+            # quantization is a WIRE property (scale+codebook, not a
+            # cast): the eager host-mode mean stays lossless
+            dtype = None if is_quantized_dtype(self.allreduce_grad_dtype) \
+                else self.allreduce_grad_dtype
             stacked = {path: (g.ndim == len(shapes[path]) + 1
                               and g.shape[0] == size
                               and tuple(g.shape[1:]) == tuple(shapes[path]))
@@ -699,6 +759,62 @@ class MeshCommunicator(CommunicatorBase):
         composes with either topology)."""
         return "hierarchical" if self.hierarchy is not None else "flat"
 
+    # -- quantized wire (ISSUE 8) ------------------------------------------
+    @property
+    def quantized(self):
+        """True when any hop's wire dtype is a quantized (int8/fp8)
+        codebook — the exchanges that carry a per-bucket symmetric
+        scale and (with :attr:`error_feedback`) a residual buffer."""
+        from ._memory_utility import is_quantized_dtype
+        return (is_quantized_dtype(self.allreduce_grad_dtype)
+                or is_quantized_dtype(self.dcn_grad_dtype))
+
+    @property
+    def quantized_wire_dtype(self):
+        """The quantized wire dtype (the slow hop's on hierarchical
+        communicators, the world wire on flat ones), or ``None``."""
+        from ._memory_utility import is_quantized_dtype
+        if self.hierarchy is not None:
+            return self.dcn_grad_dtype \
+                if is_quantized_dtype(self.dcn_grad_dtype) else None
+        return self.allreduce_grad_dtype \
+            if is_quantized_dtype(self.allreduce_grad_dtype) else None
+
+    def grad_residual_len(self, shapes, dtypes):
+        """LOCAL (per-device) length of the error-feedback residual the
+        quantized ``grad_transform`` threads: per bucket, the quantized
+        hop's per-device payload — the padded ``1/ici`` chunk on
+        hierarchical communicators, the full bucket on flat ones —
+        concatenated in plan order.  0 when the wire is not quantized.
+        The global residual operand is this × ``size``, sharded by
+        :meth:`flat_chunk_spec` (each device owns its slice — the same
+        layout, donation, and resume plumbing as the reduce-scatter
+        stale chunk)."""
+        if self.quantized_wire_dtype is None:
+            return 0
+        total = 0
+        for idx in self.grad_buckets(shapes, dtypes):
+            elems = sum(int(np.prod(shapes[i])) for i in idx)
+            if self.hierarchy is not None:
+                intra = self.ici_size
+                total += -(-elems // intra)
+            else:
+                total += elems
+        return total
+
+    def grad_residual_len_for(self, model):
+        """:meth:`grad_residual_len` over ``model``'s gradient leaves,
+        planned exactly like :meth:`grad_buckets_for` (post
+        cast-compression, pre quantization) — the one length the hot
+        path, the optimizer's zero-seed, and the resume template must
+        agree on."""
+        from ._memory_utility import is_quantized_dtype
+        shapes, dtypes = self.grad_leaf_specs(model)
+        if self.allreduce_grad_dtype is not None \
+                and not is_quantized_dtype(self.allreduce_grad_dtype):
+            dtypes = [self.allreduce_grad_dtype] * len(dtypes)
+        return self.grad_residual_len(shapes, dtypes)
+
     def grad_buckets(self, shapes, dtypes):
         """The bucket plan this communicator's ``grad_transform`` traces
         for leaves of the given shapes/dtypes (post dtype-compression):
@@ -725,9 +841,14 @@ class MeshCommunicator(CommunicatorBase):
 
     def grad_buckets_for(self, model):
         """The bucket plan ``grad_transform`` traces for ``model``'s
-        gradients (leaves in hot-path order, post dtype-compression)."""
+        gradients (leaves in hot-path order, post dtype-compression).
+        A QUANTIZED wire dtype does not recast the leaves — quantization
+        happens at the wire, so buckets are planned (and bounded) in the
+        gradient's own dtype."""
+        from ._memory_utility import is_quantized_dtype
         shapes, dtypes = self.grad_leaf_specs(model)
-        if self.allreduce_grad_dtype is not None:
+        if self.allreduce_grad_dtype is not None \
+                and not is_quantized_dtype(self.allreduce_grad_dtype):
             dtypes = [self.allreduce_grad_dtype] * len(dtypes)
         return self.grad_buckets(shapes, dtypes)
 
@@ -757,9 +878,20 @@ class MeshCommunicator(CommunicatorBase):
         Packing goes through ``_memory_utility.tree_pack``/``tree_unpack``
         — the one pack/unpack implementation (shared with ZeRO and the
         reduce-scatter update).
+
+        QUANTIZED wires (ISSUE 8): with an int8/fp8
+        ``allreduce_grad_dtype`` the returned transform accepts an
+        optional ``residual`` second argument (the error-feedback
+        buffer) and, when given one, returns ``(grads, new_residual)``
+        instead of bare grads — the multi-node optimizer threads it;
+        legacy 1-arg callers get inline quantization with the residual
+        discarded (error feedback off for that call).
         """
         if self.hierarchy is not None:
             return self._hierarchical_grad_transform()
+        from ._memory_utility import is_quantized_dtype
+        if is_quantized_dtype(self.allreduce_grad_dtype):
+            return self._quantized_flat_grad_transform()
         axis = self.axis_name
         dtype = self.allreduce_grad_dtype
         comm = self
@@ -791,6 +923,70 @@ class MeshCommunicator(CommunicatorBase):
 
         return transform
 
+    def _quantized_flat_grad_transform(self):
+        """The quantized one-hop exchange (ISSUE 8; also what the
+        ``CHAINERMN_TPU_HIERARCHY=flat`` escape hatch collapses a
+        quantized-DCN hierarchical communicator onto): per bucket,
+        quantize ``v = grads (+ residual)`` with a per-bucket symmetric
+        scale, ``all_gather`` the quantized payload + the scale scalar
+        over the axis, and dequantize-sum — each rank reconstructs the
+        mean from every rank's ``(q, scale)`` pair, so the wire carries
+        the quantized fraction of the bytes while the accumulation
+        stays f32 (an int8 ``psum`` would overflow at size 2, and ranks
+        quantize with DIFFERENT scales — summing codewords is
+        meaningless; DynamiQ's gather-then-dequantize shape).
+
+        Error feedback: ``transform(grads, residual)`` adds the
+        previous step's residual slice before quantizing and returns
+        ``(grads, new_residual)`` with ``new_residual = v − Q(v)`` per
+        bucket — the quantization error is carried, not lost, so the
+        applied updates telescope to the true gradient sum
+        (tests/communicator_tests/test_quantization.py).
+        """
+        axis = self.axis_name
+        size = self.size
+        wire = self.allreduce_grad_dtype
+        comm = self
+
+        def transform(grads, residual=None):
+            from ._memory_utility import (dequantize_sum,
+                                          quantize_with_feedback,
+                                          tree_pack, tree_unpack)
+            if residual is None and comm.error_feedback:
+                _warn_inert_error_feedback()
+            leaves, treedef = jax.tree.flatten(grads)
+            if not leaves:
+                return grads if residual is None else (grads, residual)
+            orig_dtypes = [g.dtype for g in leaves]
+            buckets = comm.grad_buckets([g.shape for g in leaves],
+                                        [g.dtype for g in leaves])
+            out = [None] * len(leaves)
+            new_res = []
+            offset = 0
+            for idx in buckets:
+                with jax.named_scope("mn_q_bucket_exchange"):
+                    flat, spec = tree_pack([leaves[i] for i in idx])
+                    n = flat.shape[0]
+                    r = None
+                    if residual is not None:
+                        r = residual[offset:offset + n]
+                        offset += n
+                    q, scale, nr = quantize_with_feedback(flat, r, wire)
+                    if nr is not None:
+                        new_res.append(nr)
+                    qg = lax.all_gather(q, axis)
+                    sg = lax.all_gather(scale, axis)
+                    mean = dequantize_sum(qg, sg) / size
+                    for i, g in zip(idx, tree_unpack(mean, spec)):
+                        out[i] = g
+            leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
+            grads = jax.tree.unflatten(treedef, leaves)
+            if residual is None:
+                return grads
+            return grads, jnp.concatenate(new_res)
+
+        return transform
+
     def _hierarchical_grad_transform(self):
         """The two-level exchange (ISSUE 6): per bucket, intra-host
         ``psum_scatter`` over ICI → inter-host allreduce over DCN on the
@@ -815,20 +1011,37 @@ class MeshCommunicator(CommunicatorBase):
         slow hop's bytes halve (the first brick of ROADMAP item 2).
         The mean divide happens once, on the 1/ici chunk (fewer flops,
         same math).
+
+        QUANTIZED DCN (ISSUE 8, the second brick): an int8/fp8
+        ``dcn_grad_dtype`` replaces the chunk ``psum`` with
+        quantize → ``all_gather(q + scale)`` over DCN →
+        dequantize-sum: ranks quantize with their OWN per-bucket scale
+        (computed on the reduce-scattered chunk), so summing codewords
+        is impossible — each rank reconstructs the sum from every
+        group's ``(q, scale)`` instead, and the slow wire carries the
+        quantized fraction of the bytes.  With ``transform(grads,
+        residual)`` the quantization error is fed back (per bucket, per
+        device) and the call returns ``(grads, new_residual)``.
         """
         ici, dcn = self.ici_axis, self.dcn_axis
         intra = self.ici_size
         size = self.size
         dtype = self.allreduce_grad_dtype
         dcn_dtype = self.dcn_grad_dtype
+        from ._memory_utility import is_quantized_dtype
+        q_dcn = is_quantized_dtype(dcn_dtype)
         comm = self
 
-        def transform(grads):
-            from ._memory_utility import (hop_schedule, pad_to_multiple,
+        def transform(grads, residual=None):
+            from ._memory_utility import (dequantize_sum, hop_schedule,
+                                          pad_to_multiple,
+                                          quantize_with_feedback,
                                           tree_pack, tree_unpack)
+            if residual is None and q_dcn and comm.error_feedback:
+                _warn_inert_error_feedback()
             leaves, treedef = jax.tree.flatten(grads)
             if not leaves:
-                return grads
+                return grads if residual is None else (grads, residual)
             orig_dtypes = [g.dtype for g in leaves]
             if dtype is not None:
                 leaves = [g.astype(dtype) for g in leaves]
@@ -837,6 +1050,8 @@ class MeshCommunicator(CommunicatorBase):
             out = [None] * len(leaves)
             specs = {}
             chunks = {}
+            new_res = {}
+            offset = 0
             for op, b in hop_schedule(len(buckets)):
                 idx = buckets[b]
                 if op == "ici_reduce_scatter":
@@ -846,6 +1061,23 @@ class MeshCommunicator(CommunicatorBase):
                         specs[b] = (spec, n_true)
                         chunks[b] = lax.psum_scatter(
                             flat, ici, scatter_dimension=0, tiled=True)
+                elif op == "dcn_exchange" and q_dcn:
+                    with jax.named_scope("mn_hier_quantized_dcn"):
+                        c = chunks[b]
+                        wire = c.dtype
+                        n = c.shape[0]
+                        r = None
+                        if residual is not None:
+                            r = residual[offset:offset + n]
+                            offset += n
+                        q, scale, nr = quantize_with_feedback(
+                            c, r, dcn_dtype)
+                        if nr is not None:
+                            new_res[b] = nr
+                        qg = lax.all_gather(q, dcn)
+                        sg = lax.all_gather(scale, dcn)
+                        chunks[b] = (dequantize_sum(qg, sg)
+                                     / size).astype(wire)
                 elif op == "dcn_exchange":
                     with jax.named_scope("mn_hier_allreduce_dcn"):
                         c = chunks[b]
@@ -861,7 +1093,11 @@ class MeshCommunicator(CommunicatorBase):
                     for i, g in zip(idx, tree_unpack(full[:n_true], spec)):
                         out[i] = g
             leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
-            return jax.tree.unflatten(treedef, leaves)
+            grads = jax.tree.unflatten(treedef, leaves)
+            if residual is None:
+                return grads
+            return grads, jnp.concatenate(
+                [new_res[b] for b in range(len(buckets))])
 
         return transform
 
@@ -978,6 +1214,7 @@ class MeshCommunicator(CommunicatorBase):
                     else self.allreduce_grad_dtype),
                 batch_collectives=self.batch_collectives,
                 bucket_mb=self.bucket_mb,
+                error_feedback=self.error_feedback,
                 # a hierarchical name would re-trigger the two-level
                 # split on the subgroup's arbitrary device subset
                 name="jax_ici" if self.hierarchy is not None

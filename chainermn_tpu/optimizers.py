@@ -151,8 +151,48 @@ class _MultiNodeOptimizer:
         from .core.optimizer import _LRUCache
         super().__setattr__("_mn_step_cache", _LRUCache())
         super().__setattr__("_stale_grads", None)  # double-buffer slot
+        super().__setattr__("_residual", None)  # error-feedback slot
 
     _double_buffering = False
+
+    @property
+    def _needs_residual(self):
+        """True when the compiled step threads the error-feedback
+        residual (ISSUE 8): the communicator quantizes a hop AND error
+        feedback is on.  The residual rides the stale-grad machinery —
+        a persistent flat f32 buffer, donated into the step, sharded by
+        ``flat_chunk_spec`` (each device owns its slice), serialized
+        next to the stale buffer so resume keeps the telescoping sum
+        intact."""
+        comm = self.communicator
+        return bool(getattr(comm, "quantized", False)
+                    and getattr(comm, "error_feedback", False))
+
+    def _residual_global_len(self):
+        """Length of the GLOBAL residual vector: per-device residual ×
+        size.  Sharded-update steps quantize the post-fast-hop chunk
+        (``n_pad / ici`` per device); allreduce steps quantize per
+        bucket (the communicator owns that accounting)."""
+        comm = self.communicator
+        if self._sharded_update:
+            _, _, n_pad = self._zero_layout
+            slow = comm.dcn_size if comm.hierarchy is not None \
+                else comm.size
+            return n_pad * slow
+        return comm.grad_residual_len_for(self.actual_optimizer.target) \
+            * comm.size
+
+    def _residual_operand(self):
+        """The residual tuple operand the compiled step expects — ``()``
+        when error feedback is off, ``(buffer,)`` (zero-seeded on first
+        use: no error has been made yet) when on.  Shared by
+        ``update()``/``update_scan()`` and the census tracer."""
+        if not self._needs_residual:
+            return ()
+        if self._residual is None:
+            super().__setattr__("_residual", jnp.zeros(
+                (self._residual_global_len(),), jnp.float32))
+        return (self._residual,)
 
     @property
     def _sharded_update(self):
@@ -183,6 +223,7 @@ class _MultiNodeOptimizer:
         # the saved flat chunks onto mismatched per-param slots.
         super().__setattr__("_zero_layout", None)
         super().__setattr__("_stale_grads", None)
+        super().__setattr__("_residual", None)
         self._mn_step_cache.clear()
         return self
 
@@ -215,7 +256,8 @@ class _MultiNodeOptimizer:
         else:
             opt_state = actual._ensure_opt_state(params)
         key = actual._cache_key(lossfun, args, kwargs) \
-            + (self._double_buffering, self._sharded_update)
+            + (self._double_buffering, self._sharded_update,
+               self._needs_residual)
         step = self._mn_step_cache.get(key)
         if step is None:
             step = (self._make_zero_step(lossfun, args, kwargs)
@@ -234,12 +276,13 @@ class _MultiNodeOptimizer:
                 zeros = jax.tree.map(jnp.zeros_like, params)
             super().__setattr__("_stale_grads", zeros)
         stale = (self._stale_grads,) if self._double_buffering else ()
+        residual = self._residual_operand()
         operands = (params, pstate, opt_state, actual._hyper_values(),
-                    actual._next_rng_key(), stale, args, kwargs)
+                    actual._next_rng_key(), stale, residual, args, kwargs)
         actual._stash_step_spec(step, operands)
         try:
-            new_params, new_pstate, new_opt_state, loss, grads, obs = \
-                step(*operands)
+            new_params, new_pstate, new_opt_state, loss, grads, \
+                res_out, obs = step(*operands)
         except Exception as e:
             from .core.optimizer import raise_if_donated_state_lost
             raise_if_donated_state_lost(e, actual)
@@ -248,6 +291,10 @@ class _MultiNodeOptimizer:
             # the donated stale buffer is rebound to this step's fresh
             # mean gradient — through the wrapper, never a raw alias
             super().__setattr__("_stale_grads", grads)
+        if self._needs_residual:
+            # same contract for the donated error-feedback buffer: this
+            # step's quantization error becomes next step's correction
+            super().__setattr__("_residual", res_out[0])
         # sharded updates never materialize the full mean gradient, so
         # Parameter.grad stays unpopulated (documented ZeRO contract;
         # under double buffering ``grads`` is the flat fresh CHUNK and
@@ -326,8 +373,22 @@ class _MultiNodeOptimizer:
         chunk layout is fast-hop-major (``comm.flat_chunk_spec()``);
         the chained index below addresses the same layout the gathers
         reassemble.
+
+        QUANTIZED slow hop (ISSUE 8): an int8/fp8 ``dcn_grad_dtype``
+        (or a quantized scalar dtype on a flat communicator — the
+        escape-hatch collapse) replaces the slow hop's ``psum_scatter``
+        with a quantized reduce-scatter: quantize the chunk with ONE
+        per-bucket symmetric scale, ``all_to_all`` the quantized
+        SEGMENTS (each crosses the slow wire exactly once — the wire
+        carries the quantized fraction of the f32 reduce-scatter's
+        bytes at any ring size), ``all_gather`` the scale scalars, and
+        dequantize-sum on the owner.  ``residual`` (error feedback) is
+        added before quantizing and the new residual ``v − Q(v)`` is
+        returned to become next step's correction.
         """
-        from .communicators._memory_utility import tree_pack, tree_unpack
+        from .communicators._memory_utility import (
+            dequantize_sum, is_quantized_dtype, quantize_with_feedback,
+            tree_pack, tree_unpack)
         from .core.optimizer import apply_transform_update
         comm = self.communicator
         tx = self._zero_transform()
@@ -339,15 +400,34 @@ class _MultiNodeOptimizer:
         rs_axes = comm.chunk_axes()
         axis_sizes = [int(comm.mesh.shape[a]) for a in rs_axes]
         slow_axis = rs_axes[-1] if len(rs_axes) > 1 else None
+        # the quantized hop: the slow (last) axis of the chain —
+        # on a flat communicator the single world axis IS the wire the
+        # quantized dtype compresses
+        q_dtype = getattr(comm, "quantized_wire_dtype", None)
+        q_axis = rs_axes[-1] if q_dtype is not None else None
+        if is_quantized_dtype(grad_dtype):
+            grad_dtype = None  # quantize at the wire, never pre-cast
 
-        def zero_update(params, grads, opt_state, hyper, stale_chunk=None):
+        def zero_update(params, grads, opt_state, hyper, stale_chunk=None,
+                        residual=None):
+            new_residual = None
             with jax.named_scope("zero_reduce_scatter_grad"):
                 gflat, _ = tree_pack(grads)
                 gflat = jnp.pad(gflat, (0, n_pad - n))
                 if grad_dtype is not None:
                     gflat = gflat.astype(grad_dtype)
                 gchunk = gflat
-                for a in rs_axes:
+                for a, a_size in zip(rs_axes, axis_sizes):
+                    if a == q_axis:
+                        with jax.named_scope("zero_quantized_rs"):
+                            q, scale, new_residual = quantize_with_feedback(
+                                gchunk, residual, q_dtype)
+                            seg = lax.all_to_all(
+                                q.reshape(a_size, -1), a,
+                                split_axis=0, concat_axis=0)
+                            sg = lax.all_gather(scale, a)
+                            gchunk = dequantize_sum(seg, sg)
+                        continue
                     if a == slow_axis and dcn_dtype is not None:
                         gchunk = gchunk.astype(dcn_dtype)
                     gchunk = lax.psum_scatter(
@@ -370,7 +450,7 @@ class _MultiNodeOptimizer:
                 for a in reversed(rs_axes):
                     new_flat = lax.all_gather(new_flat, a, tiled=True)
                 new_params = tree_unpack(new_flat, spec)
-            return new_params, new_opt_state, gchunk
+            return new_params, new_opt_state, gchunk, new_residual
 
         return zero_update
 
@@ -382,18 +462,20 @@ class _MultiNodeOptimizer:
         axis = comm.axis_name
         size = comm.size
         double_buffering = self._double_buffering
+        needs_residual = self._needs_residual
         zero_update = self._make_zero_update()
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
         def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
-                      args, kwargs):
+                      residual, args, kwargs):
             rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
             with jax.named_scope("zero_forward_backward"):
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_local, args, kwargs)
-            new_params, new_opt_state, fresh_chunk = zero_update(
-                params, grads, opt_state, hyper,
-                stale[0] if double_buffering else None)
+            new_params, new_opt_state, fresh_chunk, new_residual = \
+                zero_update(params, grads, opt_state, hyper,
+                            stale[0] if double_buffering else None,
+                            residual[0] if needs_residual else None)
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
             new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis),
@@ -402,26 +484,34 @@ class _MultiNodeOptimizer:
             # buffering (it becomes the next stale buffer); otherwise
             # None — the full mean gradient never exists on this path
             out_grads = fresh_chunk if double_buffering else None
+            res_out = (new_residual,) if needs_residual else ()
             return new_params, new_pstate, new_opt_state, loss, \
-                out_grads, obs
+                out_grads, res_out, obs
 
         args_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
         opt_specs = self._zero_state_spec(actual._opt_state)
-        # the stale chunk is sharded like the opt state's flat leaves
+        # the stale chunk is sharded like the opt state's flat leaves;
+        # the error-feedback residual shares the layout (per-device
+        # slice of a flat vector)
         stale_spec = comm.flat_chunk_spec() if double_buffering else P()
+        residual_spec = comm.flat_chunk_spec() if needs_residual else P()
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
             in_specs=(P(), P(), opt_specs, P(), P(), stale_spec,
-                      args_specs, kwargs_specs),
-            out_specs=(P(), P(), opt_specs, P(), stale_spec, P()),
+                      residual_spec, args_specs, kwargs_specs),
+            out_specs=(P(), P(), opt_specs, P(), stale_spec,
+                       residual_spec, P()),
             check_vma=False)
         if getattr(actual, "donate_params", True):
             # under double buffering the stale chunk (argnum 5) is
-            # replaced by this step's fresh chunk — donate it too
-            donate = (0, 2, 5) if double_buffering else (0, 2)
+            # replaced by this step's fresh chunk — donate it too; same
+            # for the error-feedback residual (argnum 6)
+            donate = (0, 2)
+            donate += (5,) if double_buffering else ()
+            donate += (6,) if needs_residual else ()
         else:
             donate = (2,)
         return jax.jit(mapped, donate_argnums=donate)
@@ -464,10 +554,11 @@ class _MultiNodeOptimizer:
         axis = comm.axis_name
         size = comm.size
         double_buffering = self._double_buffering
+        needs_residual = self._needs_residual
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
         def rank_step(params, pstate, opt_state, hyper, rng_key, stale,
-                      args, kwargs):
+                      residual, args, kwargs):
             # decorrelate stochastic masks across ranks (each rank holds a
             # different batch shard)
             rng_local = jax.random.fold_in(rng_key, lax.axis_index(axis))
@@ -475,9 +566,16 @@ class _MultiNodeOptimizer:
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_local, args, kwargs)
             # the reference's allreduce_grad: mean over ranks, optional
-            # dtype compression, optional flat bucket — all in-program
+            # dtype compression, optional flat bucket — all in-program;
+            # quantized wires additionally thread the error-feedback
+            # residual through the transform (ISSUE 8)
             with jax.named_scope("mn_allreduce_grad"):
-                grads = grad_transform(grads)
+                if needs_residual:
+                    grads, new_residual = grad_transform(grads, residual[0])
+                    res_out = (new_residual,)
+                else:
+                    grads = grad_transform(grads)
+                    res_out = ()
             apply_grads = stale[0] if double_buffering else grads
             with jax.named_scope("mn_optimizer_update"):
                 new_params, new_opt_state = apply_transform_update(
@@ -487,26 +585,34 @@ class _MultiNodeOptimizer:
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
             new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis), new_pstate)
-            return new_params, new_pstate, new_opt_state, loss, grads, obs
+            return new_params, new_pstate, new_opt_state, loss, grads, \
+                res_out, obs
 
         args_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
+        # the residual is a per-device slice of a flat vector — the
+        # same chunked layout (and resume plumbing) as the
+        # reduce-scatter stale chunk
+        residual_spec = comm.flat_chunk_spec() if needs_residual else P()
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), args_specs,
-                      kwargs_specs),
-            out_specs=(P(), P(), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(), P(), residual_spec,
+                      args_specs, kwargs_specs),
+            out_specs=(P(), P(), P(), P(), P(), residual_spec, P()),
             check_vma=False)
         # donate params + opt_state (and, under double buffering, the
         # params-sized stale-grad buffer at argnum 5: it is replaced by
-        # this step's returned gradient, so XLA may update it in place).
+        # this step's returned gradient, so XLA may update it in place;
+        # same for the error-feedback residual at argnum 6).
         # Safe by default through the Link bridge — see core/optimizer.py
         # ``donate_params``; set it False on the wrapped optimizer to
         # keep pre-update buffers alive.
         if getattr(actual, "donate_params", True):
-            donate = (0, 2, 5) if double_buffering else (0, 2)
+            donate = (0, 2)
+            donate += (5,) if double_buffering else ()
+            donate += (6,) if needs_residual else ()
         else:
             donate = (2,)
         return jax.jit(mapped, donate_argnums=donate)
@@ -572,7 +678,8 @@ class _MultiNodeOptimizer:
             opt_state = self._ensure_zero_opt_state(params)
         else:
             opt_state = actual._ensure_opt_state(params)
-        key = ("scan", n_steps, self._sharded_update) \
+        key = ("scan", n_steps, self._sharded_update,
+               self._needs_residual) \
             + actual._cache_key(lossfun, args, kwargs)
         step = self._mn_step_cache.get(key)
         if step is None:
@@ -580,16 +687,21 @@ class _MultiNodeOptimizer:
                     if self._sharded_update
                     else self._make_scan_step(lossfun, args, kwargs, n_steps))
             self._mn_step_cache[key] = step
+        residual = self._residual_operand()
         operands = (params, pstate, opt_state, actual._hyper_values(),
-                    actual._next_rng_key(), args, kwargs)
+                    actual._next_rng_key(), residual, args, kwargs)
         actual._stash_step_spec(step, operands)
         try:
-            new_params, new_pstate, new_opt_state, losses, grads, obs = \
-                step(*operands)
+            new_params, new_pstate, new_opt_state, losses, grads, \
+                res_out, obs = step(*operands)
         except Exception as e:
             from .core.optimizer import raise_if_donated_state_lost
             raise_if_donated_state_lost(e, actual)
             raise
+        if self._needs_residual:
+            # the residual rides the scan carry: the K-th step's error
+            # comes back to seed dispatch K+1
+            super().__setattr__("_residual", res_out[0])
         actual._write_back(new_params, new_pstate, grads)
         actual._opt_state = new_opt_state
         actual.t += n_steps
@@ -606,19 +718,23 @@ class _MultiNodeOptimizer:
         grad_transform = comm.grad_transform()
         axis = comm.axis_name
         size = comm.size
+        needs_residual = self._needs_residual
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
-        def rank_scan(params, pstate, opt_state, hyper, rng_key, args,
-                      kwargs):
+        def rank_scan(params, pstate, opt_state, hyper, rng_key, residual,
+                      args, kwargs):
             rng_rank = jax.random.fold_in(rng_key, lax.axis_index(axis))
 
             def one_step(carry, xs):
-                params, pstate, opt_state, _, i = carry
+                params, pstate, opt_state, _, res, i = carry
                 s_args, s_kwargs = xs
                 rng_i = jax.random.fold_in(rng_rank, i)
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_i, s_args, s_kwargs)
-                grads = grad_transform(grads)
+                if needs_residual:
+                    grads, res = grad_transform(grads, res)
+                else:
+                    grads = grad_transform(grads)
                 new_params, new_opt_state = apply_transform_update(
                     tx, grads, opt_state, params, hyper["lr"],
                     hyper.get("decoupled_wd", 0.0))
@@ -626,14 +742,19 @@ class _MultiNodeOptimizer:
                 # step's value survives) — stacking them as scan ys would
                 # materialize a (K, model-size) buffer in HBM, defeating
                 # donate_params for exactly the large models K-step fusion
-                # targets.  Only the small per-step scalars stack.
+                # targets.  Only the small per-step scalars stack.  The
+                # error-feedback residual rides the carry for the same
+                # reason — each step corrects the previous one's error.
                 return ((new_params, new_pstate, new_opt_state, grads,
-                         i + 1), (loss, obs))
+                         res, i + 1), (loss, obs))
 
             init_grads = jax.tree.map(jnp.zeros_like, params)
-            (params, pstate, opt_state, last_grads, _), (losses, all_obs) = \
+            init_res = residual[0] if needs_residual else jnp.zeros((0,))
+            (params, pstate, opt_state, last_grads, last_res, _), \
+                (losses, all_obs) = \
                 lax.scan(one_step, (params, pstate, opt_state, init_grads,
-                                    jnp.int32(0)), (args, kwargs))
+                                    init_res, jnp.int32(0)),
+                         (args, kwargs))
             losses = lax.pmean(losses, axis)
             pstate = jax.tree.map(lambda s: lax.pmean(s, axis), pstate)
             # observations: mean over the K fused steps (matches what a
@@ -641,18 +762,24 @@ class _MultiNodeOptimizer:
             # over ranks
             obs = jax.tree.map(
                 lambda o: lax.pmean(jnp.mean(o, axis=0), axis), all_obs)
-            return params, pstate, opt_state, losses, last_grads, obs
+            res_out = (last_res,) if needs_residual else ()
+            return params, pstate, opt_state, losses, last_grads, \
+                res_out, obs
 
         args_specs = jax.tree.map(
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_kwargs)
+        residual_spec = comm.flat_chunk_spec() if needs_residual else P()
         mapped = shard_map(
             rank_scan, mesh=comm.mesh,
-            in_specs=(P(), P(), P(), P(), P(), args_specs, kwargs_specs),
-            out_specs=(P(), P(), P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(), residual_spec, args_specs,
+                      kwargs_specs),
+            out_specs=(P(), P(), P(), P(), P(), residual_spec, P()),
             check_vma=False)
         donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
+        if needs_residual and getattr(actual, "donate_params", True):
+            donate += (5,)
         return jax.jit(mapped, donate_argnums=donate)
 
     def _make_zero_scan_step(self, lossfun, ex_args, ex_kwargs, n_steps):
@@ -666,46 +793,56 @@ class _MultiNodeOptimizer:
         actual = self.actual_optimizer
         axis = comm.axis_name
         size = comm.size
+        needs_residual = self._needs_residual
         zero_update = self._make_zero_update()
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
-        def rank_scan(params, pstate, opt_state, hyper, rng_key, args,
-                      kwargs):
+        def rank_scan(params, pstate, opt_state, hyper, rng_key, residual,
+                      args, kwargs):
             rng_rank = jax.random.fold_in(rng_key, lax.axis_index(axis))
 
             def one_step(carry, xs):
-                params, pstate, opt_state, i = carry
+                params, pstate, opt_state, res, i = carry
                 s_args, s_kwargs = xs
                 rng_i = jax.random.fold_in(rng_rank, i)
                 loss, new_pstate, obs, grads = loss_and_grad(
                     params, pstate, rng_i, s_args, s_kwargs)
-                new_params, new_opt_state, _ = zero_update(
-                    params, grads, opt_state, hyper)
-                return ((new_params, new_pstate, new_opt_state, i + 1),
-                        (loss, obs))
+                new_params, new_opt_state, _, new_res = zero_update(
+                    params, grads, opt_state, hyper, None,
+                    res if needs_residual else None)
+                if not needs_residual:
+                    new_res = res
+                return ((new_params, new_pstate, new_opt_state, new_res,
+                         i + 1), (loss, obs))
 
-            (params, pstate, opt_state, _), (losses, all_obs) = lax.scan(
-                one_step, (params, pstate, opt_state, jnp.int32(0)),
-                (args, kwargs))
+            init_res = residual[0] if needs_residual else jnp.zeros((0,))
+            (params, pstate, opt_state, last_res, _), (losses, all_obs) = \
+                lax.scan(one_step,
+                         (params, pstate, opt_state, init_res,
+                          jnp.int32(0)), (args, kwargs))
             losses = lax.pmean(losses, axis)
             pstate = jax.tree.map(lambda s: lax.pmean(s, axis), pstate)
             obs = jax.tree.map(
                 lambda o: lax.pmean(jnp.mean(o, axis=0), axis), all_obs)
+            res_out = (last_res,) if needs_residual else ()
             # None grads: the full mean gradient never exists under ZeRO
-            return params, pstate, opt_state, losses, None, obs
+            return params, pstate, opt_state, losses, None, res_out, obs
 
         args_specs = jax.tree.map(
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_args)
         kwargs_specs = jax.tree.map(
             lambda leaf: self._scan_batch_spec(leaf, axis, size), ex_kwargs)
         opt_specs = self._zero_state_spec(actual._opt_state)
+        residual_spec = comm.flat_chunk_spec() if needs_residual else P()
         mapped = shard_map(
             rank_scan, mesh=comm.mesh,
-            in_specs=(P(), P(), opt_specs, P(), P(), args_specs,
-                      kwargs_specs),
-            out_specs=(P(), P(), opt_specs, P(), P(), P()),
+            in_specs=(P(), P(), opt_specs, P(), P(), residual_spec,
+                      args_specs, kwargs_specs),
+            out_specs=(P(), P(), opt_specs, P(), P(), residual_spec, P()),
             check_vma=False)
         donate = (0, 2) if getattr(actual, "donate_params", True) else (2,)
+        if needs_residual and getattr(actual, "donate_params", True):
+            donate += (5,)
         return jax.jit(mapped, donate_argnums=donate)
 
     # -- misc reference API -----------------------------------------------------
@@ -722,12 +859,14 @@ class _MultiNodeOptimizer:
         # instead of the double-buffer fresh-start semantics
         super().__setattr__("_zero_layout", None)
         super().__setattr__("_stale_grads", None)
+        super().__setattr__("_residual", None)
         self._mn_step_cache.clear()
 
     def remove_hook(self, name):
         self.actual_optimizer.remove_hook(name)
         super().__setattr__("_zero_layout", None)
         super().__setattr__("_stale_grads", None)
+        super().__setattr__("_residual", None)
         self._mn_step_cache.clear()
 
     def _gather_opt_state_to_host(self, opt_state):
@@ -836,6 +975,17 @@ class _MultiNodeOptimizer:
                 and self._zero_layout is not None:
             actual._opt_state = self._commit_opt_state_to_mesh(
                 actual._opt_state)
+        if self._needs_residual:
+            # the error-feedback residual is OBSERVABLE state (ISSUE 8):
+            # the telescoping sum — applied updates so far + residual ==
+            # true gradient sum — must survive a checkpoint/restore, or
+            # the resumed run silently drops the carried error.  Same
+            # flat-vector plumbing as the stale chunk.  Size-changed
+            # resume re-seeds ZEROS: the residual is per-DEVICE
+            # quantization error with no global content invariant (a new
+            # partition quantizes different chunks), and dropping it
+            # costs exactly one step of correction, never correctness.
+            self._serialize_residual(serializer)
         if self._double_buffering:
             # the one-step-stale gradient buffer is OBSERVABLE state:
             # without it a resumed run applies zeros on its first update
@@ -906,6 +1056,54 @@ class _MultiNodeOptimizer:
             # None restored = snapshot predates stale-grad saving (or was
             # taken before the first update): fresh zero-seed semantics
             super().__setattr__("_stale_grads", restored)
+
+    def _serialize_residual(self, serializer):
+        from .core.optimizer import (deserialize_flat_tree,
+                                     serialize_flat_tree)
+        actual = self.actual_optimizer
+        sub = serializer["ef_residual"]
+        if serializer.is_writer:
+            if self._residual is not None:
+                # sharded on a real multi-controller mesh — same
+                # host-gather the opt_state/stale writes get
+                serialize_flat_tree(
+                    sub, self._gather_opt_state_to_host(self._residual),
+                    "n", "r")
+            return
+        if actual.target is None:
+            return
+        params = extract_state(actual.target)["params"]
+        if not params or any(v is None for v in params.values()):
+            super().__setattr__("_residual", None)
+            return
+        if self._sharded_update and self._zero_layout is None:
+            # no flat layout yet (e.g. pre-feature snapshot without
+            # opt_state): the residual length is underivable — zero-seed
+            # on first update instead
+            super().__setattr__("_residual", None)
+            return
+        length = self._residual_global_len()
+        template = jnp.zeros((length,), jnp.float32)
+        restored = deserialize_flat_tree(sub, template, "n", "r")
+        if restored is None:
+            # pre-feature snapshot: fresh zero-seed on first update
+            super().__setattr__("_residual", None)
+            return
+        if not (isinstance(restored, jax.Array)
+                and not restored.is_fully_addressable):
+            if restored.shape != template.shape:
+                # saved under a DIFFERENT communicator size/plan:
+                # per-device error has no cross-partition meaning —
+                # zero-seed (documented contract, one step of error)
+                super().__setattr__("_residual", None)
+                return
+            host = np.asarray(restored)
+            sharding = jax.sharding.NamedSharding(
+                self.communicator.mesh,
+                self.communicator.flat_chunk_spec())
+            restored = jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+        super().__setattr__("_residual", restored)
 
 
 class _DoubleBufferingOptimizer(_MultiNodeOptimizer):

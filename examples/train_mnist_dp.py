@@ -61,6 +61,14 @@ def main():
                         help="ZeRO-1: shard optimizer state over the DP "
                              "axis (reduce-scatter grads, 1/n-chunk "
                              "update, all-gather params)")
+    parser.add_argument("--grad-dtype", default=None,
+                        help="gradient wire dtype: bfloat16 (cast) or "
+                             "int8/float8_e4m3/float8_e5m2 (quantized; "
+                             "on -c hierarchical compresses the DCN hop "
+                             "only — docs/performance.md §9)")
+    parser.add_argument("--no-error-feedback", action="store_true",
+                        help="ablation: drop the quantization residual "
+                             "instead of carrying it")
     args = parser.parse_args()
 
     if args.simulate_devices:
@@ -70,7 +78,9 @@ def main():
         from chainermn_tpu.utils import use_platform
         use_platform(args.platform)
 
-    comm = ct.create_communicator(args.communicator)
+    comm = ct.create_communicator(
+        args.communicator, allreduce_grad_dtype=args.grad_dtype,
+        error_feedback=not args.no_error_feedback)
     model = Classifier(MLP(args.unit, 10))
     comm.bcast_data(model)
 

@@ -398,6 +398,81 @@ def test_hierarchy_escape_hatch(monkeypatch):
     assert comm.allreduce_grad_dtype == jnp.bfloat16
 
 
+def test_hierarchy_escape_hatch_warns_on_dict_degradation(monkeypatch):
+    """ISSUE 8 satellite: degrading a per-hop dict onto the flat alias's
+    single hop is intent-changing (the FULL gradient now rides the dcn
+    compression) — it must warn ONCE per distinct dict, naming the
+    dropped keys, and still apply the documented dcn-wins rule."""
+    import warnings as _warnings
+    from chainermn_tpu import communicators as comm_mod
+    monkeypatch.setenv("CHAINERMN_TPU_HIERARCHY", "flat")
+    monkeypatch.setattr(comm_mod, "_WARNED_FLAT_DICTS", set())
+    spec = {"ici": "bfloat16", "dcn": "int8"}
+    with pytest.warns(UserWarning, match="degrades per-hop") as rec:
+        comm = create_communicator("hierarchical",
+                                   allreduce_grad_dtype=dict(spec))
+    assert comm.allreduce_grad_dtype == jnp.int8  # dcn entry won
+    assert comm.hierarchy is None
+    msg = str(rec[0].message)
+    assert "ici" in msg and "'dcn'" in msg  # dropped + kept keys named
+    # one-time: the SAME dict intent does not warn again ...
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        create_communicator("hierarchical",
+                            allreduce_grad_dtype=dict(spec))
+    # ... but a DIFFERENT dict does
+    with pytest.warns(UserWarning, match="degrades per-hop"):
+        create_communicator("hierarchical",
+                            allreduce_grad_dtype={"dcn": "bfloat16"})
+
+
+def test_quantized_dtype_knobs():
+    """ISSUE 8 construction surface: quantized wire dtypes resolve per
+    hop (scalar quantized → DCN only on hierarchical communicators),
+    the ici hop refuses quantization, and error_feedback rides the
+    factory."""
+    comm = create_communicator("hierarchical", inter_size=2,
+                               allreduce_grad_dtype={"dcn": "int8"})
+    assert comm.allreduce_grad_dtype is None  # ici lossless
+    assert comm.dcn_grad_dtype == jnp.int8
+    assert comm.quantized and comm.error_feedback
+    assert str(comm.quantized_wire_dtype) == "int8"
+    # scalar quantized on hierarchical: DCN only (unlike bf16, which
+    # compresses both hops — int8 cannot ride a psum_scatter)
+    comm = create_communicator("hierarchical", inter_size=2,
+                               allreduce_grad_dtype="int8")
+    assert comm.allreduce_grad_dtype is None
+    assert comm.dcn_grad_dtype == jnp.int8
+    # fp8 alias spelling resolves to jax's e4m3fn
+    comm = create_communicator("hierarchical", inter_size=2,
+                               allreduce_grad_dtype={"dcn": "float8_e4m3"},
+                               error_feedback=False)
+    assert comm.dcn_grad_dtype == jnp.dtype(jnp.float8_e4m3fn)
+    assert not comm.error_feedback
+    with pytest.raises(ValueError, match="lossless by design"):
+        create_communicator("hierarchical", inter_size=2,
+                            allreduce_grad_dtype={"ici": "int8"})
+    # flat communicator: scalar quantized compresses the one hop
+    comm = create_communicator("jax_ici", allreduce_grad_dtype="int8")
+    assert comm.quantized and str(comm.quantized_wire_dtype) == "int8"
+
+
+def test_compress_env_escape_hatch(monkeypatch):
+    """CHAINERMN_TPU_COMPRESS=off strips QUANTIZED wires back to
+    lossless at construction; plain bf16 cast compression is untouched
+    (it predates the quantized path and has its own knobs)."""
+    monkeypatch.setenv("CHAINERMN_TPU_COMPRESS", "off")
+    comm = create_communicator("hierarchical", inter_size=2,
+                               allreduce_grad_dtype={"ici": "bfloat16",
+                                                     "dcn": "int8"})
+    assert comm.dcn_grad_dtype is None  # int8 stripped
+    assert comm.allreduce_grad_dtype == jnp.bfloat16  # bf16 kept
+    assert not comm.quantized
+    comm = create_communicator("jax_ici", allreduce_grad_dtype="int8")
+    assert comm.allreduce_grad_dtype is None
+    assert not comm.quantized
+
+
 def test_per_hop_dtype_validation():
     comm = create_communicator(
         "hierarchical", inter_size=2,

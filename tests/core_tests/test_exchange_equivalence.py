@@ -248,6 +248,49 @@ def test_reduce_scatter_update_scan_continues_trajectory(golden):
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.parametrize("exchange,db", [("hierarchical", False),
+                                         ("hierarchical_rs", False),
+                                         ("hierarchical_rs", True)])
+def test_quantized_residual_resume_bit_exact(tmp_path, exchange, db):
+    """The error-feedback residual is OBSERVABLE state (ISSUE 8): a
+    same-size serialize → restore → continue is bit-exact — the
+    telescoping sum (applied + residual == true) survives the
+    checkpoint — on the allreduce, sharded-update, and
+    double-buffered×rs quantized paths."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, _, opt = _run(exchange, double_buffering=db,
+                     grad_dtype={"dcn": "int8"}, steps=2)
+    assert opt._residual is not None
+    save_npz(path, opt)
+    cont_ref = [float(opt.update(opt.target, x, t)) for _ in range(2)]
+
+    _, _, fresh = _run(exchange, double_buffering=db,
+                       grad_dtype={"dcn": "int8"}, steps=1)
+    load_npz(path, fresh)
+    assert fresh._residual is not None
+    cont = [float(fresh.update(fresh.target, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_ref, rtol=0, atol=0)
+
+
+def test_quantized_residual_pre_feature_snapshot_zero_seeds(tmp_path):
+    """A snapshot saved WITHOUT error feedback (no ef_residual section)
+    loads onto an EF run with fresh zero-seed semantics — no crash, no
+    stale residual invented."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+    _, _, plain = _run("hierarchical", steps=2)  # lossless: no residual
+    save_npz(path, plain)
+    _, _, ef = _run("hierarchical", grad_dtype={"dcn": "int8"}, steps=2)
+    assert ef._residual is not None
+    load_npz(path, ef)
+    assert ef._residual is None  # zero-seeds on the next update
+    assert np.isfinite(float(ef.update(ef.target, x, t)))
+
+
 def test_double_buffered_reduce_scatter_resume_bit_exact(tmp_path):
     """Serialize → restore → continue must be bit-exact for the
     reduce-scatter double-buffering pair: the stale CHUNK is observable

@@ -213,6 +213,86 @@ def test_hierarchical_rs_shards_both_hops(live):
         live["reduce_scatter"]["exchanged_param_bytes_per_replica"]
 
 
+def test_quantized_dcn_crossing_at_wire_dtype(live):
+    """ISSUE 8 acceptance, machine-checked from the trace: the quantized
+    configs' DCN gradient crossing rides the QUANTIZED wire dtype (the
+    packed buffer's itemsize, never the gradient dtype), via
+    quantize → all_gather (allreduce exchange) / all_to_all (sharded
+    update) → dequantize-sum — no full-precision gradient psum ever
+    touches DCN — while ICI stays lossless byte-for-byte."""
+    f32 = live["hierarchical"]["per_hop"]
+    for name, wire in (("hierarchical_int8", "int8"),
+                       ("hierarchical_fp8", "float8_e4m3fn")):
+        row = live[name]
+        assert row["quantized_wire"] == wire, name
+        assert row["per_hop"]["dcn"]["collectives"] == {"all_gather": 1}
+        assert row["per_hop"]["dcn"]["wire_dtypes"] == [wire], name
+        # ICI hop untouched: same collectives, same lossless bytes
+        assert row["per_hop"]["ici"] == f32["ici"], name
+    rs = live["hierarchical_rs_int8"]
+    assert rs["per_hop"]["dcn"]["collectives"] == \
+        {"all_to_all": 1, "all_gather": 1}
+    # the all_to_all gradient segments are int8; the f32 entry is the
+    # params-rebuild all_gather, accounted as param bytes
+    assert rs["per_hop"]["dcn"]["wire_dtypes"] == ["float32", "int8"]
+    assert rs["per_hop"]["ici"] == live["hierarchical_rs"]["per_hop"]["ici"]
+
+
+def test_quantized_dcn_payload_pinned_at_quantized_fraction(budgets, live):
+    """The acceptance bar: the DCN gradient-payload BYTE ratio of every
+    quantized config is the quantized fraction of the lossless one —
+    int8/fp8 are 1-byte wires, so exactly 1/4 of the f32 crossing
+    (and 1/(4·ici) of the full gradient)."""
+    lossless = live["hierarchical"]["dcn_payload_bytes_ratio"]
+    for name in ("hierarchical_int8", "hierarchical_fp8",
+                 "hierarchical_rs_int8"):
+        row = live[name]
+        # element payload unchanged (still the 1/ici chunk) ...
+        assert row["dcn_grad_payload_ratio"] == \
+            pytest.approx(1.0 / row["intra_size"], abs=0), name
+        # ... byte payload at the quantized fraction: 1/4 of f32
+        assert row["dcn_payload_bytes_ratio"] == \
+            pytest.approx(lossless / 4, abs=0), name
+        assert row["dcn_payload_bytes_ratio"] <= lossless / 4, name
+        assert budgets["structure"][name]["dcn_payload_bytes_ratio"] == \
+            row["dcn_payload_bytes_ratio"], name
+
+
+def test_quantized_keeps_slow_hop_first_order(live):
+    """The quantized DCN ops (all_gather of codewords / all_to_all of
+    segments) keep hop_schedule's promise: every DCN collective is
+    emitted before ANY fast-hop all_gather."""
+    for name in ("hierarchical_int8", "hierarchical_fp8",
+                 "hierarchical_rs_int8"):
+        assert live[name]["hop_ordered"], name
+
+
+def test_quantized_wire_halves_dcn_bytes_vs_bf16(live):
+    """The headline relation at the committed 2-host split: int8 DCN
+    grad bytes are half the bf16 crossing and a quarter of the f32 one
+    (all_gather of 1-byte codewords at inter=2 == psum of 1-byte
+    payload would-be bytes)."""
+    f32 = live["hierarchical"]["per_hop"]["dcn"]["exchanged_grad_bytes"]
+    bf16 = live["hierarchical_dcn_bf16"]["per_hop"]["dcn"][
+        "exchanged_grad_bytes"]
+    int8 = live["hierarchical_int8"]["per_hop"]["dcn"][
+        "exchanged_grad_bytes"]
+    assert bf16 * 2 == f32
+    assert int8 * 4 == f32
+    assert int8 * 2 == bf16
+
+
+def test_unknown_collective_prim_is_hard_census_error():
+    """A collective the pricing does not understand must raise, never
+    silently skip or misprice (the satellite's contract)."""
+    import chainermn_tpu as ct
+    comm = ct.create_communicator("jax_ici")
+    with pytest.raises(ValueError, match="cannot price"):
+        comm_census.row_wire_bytes(
+            {"prim": "ppermute", "elems": 1024, "dtype": "float32",
+             "axes": ["mn_world"]}, comm)
+
+
 def test_measured_sweep_meets_tolerance_when_present(budgets):
     sweep = budgets["sweep"]
     if sweep["status"] != "measured":

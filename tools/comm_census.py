@@ -27,6 +27,19 @@ where ``tests/test_comm_budget.py`` holds every future PR to it
                       (``hop_schedule``), and per-hop dtype
                       (``hierarchical_dcn_bf16`` halves only the DCN
                       crossing)
+* ``hierarchical_int8`` / ``hierarchical_fp8`` / ``hierarchical_rs_int8``
+                    — the QUANTIZED slow hop (ISSUE 8): the DCN psum is
+                      replaced by quantize → ``all_gather`` (allreduce
+                      exchange) or ``all_to_all`` (sharded update) of
+                      the int8/fp8 payload + dequantize-sum, with the
+                      per-bucket scale scalars riding tiny all_gathers
+                      (below the gradient floor).  Every row is priced
+                      at its OWN operand dtype — the WIRE dtype of the
+                      packed buffer, so the committed
+                      ``dcn_payload_bytes_ratio`` pins the quantized
+                      fraction from the trace (int8 crossings ≤ 1/4 of
+                      f32), and unknown collective primitives are a
+                      hard census error, never a silent skip.
 
 The census runs on the CPU mesh (tests/conftest.py's simulated 8
 devices) over a small-but-real transformer vertical whose gradients
@@ -105,6 +118,21 @@ CONFIGS = {
                             exchange="reduce_scatter",
                             comm="hierarchical",
                             inter_size=HIER_INTER_SIZE),
+    "hierarchical_int8": dict(batch_collectives=True,
+                              grad_dtype={"dcn": "int8"},
+                              exchange="allreduce",
+                              comm="hierarchical",
+                              inter_size=HIER_INTER_SIZE),
+    "hierarchical_fp8": dict(batch_collectives=True,
+                             grad_dtype={"dcn": "float8_e4m3"},
+                             exchange="allreduce",
+                             comm="hierarchical",
+                             inter_size=HIER_INTER_SIZE),
+    "hierarchical_rs_int8": dict(batch_collectives=True,
+                                 grad_dtype={"dcn": "int8"},
+                                 exchange="reduce_scatter",
+                                 comm="hierarchical",
+                                 inter_size=HIER_INTER_SIZE),
 }
 
 
@@ -189,10 +217,15 @@ def row_ring(row, comm):
 
 def row_wire_bytes(row, comm):
     """Per-replica wire bytes of one census row under the ring
-    decomposition, in the row's own operand dtype (``all_gather``
-    operands are the per-rank chunk; the accounting is over the full
-    gathered buffer) — the ONE pricing rule config_row and the
-    PROBE=comm per-hop table share."""
+    decomposition, in the row's own operand dtype — the WIRE dtype of
+    the packed buffer (``all_gather`` operands are the per-rank chunk;
+    the accounting is over the full gathered buffer) — the ONE pricing
+    rule config_row and the PROBE=comm per-hop table share.
+
+    A primitive this pricing does not understand is a HARD error (ISSUE
+    8 satellite): a silently mispriced or skipped collective would make
+    the committed byte budgets lie exactly when a new exchange shape
+    lands."""
     import jax.numpy as jnp
     from chainermn_tpu.communicators._memory_utility import exchanged_bytes
     ring = row_ring(row, comm)
@@ -201,7 +234,13 @@ def row_wire_bytes(row, comm):
         return exchanged_bytes(n_bytes * ring, ring, "all_gather")
     if row["prim"] == "psum":
         return exchanged_bytes(n_bytes, ring, "psum")
-    return exchanged_bytes(n_bytes, ring, "reduce_scatter")
+    if row["prim"] in ("reduce_scatter", "all_to_all"):
+        return exchanged_bytes(n_bytes, ring, row["prim"])
+    raise ValueError(
+        f"census cannot price collective {row['prim']!r} "
+        f"(elems={row['elems']}, axes={row['axes']}): teach "
+        f"row_wire_bytes/_memory_utility.exchanged_bytes its ring "
+        f"decomposition before committing a config that emits it")
 
 
 class _Vertical:
@@ -267,7 +306,8 @@ def trace_step(exchange="allreduce", batch_collectives=True,
         opt_state = inner._ensure_opt_state(params)
         step = opt._make_step(vert.model, args, kwargs)
     operands = (params, pstate, opt_state, inner._hyper_values(),
-                inner._next_rng_key(), (), args, kwargs)
+                inner._next_rng_key(), (), opt._residual_operand(),
+                args, kwargs)
     return jax.make_jaxpr(step)(*operands), comm
 
 
@@ -311,15 +351,18 @@ def config_row(name):
         is_param = rs_exchange and r["prim"] == "all_gather"
         hop = per_hop.setdefault(row_hop(r, comm), {
             "collectives": {}, "exchanged_grad_bytes": 0,
-            "exchanged_param_bytes": 0})
+            "exchanged_param_bytes": 0, "wire_dtypes": []})
         hop["collectives"][r["prim"]] = \
             hop["collectives"].get(r["prim"], 0) + 1
+        if r["dtype"] not in hop["wire_dtypes"]:
+            hop["wire_dtypes"] = sorted(hop["wire_dtypes"] + [r["dtype"]])
         if is_param:
             hop["exchanged_param_bytes"] += int(wire)
             param_bytes += wire
         else:
             hop["exchanged_grad_bytes"] += int(wire)
             grad_bytes += wire
+    q_wire = comm.quantized_wire_dtype
     row = {
         "exchange": cfg["exchange"],
         "batch_collectives": cfg["batch_collectives"],
@@ -328,6 +371,9 @@ def config_row(name):
         "topology": comm.topology,
         "intra_size": comm.ici_size,
         "inter_size": comm.dcn_size,
+        "quantized_wire": None if q_wire is None else str(q_wire),
+        "error_feedback": comm.error_feedback if q_wire is not None
+        else None,
         "grad_collectives": counts,
         "grad_collective_elems": elems,
         "per_hop": per_hop,
@@ -336,22 +382,36 @@ def config_row(name):
         "exchanged_param_bytes_per_replica": int(param_bytes),
     }
     if hier is not None:
+        import jax.numpy as jnp
         # the tentpole's byte contract: the largest gradient buffer that
         # crosses DCN is exactly 1/ici of the full gradient (per bucket:
-        # the reduce-scattered chunk) — pin the ratio from the TRACE
+        # the reduce-scattered chunk) — pin the ratio from the TRACE.
+        # Payload rows are every DCN gradient crossing, whatever the
+        # primitive (the quantized exchange crosses as all_gather /
+        # all_to_all); the sharded update's params rebuild is excluded
+        # (accounted as param bytes)
         vert = _Vertical.get()
         dcn_grad_rows = [r for r in grad if row_hop(r, comm) == "dcn"
-                         and (r["prim"] in ("psum", "reduce_scatter"))]
+                         and not (rs_exchange
+                                  and r["prim"] == "all_gather")]
         dcn_payload = sum(r["elems"] for r in dcn_grad_rows)
         row["dcn_grad_payload_ratio"] = dcn_payload / vert.n_params
+        # the ISSUE 8 acceptance ratio: DCN payload in WIRE bytes
+        # (itemsize of the packed buffer) over the f32 gradient bytes —
+        # the quantized fraction falls out of the trace, not metadata
+        dcn_payload_bytes = sum(
+            r["elems"] * jnp.dtype(r["dtype"]).itemsize
+            for r in dcn_grad_rows)
+        row["dcn_payload_bytes_ratio"] = \
+            dcn_payload_bytes / (vert.n_params * 4)
         # slow-hop-first emission (hop_schedule): every DCN collective
+        # (psum, quantized all_gather/all_to_all, the rs params rebuild)
         # precedes every fast-hop all_gather in program order
         ag_idx = [i for i, r in enumerate(grad)
                   if r["prim"] == "all_gather"
                   and row_hop(r, comm) == "ici"]
         dcn_idx = [i for i, r in enumerate(grad)
-                   if row_hop(r, comm) == "dcn"
-                   and r["prim"] != "all_gather"]
+                   if row_hop(r, comm) == "dcn"]
         row["hop_ordered"] = (not ag_idx or not dcn_idx
                               or max(dcn_idx) < min(ag_idx))
     return row

@@ -661,9 +661,16 @@ def probe_comm():
             g["elems"] += r["elems"]
             g["bytes"] += int(comm_census.row_wire_bytes(r, comm))
         for (hop, prim, dtype), g in groups.items():
+            # wire_dtype: the dtype actually on the wire (== the
+            # operand dtype the census priced); compression_ratio: its
+            # itemsize over f32 — 0.25 for the int8/fp8 crossings, 0.5
+            # for bf16, 1.0 lossless (ISSUE 8 satellite column)
             print(json.dumps({"probe": "comm_hop_table", "config": name,
                               "hop": hop, "collective": prim,
-                              "dtype": dtype, **g}), flush=True)
+                              "dtype": dtype, "wire_dtype": dtype,
+                              "compression_ratio":
+                                  jnp.dtype(dtype).itemsize / 4.0,
+                              **g}), flush=True)
     # live per-bucket table at the default bound (and PROBE_BUCKET_MB
     # override), leaf by leaf.  grad_transform plans buckets over the
     # POST-compression leaves, so the plan depends on the grad dtype:

@@ -97,6 +97,26 @@ run_one "resnet bs64 reduce-scatter update (comm A/B)" \
 run_one "resnet bs64 hierarchical exchange 2x4 split (comm A/B)" \
   BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 BENCH_DEADLINE_S=600 \
   BENCH_TRIALS=3
+# ISSUE 8: the DCN wire-dtype A/B on the 2x4 split — int8 vs bf16 vs
+# lossless DCN crossing (BENCH_GRAD_DTYPE scalar: quantized dtypes
+# compress the DCN hop only, per the communicator's own rule; all
+# three fingerprint-excluded from the flagship cache), plus the
+# error-feedback-off ablation of the int8 leg.  Deltas vs the
+# hierarchical bf16 row = the quantized wire's step-time payoff; the
+# ablation row must NOT be faster (EF is one add + one subtract — if
+# it shows up in step_ms, the residual buffer is being re-laid-out).
+run_one "resnet bs64 hierarchical 2x4 lossless DCN (wire-dtype A/B)" \
+  BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 BENCH_GRAD_DTYPE=none \
+  BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+run_one "resnet bs64 hierarchical 2x4 int8 DCN (wire-dtype A/B)" \
+  BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 BENCH_GRAD_DTYPE=int8 \
+  BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+run_one "resnet bs64 hierarchical 2x4 int8 DCN no-EF (ablation)" \
+  BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 BENCH_GRAD_DTYPE=int8 \
+  BENCH_ERROR_FEEDBACK=0 BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+run_one "resnet bs64 hierarchical_rs 2x4 int8 DCN (wire-dtype A/B)" \
+  BENCH_EXCHANGE=hierarchical_rs BENCH_INTER_SIZE=2 \
+  BENCH_GRAD_DTYPE=int8 BENCH_DEADLINE_S=600 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 # seq-8192 remat rows LAST among the benches, with compile headroom:
