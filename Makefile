@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm sweep-flash audit dryrun examples clean
+.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -88,6 +88,14 @@ sweep-flash:      ## on-chip flash fwd/bwd/fwd+bwd tile sweep; regenerates tools
 
 probe-flash:      ## committed flash budgets joined with live fused-vs-split rows (cpu = smoke)
 	PROBE=flash PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
+
+probe-serving:    ## committed serving budgets + live decode/prefill census + per-phase table (no chip)
+	@# decode: one gather per pool per layer through the block table,
+	@# no [T, T] score dot; prefill: flash forward kernels, zero bwd
+	@# kernels — joined with tools/serving_budgets.json (the tier-1
+	@# gate tests/test_serving_budget.py's data) and the decode
+	@# roofline byte table.
+	PROBE=serving PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
 probe-comm:       ## committed gradient-exchange budgets + live per-bucket/per-hop tables (no chip)
 	@# jaxpr collective census per exchange config (per_leaf / flat /

@@ -56,7 +56,7 @@ compression — the TPU translation of the reference's flagship
 Env knobs (defaults = the flagship config; any deviation makes the run
 a variant that is excluded from the last-good cache):
 
-  measurement   BENCH_MODEL (resnet50|transformer|longcontext),
+  measurement   BENCH_MODEL (resnet50|transformer|longcontext|serving),
                 BENCH_BS, BENCH_SIZE, BENCH_LAYOUT (NHWC|NCHW),
                 BENCH_SCAN, BENCH_REMAT, BENCH_INPUT_PIPELINE — resnet;
                 BENCH_SEQ, BENCH_D_MODEL, BENCH_LAYERS, BENCH_VOCAB,
@@ -67,6 +67,14 @@ a variant that is excluded from the last-good cache):
                 longcontext (T=16k/32k flash fwd+bwd rows + the
                 "XLA fails to compile, flash runs" contrast; never
                 cached as flagship data);
+                BENCH_SERVE_QPS (default 16), BENCH_SERVE_TENANTS (4),
+                BENCH_SERVE_REQUESTS (64), BENCH_SERVE_MAX_NEW (32),
+                BENCH_SERVE_PROMPT (64), BENCH_SERVE_MAX_BATCH (8),
+                BENCH_SERVE_PAGE (16), BENCH_SERVE_PAGES (256) —
+                serving (continuous-batching engine under a seeded
+                open-loop Poisson load: tokens/sec + p50/p99 per-token
+                latency + page-pool occupancy; CPU runs clamp to a
+                labeled cpu_smoke row; never cached as flagship data);
                 BENCH_STEPS (steps/trial), BENCH_TRIALS,
                 BENCH_PEAK_TFLOPS (MFU denominator override)
                 BENCH_DONATE=0 (A/B leg: disable params/opt-state
@@ -1314,6 +1322,183 @@ def _run_bench_longcontext():
     return result
 
 
+def _run_bench_serving():
+    """BENCH_MODEL=serving: the continuous-batching engine under a
+    seeded synthetic OPEN-LOOP load (ISSUE 9).  Arrivals are a Poisson
+    process at BENCH_SERVE_QPS spread over BENCH_SERVE_TENANTS tenants
+    — generated up front from a fixed seed, independent of the service
+    rate (open loop: a slow engine builds queue, it does not slow the
+    offered load).  Reports tokens/sec (generated tokens over the
+    measured window), p50/p99 PER-TOKEN latency (first token: arrival →
+    production, includes queueing + prefill; later tokens: gap since
+    the previous token of the same request, includes preemption
+    stalls), and page-pool occupancy (mean/max over decode steps).
+
+    Two phases on ONE engine: a warmup pass first drives every prefill/
+    decode bucket the load will touch (all jit compiles land here,
+    under the compile heartbeat so the supervisor's clock pauses), then
+    the engine is drained and the measured load runs against warm
+    programs — the trace counters are asserted flat across the
+    measured phase.
+
+    CPU fallback (smoke only): the model and load CLAMP to a
+    seconds-scale configuration and the row is labeled
+    ``cpu_smoke: true`` — mechanics validation, never a serving
+    number.  Serving rows are excluded from the last-good cache by
+    construction (the metric is not flagship-cacheable, same
+    discipline as the longcontext rows)."""
+    import jax
+    _enable_compile_cache(jax)
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import Request, ServingEngine
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    cpu_smoke = jax.default_backend() == "cpu"
+
+    qps = _env_float("BENCH_SERVE_QPS", 16.0)
+    tenants = _env_int("BENCH_SERVE_TENANTS", 4)
+    n_requests = _env_int("BENCH_SERVE_REQUESTS", 64)
+    max_new = _env_int("BENCH_SERVE_MAX_NEW", 32)
+    prompt_max = _env_int("BENCH_SERVE_PROMPT", 64)
+    max_batch = _env_int("BENCH_SERVE_MAX_BATCH", 8)
+    page_size = _env_int("BENCH_SERVE_PAGE", 16)
+    num_pages = _env_int("BENCH_SERVE_PAGES", 256)
+    d_model = _env_int("BENCH_D_MODEL", 256)
+    n_layers = _env_int("BENCH_LAYERS", 4)
+    n_vocab = _env_int("BENCH_VOCAB", 8192)
+    n_heads = _env_int("BENCH_HEADS", 0) or max(1, d_model // 64)
+    if cpu_smoke:
+        # clamp: the CPU interpret smoke must finish in seconds — it is
+        # labeled, and could never stale-out first contact on size
+        n_requests = min(n_requests, 12)
+        max_new = min(max_new, 8)
+        prompt_max = min(prompt_max, 24)
+        d_model = min(d_model, 64)
+        n_layers = min(n_layers, 2)
+        n_vocab = min(n_vocab, 512)
+        n_heads = max(1, d_model // 32)
+        num_pages = min(num_pages, 64)
+    max_context = 1
+    while max_context < prompt_max + max_new:
+        max_context *= 2
+
+    model = TransformerLM(n_vocab=n_vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          max_len=max_context, seed=0,
+                          compute_dtype=jnp.bfloat16)
+    engine = ServingEngine(model, num_pages=num_pages,
+                           page_size=page_size, max_batch=max_batch,
+                           max_context=max_context,
+                           max_queue=n_requests + max_batch)
+
+    rng = np.random.RandomState(0)
+
+    def synth_requests(n, t0):
+        reqs, t = [], t0
+        for _ in range(n):
+            t += rng.exponential(1.0 / qps)
+            reqs.append(Request(
+                rng.randint(0, n_vocab, rng.randint(4, prompt_max + 1))
+                .astype(np.int32),
+                max_new_tokens=max_new,
+                tenant=f"tenant{rng.randint(tenants)}",
+                arrival_time=t))
+        return reqs
+
+    # -- warmup: compile every bucketed program BEFORE the window (the
+    # engine's never-retrace contract needs all buckets pre-traced; the
+    # compile heartbeat keeps the supervisor's clock paused meanwhile)
+    _check_compile_budget()
+    _stamp_compile("compile", _COMPILE_CREDIT[0])
+    t0 = time.perf_counter()
+    engine.warmup()
+    compile_s = time.perf_counter() - t0
+    _COMPILE_CREDIT[0] += compile_s
+    _stamp_compile("done", _COMPILE_CREDIT[0])
+    traces_before = (engine.prefill_traces, engine.decode_traces)
+
+    # -- measured open-loop window
+    for req in synth_requests(n_requests, 0.0):
+        engine.submit(req)
+    occ, steps = [], 0
+    base = time.monotonic()
+    while engine.running or engine.scheduler.pending():
+        if _remaining() < 20:
+            break  # cooperative: report the partial window honestly
+        st = engine.step(now=time.monotonic() - base)
+        if st["decoded"] == 0 and st["admitted"] == 0:
+            # open-loop idle tick: nothing arrived yet — wait for the
+            # load, don't spin (idle ticks are not decode steps and
+            # must not dilute the occupancy series)
+            time.sleep(0.002)
+            continue
+        occ.append(st["occupancy"])
+        steps += 1
+    elapsed = time.monotonic() - base
+
+    lat = []
+    for req in engine.completed:
+        if not req.token_times:
+            continue
+        lat.append(req.token_times[0] - req.arrival_time)
+        lat.extend(np.diff(req.token_times))
+    lat = np.asarray(lat) if lat else np.asarray([0.0])
+    # token_times, not tokens: an evicted request's generated tokens
+    # fold into its prompt (recompute on re-admit) but each kept its
+    # one production timestamp — len(tokens) would deflate tokens/sec
+    # exactly on the saturation rows where eviction happens
+    n_tokens = sum(len(r.token_times) for r in engine.completed)
+
+    result = {
+        "metric": "serving_engine_throughput",
+        "value": round(n_tokens / elapsed, 1) if elapsed > 0 else None,
+        "unit": "tokens/sec",
+        "vs_baseline": None,   # greenfield: the reference had no serving
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", platform),
+        "n_devices": len(devices),
+        "p50_token_latency_ms": round(float(np.percentile(lat, 50)) * 1e3,
+                                      2),
+        "p99_token_latency_ms": round(float(np.percentile(lat, 99)) * 1e3,
+                                      2),
+        "page_occupancy_mean": round(float(np.mean(occ)), 3) if occ
+        else 0.0,
+        "page_occupancy_max": round(float(np.max(occ)), 3) if occ
+        else 0.0,
+        "qps": qps, "tenants": tenants, "requests": n_requests,
+        "completed": len(engine.completed),
+        "generated_tokens": int(n_tokens),
+        "evictions": engine.evictions,
+        "decode_steps": steps,
+        "max_batch": max_batch, "page_size": page_size,
+        "num_pages": num_pages, "max_context": max_context,
+        "d_model": d_model, "n_layers": n_layers, "n_vocab": n_vocab,
+        "attn_mode": engine.mode,
+        "page_dtype": str(engine.kv.dtype),
+        "compile_s": round(compile_s, 1),
+        # the never-retrace contract, measured: bucket programs compiled
+        # in warmup, zero traces during the window
+        "window_retraces": (engine.prefill_traces - traces_before[0]
+                            + engine.decode_traces - traces_before[1]),
+    }
+    if cpu_smoke:
+        # labeled loudly: mechanics smoke, not a serving measurement
+        result["cpu_smoke"] = True
+    elif result["value"] is not None:
+        # a real on-chip serving run warms this model family's sentinel
+        # (the metric is not in _METRIC_TO_MODEL — serving rows are
+        # never flagship-cacheable — so _emit won't stamp it)
+        try:
+            with open(_prewarm_sentinel("serving"), "w") as f:
+                f.write(f"{os.environ['BENCH_RUN_ID']} {time.time()}\n")
+        except Exception:
+            pass
+    return result
+
+
 def _run_bench():
     import jax
     _enable_compile_cache(jax)
@@ -1560,6 +1745,8 @@ def _err_metric():
         return ("transformer_lm_train_throughput", "tokens/sec/chip")
     if model == "longcontext":
         return ("longcontext_flash_feasibility", "tokens_context")
+    if model == "serving":
+        return ("serving_engine_throughput", "tokens/sec")
     return ("resnet50_imagenet_train_throughput", "images/sec/chip")
 
 
@@ -1677,6 +1864,8 @@ def _child_main():
             result = _run_bench_transformer()
         elif bench_model == "longcontext":
             result = _run_bench_longcontext()
+        elif bench_model == "serving":
+            result = _run_bench_serving()
         else:
             result = _run_bench()
         _emit(result)  # final (possibly improved over the early emit)

@@ -31,6 +31,7 @@ from . import links
 from . import models
 from . import parallel
 from . import ops
+from . import serving
 from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
 from . import extensions

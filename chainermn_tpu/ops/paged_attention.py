@@ -1,0 +1,150 @@
+"""Paged single-query decode attention — the serving hot loop's kernel.
+
+The decode step of the serving engine (``chainermn_tpu.serving``) is the
+byte-bound roofline of PR 3 all over again: per generated token it must
+read every cached K/V byte of every running sequence exactly once, and
+nothing else matters.  The cache lives in a PAGED pool — fixed-size
+blocks in one preallocated array (`serving.kv_cache`), with each
+sequence owning a list of pages (its *block table*) — so the attention
+step gathers K/V **through the block table** instead of assuming a
+contiguous per-sequence buffer:
+
+    k_pages = k_pool[block_table]        # ONE gather per pool
+    scores  = q · k_pages (per page block, online softmax)
+
+Two lowerings, selected by ``CHAINERMN_TPU_PAGED_ATTN``:
+
+* ``paged`` (default): one gather per pool, then a **page-blockwise
+  online softmax** (the flash-attention recurrence over the page axis:
+  running max / normalizer, score width bounded at ``page_size``) — the
+  numerics and memory shape a future Pallas paged kernel drops into.
+* ``dense``: the escape hatch and parity reference — the same single
+  gather, flattened to a dense ``[B, T, H, D]`` view, one full-width
+  masked softmax.  Greedy decode trajectories are identical (pinned by
+  ``tests/serving_tests/test_decode_parity.py``); per-logit deltas are
+  fp32 rounding only.
+
+Neither lowering ever forms a ``[Tq, Tk]`` score matrix — the query is
+one token per sequence, so scores are ``[B, H, T]`` rows.  The serving
+budget census (`tools/serving_census.py`) pins both facts tier-1: one
+gather per pool per layer, zero full-T score dots.
+
+Dtype discipline (PR 3): pages are stored bf16 by default and enter the
+dots in their storage dtype (the MXU's native bf16 path); accumulators
+and the softmax state are fp32 via ``preferred_element_type``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["paged_decode_attention", "paged_attn_mode"]
+
+
+def paged_attn_mode(mode=None):
+    """Resolve the decode-attention lowering: explicit argument wins,
+    else the ``CHAINERMN_TPU_PAGED_ATTN`` env knob (``paged`` default,
+    ``dense`` = the reference escape hatch).  Read at call time so tests
+    can flip it, but jit caches are NOT keyed on the env — the serving
+    engine resolves the mode ONCE at construction and threads it
+    explicitly, so a mid-process env flip cannot desync a cached decode
+    program from a fresh prefill trace."""
+    if mode is None:
+        mode = os.environ.get("CHAINERMN_TPU_PAGED_ATTN", "paged")
+    if mode not in ("paged", "dense"):
+        raise ValueError(
+            f"CHAINERMN_TPU_PAGED_ATTN={mode!r} invalid (paged|dense)")
+    return mode
+
+
+def _masked_softmax_stats(s, valid):
+    """NaN-free masked softmax pieces shared by both lowerings: masked
+    scores -> (p, l) with all-masked rows yielding p == 0 (an idle batch
+    lane must produce zeros, not NaN)."""
+    s = jnp.where(valid, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    return p, l
+
+
+def _dense_decode(q, k, v, ctx_len, scale):
+    """Dense reference: q [B, H, D] over contiguous k/v [B, T, H, D]
+    with positions >= ctx_len masked.  One full-width softmax."""
+    s = jnp.einsum("bhd,bthd->bht", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    T = k.shape[1]
+    kpos = lax.broadcasted_iota(jnp.int32, (1, 1, T), 2)
+    p, l = _masked_softmax_stats(s, kpos < ctx_len[:, None, None])
+    p = p / jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bht,bthd->bhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_table, ctx_len,
+                           scale=None, mode=None):
+    """One decode step of attention for a batch of cached sequences.
+
+    q: ``[B, H, D]`` — ONE query token per sequence (the just-appended
+    position).  ``k_pool``/``v_pool``: ``[P, S, H, D]`` page pools
+    (``P`` pages of ``S`` token slots).  ``block_table``: ``[B, N]``
+    int32 page ids — sequence ``b``'s token ``t`` lives in page
+    ``block_table[b, t // S]`` at slot ``t % S``; entries past the live
+    prefix may hold any valid page id (their positions are masked by
+    ``ctx_len``).  ``ctx_len``: ``[B]`` int32 valid context lengths
+    (``0`` = idle lane, output is zeros).  Returns ``[B, H, D]`` in
+    ``q.dtype``.
+    """
+    B, H, D = q.shape
+    P, S = k_pool.shape[0], k_pool.shape[1]
+    N = block_table.shape[1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    mode = paged_attn_mode(mode)
+
+    # the gather: every cached byte of the batch's context, exactly once,
+    # addressed through the block table (pages, not contiguous buffers)
+    k_pages = k_pool[block_table]          # [B, N, S, H, D]
+    v_pages = v_pool[block_table]
+
+    if mode == "dense":
+        k = k_pages.reshape(B, N * S, H, D)
+        v = v_pages.reshape(B, N * S, H, D)
+        return _dense_decode(q, k, v, ctx_len, scale)
+
+    # page-blockwise online softmax: scan the page axis with the flash
+    # recurrence — score width bounded at S, fp32 running (m, l, acc)
+    ks = jnp.moveaxis(k_pages, 1, 0)       # [N, B, S, H, D]
+    vs = jnp.moveaxis(v_pages, 1, 0)
+    ctx = ctx_len[:, None, None]
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, i = blk
+        s = jnp.einsum("bhd,bshd->bhs", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = (i * S + lax.broadcasted_iota(jnp.int32, (1, 1, S), 2))
+        s = jnp.where(kpos < ctx, s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhs,bshd->bhd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((B, H, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, D), jnp.float32)
+    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0),
+                              (ks, vs, jnp.arange(N)))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)

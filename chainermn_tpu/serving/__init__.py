@@ -1,0 +1,38 @@
+"""Serving subsystem: continuous-batching inference over a paged KV cache.
+
+The first inference-side subsystem of the rebuild (ROADMAP item 4 —
+"millions of users" needs a serving path, not just training throughput).
+Pieces, each its own module:
+
+* :mod:`.page_allocator` — pure host-side block allocator (page ids,
+  per-sequence block tables, typed OOM);
+* :mod:`.kv_cache` — the preallocated ``[L, P, S, H, D]`` device pools
+  (bf16 pages by default) + in-graph scatter writers;
+* :mod:`ops.paged_attention <chainermn_tpu.ops.paged_attention>` — the
+  decode hot loop's gather-through-the-block-table attention step
+  (``CHAINERMN_TPU_PAGED_ATTN=dense`` escape hatch);
+* :mod:`.scheduler` — open-loop admission, per-tenant round-robin
+  fairness, preemption-by-eviction, typed backpressure;
+* :mod:`.engine` — the prefill/decode split wired together as two
+  bucketed jit programs over the shared pools.
+
+Measurement: ``BENCH_MODEL=serving python bench.py`` (tokens/sec,
+p50/p99 per-token latency, page-pool occupancy under a seeded open-loop
+load); structure committed in ``tools/serving_budgets.json`` and gated
+tier-1 by ``tests/test_serving_budget.py``; ``make probe-serving`` joins
+the two.  Design notes: ``docs/serving.md``.
+"""
+
+from .engine import ServingEngine, decode_program, prefill_program
+from .errors import (PagePoolExhaustedError, QueueSaturatedError,
+                     ServingError)
+from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
+from .page_allocator import BlockAllocator
+from .scheduler import Request, RequestScheduler
+
+__all__ = [
+    "ServingEngine", "prefill_program", "decode_program",
+    "PagedKVCache", "write_prompt_kv", "write_token_kv",
+    "BlockAllocator", "Request", "RequestScheduler",
+    "ServingError", "PagePoolExhaustedError", "QueueSaturatedError",
+]

@@ -1,0 +1,396 @@
+"""Continuous-batching serving engine: prefill/decode split over paged KV.
+
+The reference's marquee trick — keep the device busy by overlapping the
+slow path behind the hot loop — applied to inference.  Two compiled
+programs share one paged KV cache:
+
+* **prefill** (one request at a time): the prompt runs through the
+  normal flash-attention forward (``ops.attention`` — the PR 4 kernels
+  on TPU, backward never traced), each layer's K/V scattering into the
+  request's pages, and the last valid position's logits produce the
+  first generated token.  Prompt lengths are PADDED to a bucket
+  (powers of two), so ragged prompts reuse a small fixed set of
+  compiled programs.
+* **decode** (the whole running batch, one token per sequence): a
+  single-query step per layer — write the token's K/V into its page,
+  then :func:`~chainermn_tpu.ops.paged_attention.paged_decode_attention`
+  gathers the batch's context through the block tables.  The batch
+  dimension is padded to a bucket too, so sequences joining and leaving
+  the running batch NEVER retrace — the engine counts traces
+  (``prefill_traces``/``decode_traces``) and the tests pin it.
+
+Host work per step is scheduling metadata only (block tables, positions,
+sampled tokens — a few int32s per sequence); KV bytes never leave the
+device, and on real accelerators the pools are DONATED through both
+programs so XLA updates pages in place (PR 3's donation discipline; on
+the CPU test backend donation is skipped — it is a no-op there and only
+generates warnings).
+
+Scheduling (``serving.scheduler``): open-loop admission at decode-step
+granularity with per-tenant round-robin fairness; when the page pool
+runs dry the youngest running sequence is evicted (pages freed, request
+re-queued front-of-line with its generated tokens folded into the
+prompt — recompute on re-admit) and the step proceeds.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.link import bind_state, extract_state
+from ..nn import functions as F
+from ..ops import attention as flash_attention_op
+from ..ops.paged_attention import paged_attn_mode, paged_decode_attention
+from .errors import PagePoolExhaustedError
+from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
+from .page_allocator import BlockAllocator
+from .scheduler import RequestScheduler
+
+__all__ = ["ServingEngine", "prefill_program", "decode_program"]
+
+
+def _embed_tokens(model, toks, positions):
+    """Token + position embeddings cast to the model's compute dtype
+    (the TransformerLM.hidden discipline: params fp32, block compute in
+    ``compute_dtype``)."""
+    h = model.embed(toks) + model.pos_embed(positions)
+    if model.compute_dtype is not None:
+        h = h.astype(model.compute_dtype)
+    return h
+
+
+def prefill_program(model, state, k_pool, v_pool, tokens, true_len,
+                    bt_row):
+    """Pure prefill: full causal forward over the (padded) prompt.
+
+    ``tokens``: ``[1, Tb]`` int32 (positions ``>= true_len`` are
+    padding — their K/V writes drop, and causality keeps them out of
+    every valid position's attention).  Returns ``(k_pool, v_pool,
+    logits)`` with ``logits`` the fp32 ``[V]`` row at position
+    ``true_len - 1``.
+    """
+    with bind_state(model, state):
+        B, T = tokens.shape
+        pos = jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
+        h = _embed_tokens(model, tokens, pos)
+        for li, block in enumerate(model.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x.reshape(B * T, -1)).reshape(
+                B, T, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = [jnp.moveaxis(qkv[:, :, j], 1, 2) for j in range(3)]
+            # the flash dispatcher: Pallas forward on TPU (no backward is
+            # ever traced — inference), XLA/interpret elsewhere
+            att = flash_attention_op(q, k, v, causal=True)
+            att = jnp.moveaxis(att, 2, 1).reshape(B * T, -1)
+            h = h + block.attn.proj(att).reshape(B, T, -1)
+            m = block.fc2(F.gelu(block.fc1(block.ln2(h).reshape(B * T,
+                                                                -1))))
+            h = h + m.reshape(B, T, -1)
+            k_pool = k_pool.at[li].set(write_prompt_kv(
+                k_pool[li], jnp.moveaxis(k[0], 0, 1), bt_row, true_len))
+            v_pool = v_pool.at[li].set(write_prompt_kv(
+                v_pool[li], jnp.moveaxis(v[0], 0, 1), bt_row, true_len))
+        h_last = jax.lax.dynamic_slice_in_dim(
+            h[0], jnp.maximum(true_len - 1, 0), 1, axis=0)
+        logits = model.head(model.ln_f(h_last))[0]
+        return k_pool, v_pool, logits.astype(jnp.float32)
+
+
+def decode_program(model, state, k_pool, v_pool, toks, pos, bts, *,
+                   mode):
+    """Pure decode step: one token per batch lane.
+
+    ``toks``/``pos``: ``[Bb]`` int32 (``pos < 0`` marks an idle padding
+    lane: its K/V write drops and its attention context is empty).
+    ``bts``: ``[Bb, N]`` block tables.  Writes each lane's K/V at
+    ``pos`` then attends over ``[0, pos]`` through the block table.
+    Returns ``(k_pool, v_pool, logits [Bb, V] fp32, next_tok [Bb])``.
+    """
+    with bind_state(model, state):
+        Bb = toks.shape[0]
+        safe_pos = jnp.maximum(pos, 0)
+        h = _embed_tokens(model, toks, safe_pos)
+        ctx_len = jnp.where(pos >= 0, pos + 1, 0)
+        scale = 1.0 / (model.blocks[0].attn.d_head ** 0.5)
+        for li, block in enumerate(model.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x).reshape(
+                Bb, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            k_pool = k_pool.at[li].set(
+                write_token_kv(k_pool[li], k, bts, pos))
+            v_pool = v_pool.at[li].set(
+                write_token_kv(v_pool[li], v, bts, pos))
+            att = paged_decode_attention(q, k_pool[li], v_pool[li], bts,
+                                         ctx_len, scale=scale, mode=mode)
+            h = h + block.attn.proj(att.reshape(Bb, -1))
+            h = h + block.fc2(F.gelu(block.fc1(block.ln2(h))))
+        logits = model.head(model.ln_f(h)).astype(jnp.float32)
+        return k_pool, v_pool, logits, jnp.argmax(logits, axis=-1) \
+            .astype(jnp.int32)
+
+
+def _bucket(n, buckets, what):
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{what} {n} exceeds the largest bucket "
+                     f"{buckets[-1]}")
+
+
+def _pow2_buckets(lo, hi):
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(out)
+
+
+class ServingEngine:
+    """Continuous-batching engine over a ``TransformerLM``-shaped model
+    (anything exposing ``embed``/``pos_embed``/``blocks``/``ln_f``/
+    ``head`` with the block layout of ``models.transformer``).
+
+    Greedy sampling (the serving bench's configuration); the paged/dense
+    attention lowering is resolved ONCE at construction
+    (``CHAINERMN_TPU_PAGED_ATTN``).
+    """
+
+    def __init__(self, model, num_pages=256, page_size=16, max_batch=8,
+                 max_context=256, page_dtype=None, max_queue=256,
+                 scheduler=None, mode=None, eos_id=None):
+        blk = model.blocks[0].attn
+        n_layers = len(list(model.blocks))
+        max_len = model.pos_embed.W.shape[0]
+        if max_context > max_len:
+            raise ValueError(f"max_context={max_context} exceeds the "
+                             f"model's max_len={max_len}")
+        if page_dtype is None:
+            page_dtype = model.compute_dtype or jnp.float32
+        self.model = model
+        self.state = extract_state(model)
+        self.kv = PagedKVCache(n_layers, num_pages, page_size,
+                               blk.n_heads, blk.d_head, dtype=page_dtype)
+        self.allocator = BlockAllocator(num_pages, page_size)
+        self.scheduler = scheduler or RequestScheduler(max_queue=max_queue)
+        self.max_batch = int(max_batch)
+        self.max_context = int(max_context)
+        self.n_block_entries = -(-self.max_context // page_size)
+        self.mode = paged_attn_mode(mode)
+        self.eos_id = eos_id
+        self.prefill_buckets = _pow2_buckets(min(16, self.max_context),
+                                             self.max_context)
+        self.batch_buckets = _pow2_buckets(1, self.max_batch)
+        self.running = []       # admission order, oldest first
+        self.completed = []
+        self.prefill_traces = 0
+        self.decode_traces = 0
+        self.evictions = 0
+        self.decode_steps = 0
+
+        # donate the pools on real accelerators only: XLA then updates
+        # pages in place; on cpu donation is ignored and merely warns
+        donate = (1, 2) if jax.default_backend() in ("tpu", "axon") \
+            else ()
+
+        def _prefill(state, k_pool, v_pool, tokens, true_len, bt_row):
+            self.prefill_traces += 1   # trace-time side effect only
+            return prefill_program(self.model, state, k_pool, v_pool,
+                                   tokens, true_len, bt_row)
+
+        def _decode(state, k_pool, v_pool, toks, pos, bts):
+            self.decode_traces += 1    # trace-time side effect only
+            return decode_program(self.model, state, k_pool, v_pool,
+                                  toks, pos, bts, mode=self.mode)
+
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+
+    # -- ingress -------------------------------------------------------------
+
+    def submit(self, request):
+        """Queue a request (typed backpressure: QueueSaturatedError).
+        Requests that could never fit are rejected here, typed, instead
+        of livelocking admission later — the bound is the request's
+        FULL eventual context (prompt + max_new_tokens): a request that
+        merely *starts* inside the pool would grow until exhaustion,
+        evict itself, fold its tokens into the prompt, and re-admit
+        into the same wall forever (eviction can only free OTHER
+        sequences' pages).  Conservative for eos-terminated requests by
+        design: admission cannot know where eos lands."""
+        total = request.prompt.size + request.max_new_tokens
+        if total > self.max_context:
+            raise ValueError(
+                f"request needs {total} positions, engine "
+                f"max_context={self.max_context}")
+        if self.allocator.pages_for(total) > self.allocator.num_pages:
+            raise PagePoolExhaustedError(
+                self.allocator.pages_for(total),
+                self.allocator.num_pages, self.allocator.num_pages)
+        self.scheduler.submit(request)
+
+    # -- internals -----------------------------------------------------------
+
+    def _bt_row(self, seq_id):
+        row = np.zeros(self.n_block_entries, dtype=np.int32)
+        table = self.allocator.block_table(seq_id)
+        row[:len(table)] = table
+        return row
+
+    def _record_token(self, req, tok, now):
+        req.tokens.append(int(tok))
+        req.token_times.append(now)
+        if req.first_token_time is None:
+            req.first_token_time = now
+
+    def _finished(self, req):
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        return self.eos_id is not None and req.tokens \
+            and req.tokens[-1] == self.eos_id
+
+    def _retire(self, req, now):
+        self.allocator.free(req.request_id)
+        self.running.remove(req)
+        req.finish_time = now
+        self.completed.append(req)
+
+    def _evict(self, req):
+        """Preemption: free pages, fold generated tokens into the
+        prompt, re-queue front-of-line (recompute on re-admit)."""
+        self.allocator.free(req.request_id)
+        self.running.remove(req)
+        self.scheduler.requeue_front(req)
+        self.evictions += 1
+
+    def _admit(self, req, clock):
+        """Pages + prefill + first token.  Raises PagePoolExhaustedError
+        (allocator untouched) when the pool cannot hold the prompt."""
+        L = int(req.prompt.size)
+        self.allocator.ensure(req.request_id, L + 1)  # +1: first decode
+        Tb = _bucket(L, self.prefill_buckets, "prompt length")
+        tokens = np.zeros((1, Tb), dtype=np.int32)
+        tokens[0, :L] = req.prompt
+        k_pool, v_pool, logits = self._prefill_fn(
+            self.state, self.kv.k_pool, self.kv.v_pool,
+            jnp.asarray(tokens), np.int32(L),
+            jnp.asarray(self._bt_row(req.request_id)))
+        self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        tok = int(np.asarray(jnp.argmax(logits)))
+        req._ctx = L            # positions whose KV is written
+        t = clock()
+        self._record_token(req, tok, t)
+        self.running.append(req)
+        if self._finished(req):
+            self._retire(req, t)
+
+    def warmup(self):
+        """Compile EVERY bucketed program up front: one dummy prefill
+        per prompt bucket (``true_len=0`` — every page write drops) and
+        one dummy decode per batch bucket (all lanes idle).  Pool
+        contents are unchanged; afterwards joins/leaves never retrace
+        (the serving bench asserts ``window_retraces == 0``)."""
+        for Tb in self.prefill_buckets:
+            k_pool, v_pool, _ = self._prefill_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                jnp.zeros(self.n_block_entries, jnp.int32))
+            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        for Bb in self.batch_buckets:
+            k_pool, v_pool, _, nxt = self._decode_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.zeros(Bb, jnp.int32),
+                jnp.full(Bb, -1, jnp.int32),
+                jnp.zeros((Bb, self.n_block_entries), jnp.int32))
+            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        np.asarray(nxt)  # sync: compiles really happened
+
+    # -- the step loop -------------------------------------------------------
+
+    def step(self, now=None):
+        """One continuous-batching step: an admission pass (fair
+        rotation, open-loop eligibility by ``now``) then ONE decode step
+        over the running batch.  Returns step stats.
+
+        ``now=None`` (the bench's real-time mode) timestamps each token
+        at its actual production instant (after the device fetch); a
+        pinned ``now`` (deterministic tests / simulated clocks) stamps
+        everything in this step with that value."""
+        clock = time.monotonic if now is None else (lambda: now)
+        stats = {"admitted": 0, "evicted_before": self.evictions}
+        # capacity FIRST: secure this step's token page for every
+        # running sequence (evicting youngest-first when the pool runs
+        # dry) BEFORE admitting anyone — admission into pages the
+        # running batch is about to need would get the just-prefilled
+        # newcomer evicted in the same step, burning its whole prefill
+        i = 0
+        while i < len(self.running):
+            req = self.running[i]
+            try:
+                self.allocator.ensure(req.request_id, req._ctx + 1)
+                i += 1
+            except PagePoolExhaustedError:
+                victim = self.scheduler.pick_victim(self.running)
+                self._evict(victim)
+                # victim == req: the slot under scrutiny vanished —
+                # re-check the same index (now the next request)
+        # admission at decode-step granularity, into the pages left
+        # over (its growth page is secured by _admit's ensure(L + 1))
+        while len(self.running) < self.max_batch:
+            req = self.scheduler.next_admission(arrived_by=clock())
+            if req is None:
+                break
+            try:
+                self._admit(req, clock)
+                stats["admitted"] += 1
+            except PagePoolExhaustedError:
+                # pool full: wait (admission never preempts running
+                # work — only decode growth does)
+                self.scheduler.requeue_front(req, preempted=False)
+                break
+        n = len(self.running)
+        stats["evicted"] = self.evictions - stats.pop("evicted_before")
+        stats["running"] = n
+        stats["occupancy"] = (self.allocator.used_pages
+                              / self.allocator.num_pages)
+        if n == 0:
+            stats["decoded"] = 0
+            return stats
+        Bb = _bucket(n, self.batch_buckets, "batch")
+        toks = np.zeros(Bb, dtype=np.int32)
+        pos = np.full(Bb, -1, dtype=np.int32)
+        bts = np.zeros((Bb, self.n_block_entries), dtype=np.int32)
+        for j, req in enumerate(self.running):
+            toks[j] = req.tokens[-1]
+            pos[j] = req._ctx
+            bts[j] = self._bt_row(req.request_id)
+        k_pool, v_pool, _logits, nxt = self._decode_fn(
+            self.state, self.kv.k_pool, self.kv.v_pool,
+            jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(bts))
+        self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        nxt = np.asarray(nxt)   # device->host sync: the step really ran
+        self.decode_steps += 1
+        t_tok = clock()
+        for j, req in enumerate(list(self.running)):
+            req._ctx += 1
+            self._record_token(req, nxt[j], t_tok)
+            if self._finished(req):
+                self._retire(req, t_tok)
+        stats["decoded"] = n
+        return stats
+
+    def drain(self, max_steps=10000, now=None):
+        """Run steps until queues and the running batch are empty (test
+        and bench convenience).  Returns the number of steps taken."""
+        steps = 0
+        while (self.running or self.scheduler.pending()) \
+                and steps < max_steps:
+            self.step(now=now)
+            steps += 1
+        return steps

@@ -1,0 +1,86 @@
+"""Paged KV cache: preallocated device pools + in-graph page writes.
+
+The cache is two arrays per engine — ``k_pool``/``v_pool`` shaped
+``[L, P, S, H, D]`` (layers × pages × page slots × heads × head dim) —
+allocated ONCE at engine construction and only ever updated functionally
+inside the compiled prefill/decode programs (donated on real
+accelerators, so XLA writes pages in place).  Pages are bf16 by default:
+the decode step is HBM-bandwidth-bound on cache reads (PR 3's byte
+roofline applied to serving), so halving the stored byte per element is
+the single biggest lever — the dtype is pinned at construction and every
+write casts through it.
+
+Token ``t`` of a sequence lives at ``(page=block_table[t // S],
+slot=t % S)``.  Both writers below map positions to ``(page, slot)``
+pairs in-graph and scatter with ``mode="drop"``: a lane that must not
+write (idle decode slot, prompt padding) is routed to the
+out-of-range page id ``P`` and dropped by XLA — no host-side masking,
+no host-side copies, one scatter per pool per layer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache", "write_prompt_kv", "write_token_kv"]
+
+
+def write_prompt_kv(pool_l, kv, block_table_row, true_len):
+    """Write a whole prompt's K or V into one layer's pool.
+
+    ``pool_l``: ``[P, S, H, D]``.  ``kv``: ``[T, H, D]`` (position-major,
+    possibly padded past ``true_len``).  ``block_table_row``: ``[N]``
+    page ids covering at least ``true_len`` positions.  Positions
+    ``>= true_len`` scatter to the out-of-range page and are dropped.
+    """
+    P, S = pool_l.shape[0], pool_l.shape[1]
+    T = kv.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    pages = jnp.where(t < true_len, block_table_row[t // S], P)
+    return pool_l.at[pages, t % S].set(kv.astype(pool_l.dtype),
+                                       mode="drop")
+
+
+def write_token_kv(pool_l, kv, block_tables, pos):
+    """Write one decode token per batch lane into one layer's pool.
+
+    ``kv``: ``[B, H, D]``.  ``pos``: ``[B]`` int32 position being
+    written; ``pos < 0`` marks an idle lane (dropped).  ``block_tables``:
+    ``[B, N]``.
+    """
+    P, S = pool_l.shape[0], pool_l.shape[1]
+    b = jnp.arange(pos.shape[0])
+    safe = jnp.maximum(pos, 0)
+    pages = jnp.where(pos >= 0, block_tables[b, safe // S], P)
+    return pool_l.at[pages, safe % S].set(kv.astype(pool_l.dtype),
+                                          mode="drop")
+
+
+class PagedKVCache:
+    """The engine-owned pool pair.  Construction allocates the full
+    ``[L, P, S, H, D]`` arrays (zeros); the engine threads them through
+    its jit programs and stores back the returned (donated) arrays."""
+
+    def __init__(self, n_layers, num_pages, page_size, n_heads, d_head,
+                 dtype=jnp.bfloat16):
+        self.n_layers = int(n_layers)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.n_heads = int(n_heads)
+        self.d_head = int(d_head)
+        self.dtype = jnp.dtype(dtype)
+        shape = (self.n_layers, self.num_pages, self.page_size,
+                 self.n_heads, self.d_head)
+        self.k_pool = jnp.zeros(shape, self.dtype)
+        self.v_pool = jnp.zeros(shape, self.dtype)
+
+    @property
+    def page_bytes(self):
+        """Bytes one page holds across K+V (the roofline accounting in
+        docs/serving.md prices decode reads with this)."""
+        return (2 * self.page_size * self.n_heads * self.d_head
+                * self.dtype.itemsize)
+
+    @property
+    def pool_bytes(self):
+        return self.n_layers * self.num_pages * self.page_bytes

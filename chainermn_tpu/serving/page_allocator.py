@@ -1,0 +1,123 @@
+"""Pure block allocator for the paged KV cache.
+
+Host-side bookkeeping ONLY: pages are integer ids into the preallocated
+device pools (``serving.kv_cache``); no tensor ever passes through this
+module, so the decode hot path never copies KV bytes host-side — the
+allocator hands out page ids and the device programs scatter/gather
+through them.
+
+Discipline (mirrors ``_memory_utility.plan_buckets``): every decision is
+a pure function of the call sequence — the free list is FIFO over page
+ids seeded ``0..P-1``, frees return pages in block-table order — so a
+seeded request trace produces bit-identical block tables on every run
+and every host (the property suite pins this).  Invariants the suite
+churn-tests:
+
+* ownership: every allocated page is owned by exactly one sequence;
+* conservation: ``len(free) + sum(len(table))`` equals the pool size
+  after any alloc/free/evict interleaving;
+* atomicity: a failed ``ensure`` (``PagePoolExhaustedError``) leaves
+  the allocator state untouched — OOM is a typed scheduling event,
+  never corruption.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+from .errors import PagePoolExhaustedError
+
+__all__ = ["BlockAllocator"]
+
+
+class BlockAllocator:
+    """Fixed pool of ``num_pages`` pages, ``page_size`` token slots each.
+
+    ``ensure(seq_id, n_tokens)`` grows sequence ``seq_id``'s block table
+    to cover ``n_tokens`` positions (idempotent; allocation only ever
+    appends — positions are immutable once written).  ``free(seq_id)``
+    returns the table's pages to the free list in table order.
+    """
+
+    def __init__(self, num_pages, page_size):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free = deque(range(self.num_pages))
+        # OrderedDict: iteration order == admission order (the scheduler's
+        # eviction policy reads it newest-first)
+        self._tables = OrderedDict()
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def free_pages(self):
+        return len(self._free)
+
+    @property
+    def used_pages(self):
+        return self.num_pages - len(self._free)
+
+    def pages_for(self, n_tokens):
+        """Pages needed to hold ``n_tokens`` positions."""
+        return -(-max(0, int(n_tokens)) // self.page_size)
+
+    def sequences(self):
+        """Sequence ids in admission order (oldest first)."""
+        return list(self._tables)
+
+    def block_table(self, seq_id):
+        """The sequence's page ids, position-major (a copy)."""
+        return list(self._tables[seq_id])
+
+    def capacity(self, seq_id):
+        """Token positions the sequence's current pages can hold."""
+        return len(self._tables[seq_id]) * self.page_size
+
+    # -- mutation ------------------------------------------------------------
+
+    def ensure(self, seq_id, n_tokens):
+        """Grow ``seq_id``'s table to cover ``n_tokens`` positions.
+
+        Registers the sequence on first call.  Atomic: raises
+        :class:`PagePoolExhaustedError` (state unchanged) when the free
+        list cannot cover the growth.  Returns the block table (copy).
+        """
+        table = self._tables.get(seq_id)
+        if table is None:
+            table = []
+        need = self.pages_for(n_tokens) - len(table)
+        if need > len(self._free):
+            raise PagePoolExhaustedError(need, len(self._free),
+                                         self.num_pages)
+        if seq_id not in self._tables:
+            self._tables[seq_id] = table
+        for _ in range(max(0, need)):
+            table.append(self._free.popleft())
+        return list(table)
+
+    def free(self, seq_id):
+        """Release every page of ``seq_id`` (eviction and completion share
+        this path).  Pages rejoin the free list in table order.  Returns
+        the number of pages released."""
+        table = self._tables.pop(seq_id)
+        self._free.extend(table)
+        return len(table)
+
+    # -- invariant check (the property suite's oracle) -----------------------
+
+    def check(self):
+        """Assert the ownership/conservation invariants; returns True so
+        tests can ``assert alloc.check()`` after every churn step."""
+        owned = [p for t in self._tables.values() for p in t]
+        all_pages = list(self._free) + owned
+        if len(all_pages) != self.num_pages:
+            raise AssertionError(
+                f"page conservation violated: {len(self._free)} free + "
+                f"{len(owned)} owned != {self.num_pages}")
+        if len(set(all_pages)) != self.num_pages:
+            raise AssertionError("page owned by more than one holder")
+        if not all(0 <= p < self.num_pages for p in all_pages):
+            raise AssertionError("page id out of range")
+        return True

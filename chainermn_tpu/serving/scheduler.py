@@ -1,0 +1,158 @@
+"""Request scheduler: open-loop admission, per-tenant fairness, eviction.
+
+Admission happens at DECODE-STEP granularity (continuous batching): the
+engine asks the scheduler for joinable requests between decode steps, so
+a new request waits at most one step to enter the running batch — never
+for the batch to drain.  Policy pieces:
+
+* **Per-tenant fair queueing**: one FIFO per tenant, served round-robin
+  — each admission pass offers every tenant one grant in rotation, so a
+  tenant flooding requests cannot starve the others; rotation order is
+  deterministic (tenant first-seen order, persistent cursor).
+* **Bounded queues** (backpressure): ``submit`` raises the typed
+  :class:`~chainermn_tpu.serving.errors.QueueSaturatedError` when the
+  tenant's queue is at ``max_queue`` — load sheds at ingress instead of
+  accumulating unboundedly host-side.
+* **Preemption by eviction**: when the page pool runs dry mid-decode,
+  the engine evicts the YOUNGEST running sequence (LIFO — the one that
+  has consumed the least service, minimizing wasted work), frees its
+  pages, and re-queues it at the FRONT of its tenant's queue with the
+  tokens generated so far folded into its prompt (recompute on
+  re-admit: one prefill re-materializes the evicted KV, nothing else is
+  persisted).
+
+The scheduler is pure host bookkeeping with no device state; every
+decision is deterministic in the call sequence (the bench's seeded
+open-loop trace reproduces bit-identical schedules).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+import itertools
+
+import numpy as np
+
+from .errors import QueueSaturatedError
+
+__all__ = ["Request", "RequestScheduler"]
+
+
+class Request:
+    """One generation request.
+
+    ``prompt``: int32 token ids (any 1-D sequence).  ``max_new_tokens``:
+    decode budget.  ``tenant``: fairness bucket.  The engine fills in
+    lifecycle fields (``tokens``, timestamps) as it runs; after an
+    eviction ``prompt`` grows by the already-generated tokens and
+    ``max_new_tokens`` shrinks accordingly (recompute on re-admit
+    preserves completed work).
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, tenant="default",
+                 arrival_time=0.0, request_id=None):
+        self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            # prefill always produces one token (its logits ARE the
+            # first generation), and the engine's pool-fit check sizes
+            # by prompt + max_new — a 0 budget would both over-generate
+            # and, on an exact-fit prompt, livelock admission
+            raise ValueError("max_new_tokens must be >= 1")
+        self.tenant = tenant
+        self.arrival_time = float(arrival_time)
+        self.request_id = (next(Request._ids) if request_id is None
+                           else request_id)
+        self.tokens = []          # generated token ids (host ints)
+        self.token_times = []     # engine clock at each token production
+        self.preemptions = 0
+        self.first_token_time = None
+        self.finish_time = None
+
+    @property
+    def total_len(self):
+        return int(self.prompt.size) + len(self.tokens)
+
+    def __repr__(self):
+        return (f"Request(id={self.request_id}, tenant={self.tenant!r}, "
+                f"prompt={self.prompt.size}, new={len(self.tokens)}/"
+                f"{self.max_new_tokens})")
+
+
+class RequestScheduler:
+    def __init__(self, max_queue=256):
+        self.max_queue = int(max_queue)
+        self._queues = OrderedDict()   # tenant -> deque[Request]
+        self._rr = 0                   # round-robin cursor (tenant index)
+        self.submitted = 0
+        self.rejected = 0
+
+    # -- ingress -------------------------------------------------------------
+
+    def submit(self, request):
+        """Enqueue; raises :class:`QueueSaturatedError` at the bound."""
+        q = self._queues.setdefault(request.tenant, deque())
+        if len(q) >= self.max_queue:
+            self.rejected += 1
+            raise QueueSaturatedError(request.tenant, len(q),
+                                      self.max_queue)
+        q.append(request)
+        self.submitted += 1
+
+    def requeue_front(self, request, preempted=True):
+        """Re-admission path for an evicted request: generated tokens
+        fold into the prompt (their KV is recomputed by the re-admit
+        prefill; each token keeps its one production timestamp), and the
+        request jumps the line WITHIN its tenant — fairness across
+        tenants is unaffected.  ``preempted=False`` is the admission
+        back-off path (pool momentarily full, nothing was evicted)."""
+        if request.tokens:
+            request.prompt = np.concatenate(
+                [request.prompt,
+                 np.asarray(request.tokens, dtype=np.int32)])
+            request.max_new_tokens -= len(request.tokens)
+            request.tokens = []
+        if preempted:
+            request.preemptions += 1
+        self._queues.setdefault(request.tenant, deque()) \
+            .appendleft(request)
+
+    # -- egress --------------------------------------------------------------
+
+    def pending(self, tenant=None):
+        if tenant is not None:
+            return len(self._queues.get(tenant, ()))
+        return sum(len(q) for q in self._queues.values())
+
+    def next_admission(self, arrived_by=None):
+        """Pop the next request in fair rotation, or None.
+
+        ``arrived_by``: open-loop clock — only requests whose
+        ``arrival_time <= arrived_by`` are eligible (the bench's seeded
+        trace submits the whole schedule up front).  The round-robin
+        cursor advances past the granted tenant, so repeated calls in
+        one admission pass rotate across tenants.
+        """
+        tenants = list(self._queues)
+        n = len(tenants)
+        for i in range(n):
+            idx = (self._rr + i) % n
+            q = self._queues[tenants[idx]]
+            if q and (arrived_by is None
+                      or q[0].arrival_time <= arrived_by):
+                self._rr = (idx + 1) % n
+                return q.popleft()
+        return None
+
+    @staticmethod
+    def pick_victim(running):
+        """Eviction policy: the YOUNGEST running request (last admitted
+        — least service consumed, least recompute wasted).  ``running``
+        is admission-ordered oldest-first, as the engine keeps it."""
+        if not running:
+            return None
+        return running[-1]

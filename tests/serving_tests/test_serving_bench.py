@@ -1,0 +1,137 @@
+"""Serving bench-mode harness tests (ISSUE 9 satellites).
+
+Two contracts: (a) serving rows are FENCED OUT of the flagship
+last-good cache — same discipline as the longcontext/exchange rows: the
+metric is not flagship-cacheable, so neither a /tmp plant nor a real
+serving run can ever be re-served as training throughput; (b) the CPU
+smoke is CLAMPED and LABELED (``cpu_smoke: true``, seconds-scale) so a
+first-contact serving run can neither stale-out on size nor read as a
+perf datum, and its measured window never retraces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bench
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SERVING_ROW = {
+    "metric": "serving_engine_throughput",
+    "value": 5120.0, "unit": "tokens/sec", "vs_baseline": None,
+    "platform": "axon", "device_kind": "TPU v5 lite", "n_devices": 1,
+    "p50_token_latency_ms": 3.1, "p99_token_latency_ms": 18.0,
+    "qps": 16.0, "tenants": 4,
+}
+
+
+@pytest.fixture
+def cache_paths(tmp_path, monkeypatch):
+    primary = str(tmp_path / "last_bench.json")
+    repo = str(tmp_path / "repo_last_bench.json")
+    monkeypatch.setattr(bench, "_CACHE_PATH", primary)
+    monkeypatch.setattr(bench, "_REPO_CACHE_PATH", repo)
+    monkeypatch.setattr(bench, "_PREWARM_SENTINEL_BASE",
+                        str(tmp_path / "prewarmed"))
+    monkeypatch.setattr(bench, "_START_STAMP", str(tmp_path / "started"))
+    return primary, repo
+
+
+def test_serving_rows_are_never_flagship_cacheable(cache_paths, capsys):
+    """Even a pristine on-chip serving row must not enter either cache
+    slot: its metric is outside the flagship map, so `_cacheable` and
+    the cross-slot screens refuse it on every path."""
+    primary, repo = cache_paths
+    assert bench._cacheable(SERVING_ROW) is False
+    bench._emit(SERVING_ROW)              # persist path
+    capsys.readouterr()
+    assert not os.path.exists(primary)
+    assert not os.path.exists(repo)
+
+
+def test_planted_serving_entry_is_not_promoted(cache_paths, capsys,
+                                              monkeypatch):
+    """A serving entry planted in /tmp must not be promoted into the
+    committed repo slot by a later flagship persist, and must never be
+    re-served under any metric."""
+    primary, repo = cache_paths
+    with open(primary, "w") as f:
+        json.dump({"entries": {"serving_engine_throughput": {
+            "run_id": "planted", "saved_at": 9e9,
+            "result": SERVING_ROW}}}, f)
+    # a legit flagship result persists; the serving plant must not ride
+    for k in ("BENCH_BS", "BENCH_SIZE", "BENCH_STEPS", "BENCH_MODEL",
+              "BENCH_EXCHANGE", "BENCH_DONATE"):
+        monkeypatch.delenv(k, raising=False)
+    from tests.test_bench_harness import TPU_RESULT
+    bench._emit(dict(TPU_RESULT, per_chip_batch=64, n_steps=40))
+    capsys.readouterr()
+    with open(repo) as f:
+        entries = json.load(f)["entries"]
+    assert "serving_engine_throughput" not in entries
+    # stale re-serve path: serving metric finds nothing to serve
+    monkeypatch.setenv("BENCH_MODEL", "serving")
+    run_id, cached, fp = bench._load_cache("serving_engine_throughput")
+    assert cached is None
+
+
+def test_err_metric_and_first_contact_refusal(cache_paths, capsys,
+                                              monkeypatch):
+    """BENCH_MODEL=serving wires the error path to the serving metric,
+    and first contact (no serving sentinel) refuses any stale re-serve
+    — an honest null, the longcontext discipline."""
+    monkeypatch.setenv("BENCH_MODEL", "serving")
+    assert bench._err_metric() == ("serving_engine_throughput",
+                                   "tokens/sec")
+    assert bench._first_contact("serving")
+    bench._emit_stale_or_error("relay wedged")
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["metric"] == "serving_engine_throughput"
+    assert row["value"] is None
+    assert row["first_contact"] is True
+    assert "stale" not in row
+
+
+def test_cpu_smoke_is_clamped_labeled_and_retrace_free(tmp_path):
+    """End-to-end subprocess: the serving bench on the CPU backend
+    emits one final row that is (a) labeled cpu_smoke, (b) clamped to
+    the smoke load even when the env asks for more, (c) retrace-free in
+    its measured window, and (d) carries the full metric surface
+    (tokens/sec + p50/p99 + occupancy)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NO_SUPERVISE="1",
+               BENCH_MODEL="serving",
+               BENCH_SERVE_REQUESTS="64",      # clamps to 12
+               BENCH_SERVE_QPS="200",          # fast arrivals: no idle
+               BENCH_SERVE_TENANTS="3",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo.json"),
+               BENCH_PREWARM_SENTINEL=str(tmp_path / "prewarm"),
+               BENCH_START_STAMP=str(tmp_path / "started"),
+               BENCH_DEADLINE_S="480")
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=420, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_engine_throughput"
+    assert row["cpu_smoke"] is True
+    assert row["requests"] == 12               # the clamp
+    assert row["tenants"] == 3                 # knobs respected
+    assert row["qps"] == 200.0
+    assert row["value"] and row["value"] > 0
+    assert row["window_retraces"] == 0
+    assert row["completed"] == 12
+    for key in ("p50_token_latency_ms", "p99_token_latency_ms",
+                "page_occupancy_mean", "page_occupancy_max",
+                "attn_mode", "page_dtype"):
+        assert key in row, key
+    # the smoke never touches the caches (metric fencing end-to-end)
+    assert not os.path.exists(tmp_path / "cache.json")
+    assert not os.path.exists(tmp_path / "repo.json")
+    # and a CPU run never stamps the serving prewarm sentinel
+    assert not os.path.exists(str(tmp_path / "prewarm") + ".serving")

@@ -35,7 +35,13 @@ MODULES = {
         "gpipe_apply", "one_f_one_b", "make_pipeline_train_step",
         "switch_moe", "moe_dispatch_combine", "make_mesh",
         "axis_communicators", "split_microbatches", "merge_microbatches"],
-    "chainermn_tpu.ops": ["attention", "flash_attention", "xla_attention"],
+    "chainermn_tpu.ops": ["attention", "flash_attention", "xla_attention",
+                          "paged_decode_attention", "paged_attn_mode"],
+    "chainermn_tpu.serving": [
+        "ServingEngine", "Request", "RequestScheduler", "BlockAllocator",
+        "PagedKVCache", "prefill_program", "decode_program",
+        "write_prompt_kv", "write_token_kv", "ServingError",
+        "PagePoolExhaustedError", "QueueSaturatedError"],
     "chainermn_tpu.models": [
         "MLP", "Classifier", "ResNet18", "ResNet50", "ResNet101",
         "AlexNet", "NIN", "VGG16", "GoogLeNet", "Seq2seq",

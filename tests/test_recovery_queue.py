@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,32 +78,35 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 21 bench steps recorded, each once, in queue order
+    # all 23 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",  # prewarm
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",  # flagship
-        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-gdd-ef1",  # donation
-        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",  # headroom
-        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-gdd-ef1",  # input
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",  # prewarm
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",  # flagship
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-gdd-ef1-sqd-std",  # donation
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",  # headroom
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",  # input
         # ISSUE 5: bucket-MB sweep + reduce-scatter A/B legs
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-gdd-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-gdd-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-gdd-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-gdd-ef1",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-gdd-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-gdd-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-gdd-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-gdd-ef1-sqd-std",
         # ISSUE 6: hierarchical two-level exchange, forced 2x4 split
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdd-ef1",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdd-ef1-sqd-std",
         # ISSUE 8: DCN wire-dtype A/B + error-feedback ablation
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdnone-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef1",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef0",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-gdint8-ef1",
-        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-gdd-ef1",
-        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1",  # flash
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdnone-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef1-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef0-sqd-std",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-gdint8-ef1-sqd-std",
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",  # flash
+        # ISSUE 9: serving engine rows (flagship qps16x4 + saturation)
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sq64-st8",
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
@@ -154,7 +157,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the seventeen bench rows
+    the queue must still complete (|| true), the twenty-three bench rows
     must already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
@@ -178,5 +181,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 21
+                if '"final"' in ln]) == 23
     assert "Flash-vs-XLA" not in notes_text

@@ -692,6 +692,42 @@ def probe_comm():
                                   bucket_mb=bucket_mb)), flush=True)
 
 
+def probe_serving():
+    """PROBE=serving: the committed serving budgets
+    (tools/serving_budgets.json, gated tier-1 by
+    tests/test_serving_budget.py) joined with a LIVE decode/prefill
+    census, plus the per-phase table: for each phase one row of
+    structure facts and the decode roofline's byte accounting (bytes
+    the step must read from the KV pool per generated token at the
+    committed geometry — the number docs/serving.md §"decode roofline"
+    derives).  Trace property — chip-free."""
+    import serving_census
+
+    budgets = serving_census.load_budgets()
+    live = serving_census.structure()
+    for phase, facts in live.items():
+        committed = budgets["structure"].get(phase, {})
+        print(json.dumps({"probe": "serving", "phase": phase, **facts,
+                          "within_structure": facts == committed}),
+              flush=True)
+    g = budgets["geometry"]
+    H, D = g["n_heads"], g["d_model"] // g["n_heads"]
+    kv_itemsize = 2  # bf16 pages (the engine default; PR 3 discipline)
+    for phase, per_tok in (
+            # decode reads the whole context's K+V once per token
+            ("decode", 2 * g["n_layers"] * g["max_context"] * H * D
+             * kv_itemsize),
+            # prefill writes each position's K+V exactly once
+            ("prefill", 2 * g["n_layers"] * H * D * kv_itemsize)):
+        print(json.dumps({
+            "probe": "serving_phase_table", "phase": phase,
+            "kv_bytes_per_token_at_max_context": per_tok,
+            "page_kv_bytes": 2 * g["page_size"] * H * D * kv_itemsize,
+            "pool_kv_bytes": 2 * g["n_layers"] * g["num_pages"]
+            * g["page_size"] * H * D * kv_itemsize,
+            "targets_status": budgets["targets"]["status"]}), flush=True)
+
+
 def probe_flashcmp():
     """Flash (Pallas) vs xla_attention payoff, quantified (VERDICT r3
     Missing #3): causal self-attention fwd+bwd at GPT-2-small geometry,
@@ -857,3 +893,5 @@ if __name__ == "__main__":
         probe_flash()
     if which == "comm":
         probe_comm()
+    if which == "serving":
+        probe_serving()

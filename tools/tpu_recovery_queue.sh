@@ -4,6 +4,34 @@
 # emits stale/error lines instead of hanging; profile runs go last so a
 # wedge there cannot block the benches. Nothing here kills a TPU process.
 #
+# ============================================================================
+# FIRST CHIP CONTACT CHECKLIST (drain before any new perf claim; queue order)
+# ============================================================================
+# Every numeric gate below is ARMED but UNSTAMPED — no on-chip numbers have
+# landed since r5.  Running this script top to bottom drains them all; the
+# per-item "stamp" is what turns each committed gate live:
+#
+#  1. flash >=2x gate (ISSUE 4): `flash_sweep.py --write-budgets` step below
+#     rewrites tools/flash_budgets.json (sweep.status -> measured); paste the
+#     winner tiles into ops/flash_attention.py _BWD_BLOCK_TABLE and commit.
+#     Gate: tests/test_flash_budget.py vs target_fwd_bwd_tflops_T8192=63.6.
+#  2. bucket-MB sweep (ISSUE 5): the 1/4/16 MB bucketed rows below; put the
+#     winning bound into tools/comm_budgets.json `sweep` (status -> measured)
+#     to arm tests/test_comm_budget.py's numeric half.
+#  3. donate-off A/B (ISSUE 3): the BENCH_DONATE=0 row vs the bs64 flagship
+#     row = the donation payoff; record the delta in BENCH_NOTES (no numeric
+#     gate — the structure gate is already live).
+#  4. serving tokens/sec + p99 (ISSUE 9): the BENCH_MODEL=serving rows below
+#     (qps16x4 flagship serving config + qps64x8 saturation probe); commit
+#     tokens_per_sec/p99_token_latency_ms into tools/serving_budgets.json
+#     `targets` (status -> measured) to arm tests/test_serving_budget.py's
+#     numeric half.
+#
+# Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
+# split A/B, int8/bf16/lossless DCN wire A/B + EF-off ablation, the gloo
+# exposed-comm curves, and the seq-8192 remat rows.
+# ============================================================================
+#
 # QUEUE_REPO/QUEUE_LOG/QUEUE_NOTES env overrides exist for the bitrot
 # test (tests/test_recovery_queue.py) — this script runs unattended
 # exactly once per recovery, so its mechanics are tested with a stubbed
@@ -140,6 +168,18 @@ run_one "transformer bs2 seq8192 remat (dots policy)" \
 # 32k Mosaic compile gets the same abandoned-RPC headroom.
 run_one "longcontext flash 16k/32k + xla contrast (fused bwd)" \
   BENCH_MODEL=longcontext BENCH_DEADLINE_S=1800
+# ISSUE 9: the serving engine's first on-chip numbers — tokens/sec,
+# p50/p99 per-token latency, page-pool occupancy under the seeded
+# open-loop load.  The qps16 x4 row is the flagship serving config
+# (its numbers stamp tools/serving_budgets.json targets -> measured,
+# arming the tier-1 numeric gate); the qps64 x8 row saturates the
+# batch so preemption/eviction and queueing show up in p99.  Serving
+# rows are metric-fenced out of the last-good cache by construction.
+run_one "serving engine open-loop qps16 x4 tenants (flagship serving)" \
+  BENCH_MODEL=serving BENCH_DEADLINE_S=900
+run_one "serving engine qps64 x8 tenants (saturation/preemption probe)" \
+  BENCH_MODEL=serving BENCH_SERVE_QPS=64 BENCH_SERVE_TENANTS=8 \
+  BENCH_DEADLINE_S=900
 
 # Fold THIS run's authoritative JSON lines into BENCH_NOTES so the round
 # records the on-chip numbers even if nobody is awake to do it manually.
