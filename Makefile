@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving sweep-flash audit dryrun examples clean
+.PHONY: test chaos chaos-elastic bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -17,6 +17,14 @@ chaos:            ## fault-injection suite, rotating seed (echoed for repro)
 	@seed=$${CHAOS_SEED:-$$(python3 -c "import time; print(int(time.time()) % 100000)")}; \
 	echo "chaos seed: $$seed  (repro: CHAOS_SEED=$$seed make chaos)"; \
 	CHAINERMN_TPU_CHAOS_SEED=$$seed $(PY) -m pytest tests/ -q -m chaos
+
+chaos-elastic:    ## elastic preempt-and-rejoin E2E (2-process gloo)
+	@# ISSUE 10 acceptance: rank 1 hard-preempted mid-run -> survivors
+	@# shrink and keep training -> rank re-joins, world grows back ->
+	@# convergence parity + cross-world-size checkpoint bit-exactness.
+	@# Runs under the chaos marker (tier-1 runs it too; this target is
+	@# the focused repro loop).
+	$(PY) -m pytest tests/multiprocess_tests/test_elastic_chaos.py -q -m chaos
 
 bench:            ## real-hardware benchmark (one JSON line)
 	$(PY) bench.py

@@ -367,7 +367,8 @@ _DEFAULT_FINGERPRINTS = {
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
                  "input_pipeline": False, "donate": True,
                  "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
-                 "grad_dtype": "bfloat16", "error_feedback": True},
+                 "grad_dtype": "bfloat16", "error_feedback": True,
+                 "preempt_rank": -1},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -376,7 +377,8 @@ _DEFAULT_FINGERPRINTS = {
                     "n_steps": DEFAULT_TF_STEPS,
                     "flash_blocks": ":", "donate": True,
                     "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
-                    "grad_dtype": "bfloat16", "error_feedback": True},
+                    "grad_dtype": "bfloat16", "error_feedback": True,
+                    "preempt_rank": -1},
 }
 
 def _env_float(name, default):
@@ -452,6 +454,9 @@ def _config_fingerprint(model=None):
             "grad_dtype": os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"),
             "error_feedback":
                 os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
+            # the elastic A/B (preempt-and-rejoin, ISSUE 10) measures a
+            # resizing world — never flagship data (-1 = no preemption)
+            "preempt_rank": _env_int("BENCH_PREEMPT_RANK", -1),
         }
     return {
         "model": "resnet50",
@@ -470,6 +475,7 @@ def _config_fingerprint(model=None):
         "grad_dtype": os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"),
         "error_feedback":
             os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
+        "preempt_rank": _env_int("BENCH_PREEMPT_RANK", -1),
     }
 
 
@@ -506,6 +512,11 @@ def _payload_flagship_ok(model, result):
         return False
     if not result.get("donated", True):
         # the BENCH_DONATE=0 A/B leg is a measurement, not flagship data
+        return False
+    if result.get("resizes"):
+        # a mid-run communicator resize (elastic shrink/grow, ISSUE 10)
+        # changes the measured world mid-row — never flagship data
+        # (legacy rows lack the key and were fixed-size by construction)
         return False
     if result.get("exchange", "flat") != "flat":
         # bucketed/reduce_scatter/per_leaf legs compile a different
@@ -864,6 +875,17 @@ def _exchange_row_fields(model, comm, exchange):
               "topology": comm.topology,
               "ici_size": comm.ici_size,
               "dcn_size": comm.dcn_size,
+              # elastic columns (ISSUE 10): the controller world the row
+              # was measured at, and how many membership epochs the
+              # COMMUNICATOR has been through at construction (bench.py
+              # itself never resizes mid-measurement — the elastic
+              # measurement is bench_scaling --preempt-rank, whose rows
+              # carry recovery-stats resize counts; here >0 means the
+              # row was measured on a resize-scarred world, and
+              # `_payload_flagship_ok` fences any resizes>0 row out of
+              # the flagship last-good cache)
+              "world_size": getattr(comm, "inter_size", 1),
+              "resizes": int(getattr(comm, "epoch", 0)),
               "grad_dtype": str(gdtype) if gdtype is not None else None,
               "dcn_wire_dtype": str(comm.dcn_grad_dtype)
               if comm.dcn_grad_dtype is not None else None,

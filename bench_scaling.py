@@ -335,6 +335,161 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
     return rows
 
 
+def _gloo_elastic_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
+                         preempt_rank):
+    """One process of the elastic preempt-and-rejoin measurement
+    (ISSUE 10): a Trainer-supervised run over real gloo transport in
+    which rank ``preempt_rank`` is hard-preempted a third of the way
+    in, the survivors shrink and keep training, and the rank re-joins
+    (world grows back).  ``preempt_rank < 0`` is the uninterrupted
+    baseline leg of the A/B.  Rank 0 prints the row; ``step_ms`` is
+    wall-clock over ALL iterations, so the resize + state-sync tax is
+    IN the number — that tax vs the baseline row is the measurement."""
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from chainermn_tpu.communicators._communication_utility import (
+        initialize_distributed)
+    assert initialize_distributed(f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+    import tempfile
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import (FaultInjectionCommunicator,
+                                             FaultSchedule)
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.dataset import SerialIterator, TupleDataset
+    from chainermn_tpu.extensions import ElasticRecovery
+    from chainermn_tpu.models import MLP, Classifier
+    from chainermn_tpu.training import StandardUpdater, Trainer
+    from chainermn_tpu.training.trainer import Extension
+
+    out = tempfile.mkdtemp(prefix=f"elastic_bench_{pid}_")
+    rng = np.random.RandomState(0)
+    gbs = per_rank_bs * nprocs
+    x = np.asarray(rng.normal(0, 1, (gbs, 64)).astype(np.float32))
+    t = np.asarray(rng.randint(0, 10, gbs).astype(np.int32))
+
+    comm = ct.create_communicator("jax_ici")
+    comm._host_channel()._timeout_ms = 6000  # typed detection in seconds
+    if preempt_rank >= 0:
+        # beacon + join-poll = two bcast_obj calls per iteration; fire
+        # at the target iteration's beacon
+        k = max(2, steps // 3)
+        comm = FaultInjectionCommunicator(comm, FaultSchedule(
+            [dict(op="bcast_obj", nth=2 * (k - 1) + 1, action="preempt",
+                  rank=preempt_rank)], seed=0))
+    model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.01, momentum=0.9), comm).setup(model)
+    it = SerialIterator(TupleDataset(x, t), gbs, shuffle=False)
+    trainer = Trainer(StandardUpdater(it, opt), (steps, "iteration"),
+                      out=out)
+    cp = ct.create_multi_node_checkpointer(comm, name="eb", path=out)
+    recovery = ElasticRecovery(checkpointer=cp, comm=comm,
+                               rejoin_after_s=1.0,
+                               resolve_timeout_ms=120_000, verbose=False)
+
+    class _Beacon(Extension):
+        trigger = (1, "iteration")
+        priority = 400
+
+        def __call__(self, trainer):
+            recovery.comm.bcast_obj(
+                {"it": trainer.updater.iteration}, root=0)
+
+    class _Pacer(Extension):
+        # keeps the survivor in the loop long enough for the rejoin to
+        # land mid-run (the elastic leg only; the baseline pays the
+        # SAME dwell so the A/B delta isolates the elasticity tax)
+        trigger = (1, "iteration")
+        priority = 350
+
+        def __call__(self, trainer):
+            _time.sleep(0.1)
+
+    trainer.extend(_Beacon())
+    trainer.extend(_Pacer())
+    trainer.extend(cp, trigger=(max(2, steps // 6), "iteration"))
+    trainer.extend(recovery)
+    start = _time.perf_counter()
+    trainer.run()
+    wall = _time.perf_counter() - start
+    if pid == 0:
+        stats = recovery.stats
+        print(json.dumps({
+            "processes": nprocs, "per_rank_bs": per_rank_bs,
+            "elastic": preempt_rank >= 0,
+            "preempt_rank": preempt_rank if preempt_rank >= 0 else None,
+            "world_size": recovery.comm.inter_size,
+            "resizes": stats["resizes"],
+            "ranks_lost": stats["ranks_lost"],
+            "ranks_joined": stats["ranks_joined"],
+            "iterations": trainer.updater.iteration,
+            "wall_s": round(wall, 3),
+            "step_ms": round(wall / max(1, trainer.updater.iteration)
+                             * 1e3, 3),
+            "examples_per_sec": round(
+                trainer.updater.iteration * gbs / wall, 1)}), flush=True)
+
+
+def _run_elastic_ab(nprocs, per_rank_bs, hidden, steps, preempt_rank):
+    """The ≥2-host elastic A/B (ISSUE 10): one uninterrupted P-process
+    run, one preempt-and-rejoin run, and the delta — the end-to-end
+    cost of losing and re-admitting a rank (typed detection + two
+    membership resolves + two rebuilds + snapshot sync) under real
+    process boundaries."""
+    import re
+    import socket
+    import subprocess
+    import sys
+    if not 0 <= preempt_rank < nprocs:
+        raise SystemExit(f"--preempt-rank {preempt_rank} is not a rank "
+                         f"of a {nprocs}-process run")
+    env = dict(os.environ)
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "",
+            env["XLA_FLAGS"])
+    rows = []
+    for leg_preempt in (-1, preempt_rank):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--gloo-elastic-worker", str(pid), str(nprocs), str(port),
+             str(per_rank_bs), str(hidden), str(steps),
+             str(leg_preempt)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in range(nprocs)]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=600)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        assert all(p.returncode == 0 for p in procs), \
+            [(p.returncode, o[-2000:]) for p, o in zip(procs, outs)]
+        row = json.loads([ln for ln in outs[0].splitlines()
+                          if ln.startswith("{")][-1])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base, elastic = rows
+    print(json.dumps({
+        "processes": nprocs, "preempt_rank": preempt_rank,
+        "elastic_overhead_s": round(
+            elastic["wall_s"] - base["wall_s"], 3),
+        "elastic_step_ms_vs_baseline": round(
+            elastic["step_ms"] - base["step_ms"], 3),
+        "resizes": elastic["resizes"]}), flush=True)
+    return rows
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--per-chip-bs", type=int, default=8)
@@ -356,6 +511,17 @@ def main():
                              "process count (gloo CPU backend)")
     parser.add_argument("--gloo-worker", nargs=8, default=None,
                         help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--gloo-elastic-worker", nargs=7, default=None,
+                        help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--preempt-rank", type=int, default=None,
+                        help="run the elastic preempt-and-rejoin A/B "
+                             "(ISSUE 10): an uninterrupted P-process "
+                             "gloo run vs one where this rank is "
+                             "hard-preempted mid-run, shrinks out, "
+                             "re-joins and the world grows back; P = "
+                             "max of --gloo-procs (default 2).  The "
+                             "summary line is the end-to-end "
+                             "elasticity tax")
     parser.add_argument("--gloo-hidden", type=int, default=512,
                         help="MLP hidden width for --gloo-procs")
     parser.add_argument("--gloo-zero", action="store_true",
@@ -385,6 +551,15 @@ def main():
             map(int, args.gloo_worker[:7])
         _gloo_worker(pid, nprocs, port, bs, hidden, steps, bool(zero),
                      exchange=args.gloo_worker[7])
+        return
+    if args.gloo_elastic_worker:
+        _gloo_elastic_worker(*map(int, args.gloo_elastic_worker))
+        return
+    if args.preempt_rank is not None:
+        nprocs = max(int(c) for c in args.gloo_procs.split(",")) \
+            if args.gloo_procs else 2
+        _run_elastic_ab(nprocs, args.per_chip_bs, args.gloo_hidden,
+                        args.steps, args.preempt_rank)
         return
     if args.gloo_procs:
         # lazy: the vocabulary lives with the communicator mapping (the

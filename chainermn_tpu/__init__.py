@@ -40,5 +40,6 @@ from .iterators import (create_multi_node_iterator,
                         create_synchronized_iterator)
 from . import global_except_hook
 global_except_hook._add_hook_if_enabled()
-from .datasets import (scatter_dataset, create_empty_dataset, scatter_index,
+from .datasets import (scatter_dataset, rescatter_dataset,
+                       create_empty_dataset, scatter_index,
                        get_n_iterations_for_one_epoch)

@@ -50,21 +50,25 @@ import jax
 
 from ._host_channel import (ChannelError, ChannelTimeoutError, PeerLostError,
                             HostChannel, HeartbeatMonitor)
+from ._membership import ElasticMembership, MembershipView
 from .communicator_base import CommunicatorBase
 from .debug_communicator import DebugCommunicator
 from .dummy_communicator import DummyCommunicator
 from .fault_injection_communicator import (FaultInjectionCommunicator,
                                            bind_host_channel)
 from .fault_schedule import (FaultSchedule, FaultSpec, InjectedFault,
-                             schedule_from_env)
-from .mesh_communicator import MeshCommunicator
+                             RankPreempted, schedule_from_env)
+from .mesh_communicator import ElasticMeshCommunicator, MeshCommunicator
 
 __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
-           "DummyCommunicator", "DebugCommunicator",
+           "ElasticMeshCommunicator", "DummyCommunicator",
+           "DebugCommunicator",
            "FaultInjectionCommunicator", "FaultSchedule", "FaultSpec",
-           "InjectedFault", "bind_host_channel", "schedule_from_env",
+           "InjectedFault", "RankPreempted", "bind_host_channel",
+           "schedule_from_env",
            "ChannelError", "ChannelTimeoutError", "PeerLostError",
            "HostChannel", "HeartbeatMonitor",
+           "ElasticMembership", "MembershipView",
            "EXCHANGES", "exchange_knobs"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
@@ -181,8 +185,12 @@ def create_communicator(communicator_name="jax_ici", devices=None,
         comm = FaultInjectionCommunicator(base, schedule)
         channel = base._host_channel()
         if channel is not None:
+            # the clone re-binds the wrapper's rank: to_dict carries the
+            # specs' rank targeting but a schedule's OWN binding is
+            # process-local state
             comm.hc_schedule = bind_host_channel(
-                channel, FaultSchedule.from_dict(schedule.to_dict()))
+                channel, FaultSchedule.from_dict(schedule.to_dict())
+                .bind_rank(schedule.rank))
         return comm
     if name == "debug":
         return DebugCommunicator(devices=devices, axis_name=axis_name,

@@ -307,11 +307,15 @@ class HostChannel:
         transient errors with exponential backoff up to ``max_retries``.
 
         Non-retriable: :class:`PeerLostError` (the peer is gone — more
-        attempts cannot help) and the posted-abort RuntimeError (fail-stop
-        must win).  Everything else is treated as transient until the
-        retry/deadline budget runs out, then surfaces as
+        attempts cannot help), the posted-abort RuntimeError (fail-stop
+        must win), and the injected
+        :class:`~.fault_schedule.RankPreempted` (a reclaimed host does
+        not come back within a backoff — the elastic supervisor must
+        see it immediately).  Everything else is treated as transient
+        until the retry/deadline budget runs out, then surfaces as
         :class:`ChannelTimeoutError` chained to the last failure.
         """
+        from .fault_schedule import RankPreempted
         timeout_ms = self._op_timeout_ms(op)
         deadline = self._clock() + timeout_ms / 1000.0
         attempts = 0
@@ -325,7 +329,7 @@ class HostChannel:
             attempts += 1
             try:
                 return fn(remaining_ms)
-            except (PeerLostError, _AbortedError):
+            except (PeerLostError, _AbortedError, RankPreempted):
                 raise
             except Exception as e:
                 last_exc = e

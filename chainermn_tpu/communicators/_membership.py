@@ -1,0 +1,357 @@
+"""Elastic membership — who is in the job, decided over the KV store.
+
+ISSUE 10 (ROADMAP item 5): PR 1's resilience subsystem recovers faults
+at a FIXED world size; spot/preemptible capacity means controller
+processes leave and join mid-run.  This module is the control-plane
+half of elasticity: a generation-keyed (``epoch``-keyed) membership
+protocol over the same coordination-service KV client the
+:class:`~._host_channel.HostChannel` rides, so survivors can agree on
+the new rank set without any participation from a dead peer — the one
+thing the channel's lock-step collectives can never do.
+
+Protocol (see ``docs/resilience.md`` §7):
+
+* Membership is a monotonically increasing **epoch** counter plus, per
+  epoch, a decided **view** (the sorted tuple of live controller
+  ranks).  Keys live under ``<ns>/elastic`` — OUTSIDE the host
+  channel's per-generation prefix, so a ``bump_generation`` (the
+  fixed-size recovery quiesce) never strands a membership decision.
+* ``announce_leave()`` / ``announce_join()`` are non-blocking,
+  generation-keyed intents a rank posts before it departs / when it
+  wants back in.  A standing ``leave`` excludes its rank from the next
+  decision even if stale presence keys linger; ``announce_join``
+  retracts any standing leave.
+* :meth:`ElasticMembership.resolve` is the consensus: every candidate
+  posts (and keeps refreshing) a presence beat under the NEXT epoch,
+  the lowest-ranked *live* candidate acts as leader, and the leader
+  publishes the view once the candidate set is **complete** (every
+  rank in ``expect`` present) or **settled** (unchanged for
+  ``settle_s`` — the typed timeout that drops unresponsive peers).
+  Everyone else adopts the published view.  Candidates whose beat
+  freezes for ``stall_s`` mid-resolve are excluded (and skipped for
+  leadership), measured on the observer's clock like
+  :class:`~._host_channel.HeartbeatMonitor` — a peer that died INSIDE
+  the consensus cannot wedge it.
+* A resolve that exhausts its deadline without any published view
+  raises :class:`~._host_channel.ChannelTimeoutError` (op
+  ``"membership.resolve"``) — typed, never a hang.
+
+Split-brain note: the leader rule (minimum live candidate decides) has
+the usual asynchronous-consensus caveat — a candidate so slow that the
+leader settles without it finds itself EXCLUDED from the published
+view.  That is surfaced, not hidden: :meth:`resolve` returns the view
+it adopted, and :class:`~..extensions.ElasticRecovery` treats
+"view without me" exactly like a preemption (announce join, wait for
+re-admission), so a late rank degrades to a rejoin instead of a
+second, disjoint world.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ._host_channel import ChannelTimeoutError
+
+__all__ = ["MembershipView", "ElasticMembership"]
+
+
+class MembershipView:
+    """One decided membership generation: ``epoch`` + sorted ``members``
+    (global controller ranks).  Immutable value object."""
+
+    def __init__(self, epoch, members):
+        self.epoch = int(epoch)
+        self.members = tuple(sorted(int(m) for m in members))
+        if len(set(self.members)) != len(self.members):
+            raise ValueError(f"duplicate members in view: {members!r}")
+
+    @property
+    def size(self):
+        return len(self.members)
+
+    def slot(self, rank):
+        """This member's dense 0-based slot in the view (collective
+        addressing), or None for a non-member."""
+        return self.members.index(rank) if rank in self.members else None
+
+    def __contains__(self, rank):
+        return rank in self.members
+
+    def __eq__(self, other):
+        return (isinstance(other, MembershipView)
+                and (self.epoch, self.members)
+                == (other.epoch, other.members))
+
+    def __hash__(self):
+        return hash((self.epoch, self.members))
+
+    def __repr__(self):
+        return f"<MembershipView epoch={self.epoch} members={self.members}>"
+
+
+class ElasticMembership:
+    """The membership protocol bound to one process (see module doc).
+
+    ``client``: the coordination-service KV client (or the test fake).
+    ``rank``/``world``: this process's GLOBAL controller rank and the
+    boot-time process count — membership ranks are stable process
+    identities; a resized communicator maps them to dense slots.
+    ``settle_s``: how long the candidate set must be unchanged before
+    the leader decides without the full ``expect`` set (the per-peer
+    timeout).  ``stall_s``: a candidate whose presence beat freezes
+    this long mid-resolve is presumed dead and excluded.  ``clock``/
+    ``sleep`` are injectable for deterministic tests.
+    """
+
+    def __init__(self, client, rank, world, namespace="cmn",
+                 settle_s=1.0, stall_s=10.0, poll_s=0.05,
+                 timeout_ms=60_000, clock=time.monotonic,
+                 sleep=time.sleep):
+        self._client = client
+        self.rank = int(rank)
+        self.world = int(world)
+        self._base = f"{namespace}/elastic"
+        self.settle_s = float(settle_s)
+        self.stall_s = float(stall_s)
+        self.poll_s = float(poll_s)
+        self.timeout_ms = int(timeout_ms)
+        self._clock = clock
+        self._sleep = sleep
+        self._epoch_cache = 0  # monotone last-known decided epoch
+        self.stats = {"resolves": 0, "led": 0, "adopted": 0}
+
+    # -- KV primitives -------------------------------------------------------
+    # The real coordination-service client is narrower than the test
+    # fakes: it has NO ``key_value_try_get`` (non-blocking probes ride a
+    # short ``blocking_key_value_get``) and its ``key_value_set``
+    # REFUSES overwrites (ALREADY_EXISTS) — re-announcements, presence
+    # beats, and the epoch pointer all need delete-then-set.  These
+    # wrappers absorb both shapes, so the protocol runs identically
+    # against jax's client and the in-memory fakes.
+
+    #: probe window for the emulated non-blocking get (ms): long enough
+    #: for the server round-trip, short enough that a full world scan
+    #: stays well under one poll interval
+    PROBE_MS = 5
+
+    def _try_get(self, key):
+        c = self._client
+        fn = getattr(c, "key_value_try_get", None)
+        try:
+            if fn is not None:
+                return fn(key)
+            return c.blocking_key_value_get(key, self.PROBE_MS)
+        except Exception:
+            return None
+
+    def _set(self, key, value):
+        c = self._client
+        try:
+            c.key_value_set(key, str(value))
+            return
+        except Exception:
+            pass
+        try:
+            # ALREADY_EXISTS (the real client's overwrite refusal):
+            # last-writer-wins via delete-then-set.  Every such key has
+            # a single writer by protocol (own presence/announce keys;
+            # the epoch pointer is leader-only), so the window is benign
+            c.key_value_delete(key)
+            c.key_value_set(key, str(value))
+        except Exception:
+            pass
+
+    def _delete(self, key):
+        try:
+            self._client.key_value_delete(key)
+        except Exception:
+            pass
+
+    def _scan(self, prefix, ranks):
+        """``{rank: value}`` of ``<prefix>/<rank>`` keys.  One
+        ``key_value_dir_get`` round-trip on the real client; per-rank
+        probes on fakes that lack it."""
+        c = self._client
+        fn = getattr(c, "key_value_dir_get", None)
+        if fn is not None:
+            out = {}
+            try:
+                for key, value in fn(prefix):
+                    tail = str(key).rsplit("/", 1)[-1]
+                    if tail.isdigit():
+                        out[int(tail)] = value
+            except Exception:
+                pass
+            return {r: out[r] for r in ranks if r in out}
+        return {r: v for r in ranks
+                if (v := self._try_get(f"{prefix}/{r}")) is not None}
+
+    # -- epochs and views ----------------------------------------------------
+    def current_epoch(self):
+        """The newest DECIDED epoch (0 = boot, nothing decided yet).
+
+        Decided epochs are APPEND-ONLY keys (``epochs/<k>``, one per
+        decision, never overwritten or deleted): a single mutable
+        pointer would need the real client's delete-then-set overwrite
+        emulation, whose missing-key window lets a concurrent reader
+        observe epoch 0 and adopt a long-stale early view.  Discovery
+        probes upward from the instance's cached last-known epoch —
+        monotone, so it can never regress through any write gap."""
+        e = self._epoch_cache
+        while self._try_get(f"{self._base}/epochs/{e + 1}") is not None:
+            e += 1
+        self._epoch_cache = e
+        return e
+
+    def bootstrap_view(self):
+        """Epoch-0 view: every boot-time controller rank (the world
+        before any elasticity event)."""
+        return MembershipView(0, range(self.world))
+
+    def current_view(self):
+        """The newest decided view, or the bootstrap view when no
+        decision has been published yet."""
+        epoch = self.current_epoch()
+        if epoch == 0:
+            return self.bootstrap_view()
+        view = self._read_view(epoch)
+        return view if view is not None else self.bootstrap_view()
+
+    def _read_view(self, epoch):
+        raw = self._try_get(f"{self._base}/e{epoch}/view")
+        if raw is None:
+            return None
+        try:
+            members = [int(tok) for tok in str(raw).split(",") if tok != ""]
+        except ValueError:
+            return None
+        return MembershipView(epoch, members)
+
+    # -- announcements (generation-keyed intents) ---------------------------
+    def announce_leave(self, note=""):
+        """Post this rank's departure (non-blocking, best-effort): the
+        next resolve excludes it without waiting out a timeout.  A
+        standing join intent is retracted."""
+        self._delete(f"{self._base}/join/{self.rank}")
+        self._set(f"{self._base}/leave/{self.rank}",
+                  f"{self.current_epoch()}:{note}")
+
+    def announce_join(self, note=""):
+        """Post this rank's wish to (re-)enter: survivors' join polls
+        see it and initiate a grow resolve.  Retracts any standing
+        leave (the spot host came back)."""
+        self._delete(f"{self._base}/leave/{self.rank}")
+        self._set(f"{self._base}/join/{self.rank}",
+                  f"{self.current_epoch()}:{note}")
+
+    def pending_joins(self, view=None):
+        """Ranks with a standing join announcement that are NOT in the
+        (given or current) view — the survivors' per-iteration poll."""
+        view = view if view is not None else self.current_view()
+        joins = self._scan(f"{self._base}/join", range(self.world))
+        return tuple(r for r in sorted(joins) if r not in view)
+
+    # -- consensus -----------------------------------------------------------
+    def resolve(self, expect=None, require=None, timeout_ms=None):
+        """Agree on the next epoch's member set; returns the decided
+        :class:`MembershipView` (which may EXCLUDE this rank — see the
+        module docstring's split-brain note).
+
+        ``expect``: ranks the caller believes alive; the leader decides
+        as soon as all of them are present (fast path), or once the
+        candidate set has settled for ``settle_s`` (the typed per-peer
+        timeout path that drops unresponsive ranks).
+
+        ``require``: ranks that MUST be present before this caller may
+        publish ANY decision — the settle path cannot drop them.  A
+        JOINER passes the current survivors here: without it, a joiner
+        whose resolve never overlaps the survivors' would settle alone
+        and decide a second, disjoint world.  Unsatisfiable ``require``
+        ends in the typed timeout, never a wrong view.
+
+        Raises :class:`ChannelTimeoutError` when no view lands within
+        the deadline."""
+        self.stats["resolves"] += 1
+        timeout_ms = self.timeout_ms if timeout_ms is None else timeout_ms
+        epoch = self.current_epoch() + 1
+        prefix = f"{self._base}/e{epoch}"
+        deadline = self._clock() + timeout_ms / 1000.0
+        beat = 0
+        seen = {}  # rank -> (token, observer-local last-change time)
+        prev_candidates = None
+        last_change = self._clock()
+        while True:
+            decided = self._read_view(epoch)
+            if decided is not None:
+                self.stats["adopted"] += 1
+                return decided
+            if self._clock() >= deadline:
+                raise ChannelTimeoutError("membership.resolve",
+                                          f"{prefix}/view", timeout_ms,
+                                          beat)
+            beat += 1
+            self._set(f"{prefix}/present/{self.rank}", str(beat))
+            present = self._scan(f"{prefix}/present", range(self.world))
+            leaves = self._scan(f"{self._base}/leave", range(self.world))
+            candidates = []
+            for r, tok in sorted(present.items()):
+                if r in leaves:
+                    continue  # announced departure: never a candidate
+                prev = seen.get(r)
+                now = self._clock()
+                if prev is None:
+                    seen[r] = (tok, now, 0)
+                elif prev[0] != tok:
+                    seen[r] = (tok, now, prev[2] + 1)
+                elif r != self.rank and now - prev[1] > self.stall_s:
+                    continue  # beat frozen mid-resolve: presumed dead
+                candidates.append(r)
+            cand = tuple(sorted(candidates))
+            if cand != prev_candidates:
+                prev_candidates = cand
+                last_change = self._clock()
+            complete = expect is not None \
+                and set(int(e) for e in expect) <= set(cand)
+            settled = self._clock() - last_change >= self.settle_s
+            required_ok = require is None \
+                or set(int(r) for r in require) <= set(cand)
+            if cand and cand[0] == self.rank and required_ok \
+                    and (complete or settled):
+                if not complete:
+                    # settle-path zombie screen: a presence key whose
+                    # token NEVER changed during this resolve is a
+                    # leftover from a dead rank's earlier attempt (live
+                    # candidates rebeat every poll loop) — deciding it
+                    # into the view would seed the next failure
+                    cand = tuple(r for r in cand
+                                 if r == self.rank or seen[r][2] >= 1)
+                if cand and cand[0] == self.rank:
+                    view = MembershipView(epoch, cand)
+                    self._publish(view)
+                    self.stats["led"] += 1
+                    return view
+            self._sleep(self.poll_s)
+
+    def _publish(self, view):
+        """Leader-side decision write: the view key first, then the
+        epoch's append-only marker (a reader that discovers the new
+        epoch always finds its view), then the consumed join/leave
+        intents are scrubbed (admitted ranks' joins, departed ranks'
+        leaves)."""
+        prefix = f"{self._base}/e{view.epoch}"
+        self._set(f"{prefix}/view", ",".join(str(m) for m in view.members))
+        self._set(f"{self._base}/epochs/{view.epoch}", "1")
+        for r in view.members:
+            self._delete(f"{self._base}/join/{r}")
+        for r in range(self.world):
+            if r not in view:
+                self._delete(f"{self._base}/leave/{r}")
+        # presence keys of PAST epochs are dead weight: scrub the
+        # previous epoch's (best-effort; the current epoch's stay for
+        # late adopters still polling them)
+        for r in range(self.world):
+            self._delete(f"{self._base}/e{view.epoch - 1}/present/{r}")
+
+    def __repr__(self):
+        return (f"<ElasticMembership rank={self.rank} world={self.world} "
+                f"epoch={self.current_epoch()}>")

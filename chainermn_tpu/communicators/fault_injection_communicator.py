@@ -71,6 +71,13 @@ class FaultInjectionCommunicator(CommunicatorBase):
             schedule = FaultSchedule.from_dict(schedule)
         self.base = base
         self.schedule = schedule
+        if schedule.rank is None:
+            # rank-restricted specs (the elastic preempt shape) address
+            # communicator ranks; bind the wrapped communicator's rank
+            # so the shared schedule fires only on its target.  An
+            # explicit pre-bound rank wins (tests drive several ranks'
+            # schedules from one process).
+            schedule.bind_rank(getattr(base, "rank", None))
         self.hc_schedule = None  # transport-layer clone (factory-bound)
         self._sleep = sleep
         self.injected = 0
@@ -91,7 +98,9 @@ class FaultInjectionCommunicator(CommunicatorBase):
                 return True, first_arg
             if op in _DROP_LOSES_MESSAGE:
                 return True, None
-        # raise, drop-without-a-well-defined-silent-result, and the
+        # raise, preempt (a typed RankPreempted — the elastic
+        # supervisor's leave cue, hard fail-stop otherwise),
+        # drop-without-a-well-defined-silent-result, and the
         # transport-flavored actions (lost_chunk/stale_key only have
         # meaning inside the host channel — bind_host_channel) all
         # surface as the injected exception
@@ -179,6 +188,11 @@ def bind_host_channel(channel, schedule, sleep=time.sleep):
                    retry (ctx supplies the key and the client).
     ``stale_key``  corrupt the meta key so the reader sees a stale/
                    malformed entry (exercises key-cleanup paths).
+    ``preempt``    raise :class:`RankPreempted` at the hook site.  The
+                   channel's retry loop treats it as NON-transient (a
+                   reclaimed host cannot come back within a backoff),
+                   so it surfaces immediately instead of burning the
+                   retry budget.
     """
     if isinstance(schedule, dict):
         schedule = FaultSchedule.from_dict(schedule)

@@ -21,6 +21,12 @@ Fault actions
            silent result (scatter/gather/recv…) degrade to ``raise``.
 ``delay``  sleep ``delay_s`` before executing — models stragglers and
            exercises deadline/backoff paths without a real slow host.
+``preempt`` raise :class:`RankPreempted` — the rank exits hard at this
+           call (spot/preemptible capacity reclaiming the host, ISSUE
+           10).  Unhandled, the exception fail-stops the process like
+           any crash; under :class:`~..extensions.ElasticRecovery` the
+           rank announces ``leave`` and the survivors shrink the
+           communicator instead (``docs/resilience.md`` §7).
 
 Spec matching
 -------------
@@ -29,7 +35,12 @@ on the ``nth`` call of that op (1-based, counted per schedule instance)
 or probabilistically with ``prob`` drawn from the schedule's seeded RNG —
 one shared stream, consumed in op-call order, so probabilistic schedules
 replay deterministically too.  ``count`` bounds how many times a spec
-fires (default 1; ``None`` = unbounded).
+fires (default 1; ``None`` = unbounded).  ``rank`` restricts a spec to
+ONE rank of a shared schedule (the elastic chaos shape: every process
+builds the same schedule, only the targeted rank is preempted).  Rank
+filtering happens *after* the probabilistic draw, so a rank-restricted
+spec consumes identical RNG stream positions on every rank — the
+cross-rank replay property survives targeting.
 
 Host-channel ops are namespaced ``hc.<op>`` (``hc.put``, ``hc.get``,
 ``hc.barrier``, ``hc.chunk``) and carry transport-flavored actions
@@ -46,9 +57,10 @@ import json
 import os
 import random
 
-__all__ = ["InjectedFault", "FaultSpec", "FaultSchedule", "schedule_from_env"]
+__all__ = ["InjectedFault", "RankPreempted", "FaultSpec", "FaultSchedule",
+           "schedule_from_env"]
 
-_ACTIONS = ("raise", "drop", "delay", "lost_chunk", "stale_key")
+_ACTIONS = ("raise", "drop", "delay", "lost_chunk", "stale_key", "preempt")
 
 
 class InjectedFault(RuntimeError):
@@ -62,11 +74,31 @@ class InjectedFault(RuntimeError):
             + (f" ({note})" if note else ""))
 
 
+class RankPreempted(RuntimeError):
+    """This rank's capacity was reclaimed (the ``preempt`` action).
+
+    Deliberately NOT an :class:`InjectedFault` subclass: the fixed-size
+    :class:`~..extensions.FailureRecovery` must fail-stop on it (an
+    in-place retry cannot bring back a reclaimed host), while
+    :class:`~..extensions.ElasticRecovery` treats it as this rank's cue
+    to leave the membership.  Carries the op, call index, and the
+    targeted rank (``None`` when the spec was rank-unrestricted)."""
+
+    def __init__(self, op, call_index, rank=None, note=""):
+        self.op = op
+        self.call_index = call_index
+        self.rank = rank
+        super().__init__(
+            f"rank{'' if rank is None else f' {rank}'} preempted at "
+            f"{op!r} call #{call_index}"
+            + (f" ({note})" if note else ""))
+
+
 class FaultSpec:
     """One declarative fault: *when* (op + nth/prob) and *what* (action)."""
 
     def __init__(self, op, action="raise", nth=None, prob=None,
-                 delay_s=0.0, exc=None, count=1, note=""):
+                 delay_s=0.0, exc=None, count=1, note="", rank=None):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; "
                              f"choose from {_ACTIONS}")
@@ -74,6 +106,9 @@ class FaultSpec:
             raise ValueError("exactly one of nth=/prob= must be given")
         if nth is not None and nth < 1:
             raise ValueError("nth is 1-based (first call is nth=1)")
+        if rank is not None and int(rank) < 0:
+            raise ValueError(f"rank must be a non-negative rank id, "
+                             f"got {rank}")
         self.op = op
         self.action = action
         self.nth = nth
@@ -82,6 +117,7 @@ class FaultSpec:
         self.exc = exc
         self.count = count  # None = unbounded
         self.note = note
+        self.rank = None if rank is None else int(rank)
         self.fired = 0
 
     def to_dict(self):
@@ -96,6 +132,8 @@ class FaultSpec:
             d["count"] = self.count
         if self.note:
             d["note"] = self.note
+        if self.rank is not None:
+            d["rank"] = self.rank
         return d
 
     def __repr__(self):
@@ -112,6 +150,12 @@ class _Fault:
         self.call_index = call_index
 
     def make_exception(self):
+        if self.action == "preempt":
+            # the preempt action owns its exception type: a caller-
+            # supplied exc= would hide the RankPreempted contract the
+            # elastic supervisor dispatches on
+            return RankPreempted(self.op, self.call_index,
+                                 rank=self.spec.rank, note=self.spec.note)
         if self.spec.exc is not None:
             return self.spec.exc(
                 f"injected fault at {self.op!r} call #{self.call_index}")
@@ -127,13 +171,27 @@ class FaultSchedule:
     tests compare.
     """
 
-    def __init__(self, specs=(), seed=0):
+    def __init__(self, specs=(), seed=0, rank=None):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         self.specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
                       for s in specs]
         self._counters = {}
         self.fired = []
+        # the rank this schedule instance is driving (bound by the
+        # fault-injection communicator at wrap time; settable up front
+        # for host-channel-only schedules).  None = unbound: rank-
+        # restricted specs never fire, rank-free specs always can.
+        self.rank = None if rank is None else int(rank)
+
+    def bind_rank(self, rank):
+        """Bind the schedule to the rank it is driving — rank-restricted
+        specs (``FaultSpec(rank=k)``) only fire on the bound rank.  The
+        RNG stream is unaffected (rank filtering happens after the
+        draw), so bound and unbound instances of the same schedule stay
+        call-site-aligned."""
+        self.rank = None if rank is None else int(rank)
+        return self
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -174,6 +232,12 @@ class FaultSchedule:
                 matched = (n == spec.nth)
             else:
                 matched = (self._rng.random() < spec.prob)
+            if matched and spec.rank is not None \
+                    and spec.rank != self.rank:
+                # targeted at another rank (or unbound schedule): the
+                # draw above is already consumed, so every rank's
+                # stream stays aligned — the spec just doesn't fire here
+                matched = False
             if matched and hit is None:
                 spec.fired += 1
                 hit = _Fault(spec, op, n)

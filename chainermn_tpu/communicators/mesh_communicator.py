@@ -55,7 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .communicator_base import CommunicatorBase
 
-__all__ = ["MeshCommunicator"]
+__all__ = ["MeshCommunicator", "ElasticMeshCommunicator"]
 
 
 def _is_traced(*xs):
@@ -1235,3 +1235,141 @@ class MeshCommunicator(CommunicatorBase):
                 f"eager {what} expects a stacked array with leading axis "
                 f"size={self.size} (one slice per rank); got shape {x.shape}. "
                 f"Inside compiled steps (run_spmd) pass the rank-local value.")
+
+
+class ElasticMeshCommunicator(MeshCommunicator):
+    """A :class:`MeshCommunicator` over the LIVE subset of controller
+    processes (ISSUE 10 — the rebuilt transport after an elastic
+    shrink/grow).
+
+    ``members`` are GLOBAL controller ranks (the stable process
+    identities membership decides over); the communicator maps them to
+    dense slots 0..n-1 for collective addressing — ``rank`` /
+    ``inter_rank`` are the SLOT, ``stable_rank`` keeps the global
+    identity (checkpoint filenames key off it, so a process re-reads
+    its OWN snapshots across any number of resizes).  ``epoch`` is the
+    membership epoch the member set was decided at; the mesh axis name
+    and the object-channel namespace are both epoch-suffixed, so a
+    rebuilt incarnation can never match a dead one's compiled programs
+    or stranded KV keys.
+
+    Construction is COLLECTIVE over the members (every live member
+    builds the communicator for the same view, lock-step — the elastic
+    supervisor's rebuild step guarantees this); a dead peer is, by
+    definition of the view, not required.
+
+    ``channel`` (optional): the previous incarnation's
+    :class:`~._host_channel.HostChannel`, donated as a template — its
+    client and timeout/retry knobs carry over to the members-only
+    sub-channel.  ``devices``: explicit device list override (the
+    single-controller simulated-elasticity knob tier-1 uses — shrink a
+    world of local devices without any real process leaving).
+    """
+
+    def __init__(self, members, epoch=0, channel=None, devices=None,
+                 axis_name=None, **kwargs):
+        members = tuple(sorted(int(m) for m in members))
+        if not members:
+            raise ValueError("an elastic communicator needs >= 1 member")
+        self.members = members
+        self.epoch = int(epoch)
+        me = jax.process_index()
+        if jax.process_count() > 1 and me not in members:
+            raise ValueError(
+                f"process {me} is not in the elastic view {members}; "
+                f"non-members must re-join through the membership "
+                f"protocol before constructing the communicator")
+        self._member_slot = members.index(me) if me in members else 0
+        self._stable_rank = me
+        # the members-only object channel must exist BEFORE the base
+        # constructor runs (its intra-topology allgather is the first
+        # collective of the new incarnation)
+        self._elastic_channel = self._derive_channel(channel)
+        if devices is None:
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(getattr(d, "process_index", 0),
+                                   []).append(d)
+            devices = [d for m in members
+                       for d in sorted(by_proc.get(m, ()),
+                                       key=lambda d: d.id)]
+            if not devices:
+                raise ValueError(
+                    f"no devices owned by members {members}")
+        if axis_name is None:
+            axis_name = f"elastic_e{self.epoch}"
+        super().__init__(devices=devices, axis_name=axis_name, **kwargs)
+
+    def _derive_channel(self, template):
+        """Members-only sub-channel: same client and tolerance knobs as
+        the template, namespace scoped by membership epoch (keys of any
+        other incarnation can never match), process ids remapped to the
+        view's dense slots."""
+        from ._host_channel import HostChannel, get_host_channel
+        if template is None:
+            template = get_host_channel()
+        if template is None or len(self.members) <= 1:
+            # single live controller (or no coordination service): the
+            # object channel degenerates to loopback like any
+            # single-process run
+            return None
+        ns_root = template._ns.split("/el", 1)[0]
+        return HostChannel(
+            namespace=f"{ns_root}/el{self.epoch}",
+            client=template._client,
+            chunk_bytes=template._chunk,
+            timeout_ms=template._timeout_ms,
+            op_timeouts=dict(template._op_timeouts),
+            max_retries=template.max_retries,
+            backoff_base_s=template.backoff_base_s,
+            backoff_max_s=template.backoff_max_s,
+            clock=template._clock, sleep=template._sleep,
+            process_id=self._member_slot,
+            num_processes=len(self.members))
+
+    def _host_channel(self):
+        return self._elastic_channel
+
+    # -- topology: slots for collectives, stable ids for identity ----------
+    @property
+    def rank(self):
+        return self._member_slot
+
+    @property
+    def inter_rank(self):
+        return self._member_slot
+
+    @property
+    def inter_size(self):
+        return len(self.members)
+
+    @property
+    def stable_rank(self):
+        """This process's GLOBAL controller rank — invariant across
+        resizes (snapshot filenames and membership announcements key
+        off it, never off the per-view slot)."""
+        return self._stable_rank
+
+    def _local_device_counts(self):
+        # base indexes by jax process id over process_count slots; the
+        # elastic view has len(members) slots keyed by member order
+        slot = {m: i for i, m in enumerate(self.members)}
+        counts = [0] * len(self.members)
+        for d in self._devices:
+            counts[slot[getattr(d, "process_index", 0)]] += 1
+        return counts
+
+    def _process_allgather_pickled(self, obj):
+        # NEVER fall back to multihost_utils.process_allgather: that
+        # path spans every BOOT process, and an elastic world exists
+        # precisely because some of them are gone — the fallback would
+        # hang on the dead peers.  Members-only channel, or loopback.
+        ch = self._host_channel()
+        if ch is not None:
+            return ch.allgather(obj)
+        return [obj]
+
+    def __repr__(self):
+        return (f"<ElasticMeshCommunicator epoch={self.epoch} "
+                f"members={self.members} size={self.size} "
+                f"axis={self.axis_name!r}>")

@@ -17,8 +17,8 @@ import numpy as np
 
 from .dataset.datasets import SubDataset
 
-__all__ = ["scatter_dataset", "create_empty_dataset", "scatter_index",
-           "get_n_iterations_for_one_epoch"]
+__all__ = ["scatter_dataset", "rescatter_dataset", "create_empty_dataset",
+           "scatter_index", "get_n_iterations_for_one_epoch"]
 
 
 def scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None,
@@ -73,6 +73,51 @@ def scatter_dataset(dataset, comm, root=0, shuffle=False, seed=None,
     per_host = total // n_hosts
     start, finish = host * per_host, (host + 1) * per_host
     return SubDataset(dataset, start, finish, order=order)
+
+
+def rescatter_dataset(shard, comm):
+    """Deterministically re-slice an already-scattered shard for a
+    RESIZED communicator (elastic shrink/grow, ISSUE 10).
+
+    ``shard`` is a :class:`SubDataset` a previous ``scatter_dataset``
+    produced (its ``order`` is the seeded permutation every member
+    agreed on); ``comm`` is the REBUILT communicator.  The SAME order
+    is re-padded by wrap-around to the new ``comm.size`` multiple and
+    re-sliced contiguously over the new ``comm.inter_size`` hosts — a
+    pure function of (order, new topology), so every surviving member
+    computes the identical partition with no collective, and the union
+    of the new shards equals the union of the old ones: within an
+    epoch no sample is dropped, and none is counted twice beyond the
+    equal-length wrap-around padding ``scatter_dataset`` itself
+    documents.  Iterator position (which samples of the epoch are
+    already consumed) is trainer state and rides the checkpoint, not
+    this function.
+    """
+    if not isinstance(shard, SubDataset):
+        raise TypeError(
+            f"rescatter_dataset re-slices a SubDataset produced by "
+            f"scatter_dataset, got {type(shard).__name__}; for a raw "
+            f"dataset call scatter_dataset with the same seed instead")
+    base = shard._dataset
+    order = shard._order
+    n = len(base) if order is None else len(np.unique(order))
+    if order is not None:
+        # strip the previous wrap-around padding: the agreed permutation
+        # is the first n entries (scatter_dataset appends the pad AFTER
+        # the permutation)
+        order = np.asarray(order)[:n]
+    else:
+        order = np.arange(n)
+    size = comm.size
+    per_rank = -(-n // size)
+    total = per_rank * size
+    if total > n:
+        order = np.concatenate([order, order[: total - n]])
+    n_hosts = max(comm.inter_size, 1)
+    host = comm.inter_rank
+    per_host = total // n_hosts
+    start, finish = host * per_host, (host + 1) * per_host
+    return SubDataset(base, start, finish, order=order)
 
 
 def scatter_index(n_total, comm, root=0):

@@ -1,6 +1,8 @@
 """Distributed trainer extensions (reference: ``chainermn.extensions``)."""
 
 from .checkpoint import create_multi_node_checkpointer, _MultiNodeCheckpointer
+from .elastic import (ElasticConfigError, ElasticRecovery,
+                      create_elastic_membership, global_batch_plan)
 from .failure_recovery import FailureRecovery, RecoveryGivingUp
 from .observation_aggregator import ObservationAggregator
 
@@ -15,6 +17,8 @@ except Exception:  # pragma: no cover - orbax optional
 
 __all__ = ["create_multi_node_checkpointer", "_MultiNodeCheckpointer",
            "FailureRecovery", "RecoveryGivingUp",
+           "ElasticRecovery", "ElasticConfigError",
+           "create_elastic_membership", "global_batch_plan",
            "ObservationAggregator", "OrbaxCheckpointer",
            "create_multi_node_orbax_checkpointer",
            "_MultiNodeOrbaxCheckpointer"]

@@ -90,7 +90,11 @@ class _MultiNodeCheckpointer(Extension):
 
     @property
     def rank(self):
-        return self.comm.inter_rank
+        # prefer the communicator's STABLE process identity (elastic
+        # communicators keep it invariant across resizes, ISSUE 10) so
+        # a process always re-reads its OWN snapshots — the per-view
+        # slot would silently re-key files after a shrink/grow
+        return getattr(self.comm, "stable_rank", self.comm.inter_rank)
 
     def _dir(self, trainer=None):
         if self.path is not None:

@@ -1,0 +1,604 @@
+"""Elastic shrink/grow — training that survives preemptible capacity.
+
+ISSUE 10 (ROADMAP item 5): :class:`~.failure_recovery.FailureRecovery`
+recovers faults at a FIXED world size — a lost peer fail-stops the job.
+On spot/preemptible capacity the production event is a rank LEAVING
+(host reclaimed) and later a rank JOINING (replacement capacity), and
+the collective schedule here is a *pure re-plannable function of the
+topology* (``plan_buckets`` / ``hop_schedule`` / ``flat_chunk_spec``):
+a changed world size means re-planning, never restarting from scratch.
+
+:class:`ElasticRecovery` extends the supervisor with three moves, all
+built on the membership protocol
+(:class:`~..communicators.ElasticMembership`):
+
+* **shrink** — a survivor's typed failure (channel timeout, lost-peer
+  heartbeat, injected fault) triggers a membership resolve with typed
+  timeouts for unresponsive peers; the survivors rebuild the
+  communicator over the decided member set
+  (:class:`~..communicators.ElasticMeshCommunicator`), re-plan every
+  size-dependent structure through
+  ``optimizer.change_communicator`` (bucket plans, ZeRO
+  ``flat_chunk_spec`` chunking; stale-grad and error-feedback buffers
+  re-seed zeros — the documented size-changed contract), converge on
+  the checkpointer's consensus snapshot, and keep training.
+* **leave** — the preempted rank (:class:`RankPreempted` from the
+  fault schedule, or the real scheduler's signal) announces a
+  generation-keyed ``leave`` so survivors never burn the full timeout
+  on it, then either fail-stops (production default: the scheduler
+  restarts the process) or parks and re-joins (``rejoin_after_s`` —
+  the chaos harness's preempt-and-return shape).
+* **grow** — survivors poll join announcements at iteration
+  boundaries (a lock-step object-channel broadcast, so every survivor
+  enters the resize at the same call site), admit the joiner through
+  the same resolve, rebuild at the larger size, ship the newest
+  snapshot to the joiner over the new channel, and consensus-load so
+  every member — including the one that just arrived with stale
+  state — resumes bit-exact from the same generation.
+
+Global batch across resizes: the repo's batch convention already makes
+``"rescale"`` free — ``update()`` receives the GLOBAL batch and the
+``shard_map`` in_spec splits it over however many ranks exist, so the
+gradient stays the full-batch mean at any world size (convergence
+parity, not bit-exactness: reduction order changes).
+:func:`global_batch_plan` computes the policy table (per-rank rescale
+vs gradient accumulation) and :meth:`ElasticRecovery._check_batch`
+validates divisibility at resize time, failing with the plan attached
+instead of a shape error inside the first resized step.
+
+Detection caveat (same lock-step discipline as the fixed-size
+supervisor): a departed rank is detected at the CONTROL PLANE — a
+host-channel op's typed timeout, a heartbeat, an announced leave —
+between steps.  Keep a per-iteration channel op in the loop (the
+multi-node iterator's batch broadcast, a beacon, or the checkpoint
+trigger); a rank lost while a peer is already blocked inside a
+compiled data-plane collective surfaces through the runtime's own
+error instead, and recovery proceeds from there.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import time
+
+import numpy as np
+
+from ..communicators._host_channel import ChannelError
+from ..communicators._membership import ElasticMembership
+from ..communicators.fault_schedule import InjectedFault, RankPreempted
+from ..communicators.mesh_communicator import ElasticMeshCommunicator
+from .failure_recovery import FailureRecovery, RecoveryGivingUp
+
+__all__ = ["ElasticRecovery", "global_batch_plan", "ElasticConfigError",
+           "create_elastic_membership"]
+
+_ELASTIC_RECOVERABLE = (InjectedFault, ChannelError, RankPreempted)
+
+
+class ElasticConfigError(RuntimeError):
+    """A resize produced a configuration the run cannot satisfy (e.g.
+    the global batch does not divide over the new world and the policy
+    forbids accumulation).  Carries the computed ``plan``."""
+
+    def __init__(self, message, plan=None):
+        self.plan = plan
+        super().__init__(message)
+
+
+def global_batch_plan(global_bs, world_size, policy="rescale",
+                      max_per_rank=None):
+    """The global-batch preservation table (``docs/resilience.md`` §7):
+    how one logical step of ``global_bs`` samples is fed to a world of
+    ``world_size`` ranks.
+
+    Returns ``{"policy", "global_bs", "world_size", "dispatch_bs",
+    "per_rank_bs", "accum_steps"}`` where one logical step =
+    ``accum_steps`` dispatches of ``dispatch_bs`` samples
+    (``dispatch_bs × accum_steps == global_bs``), each dispatch
+    sharding ``per_rank_bs = dispatch_bs / world_size`` per rank.
+
+    * ``"rescale"`` (default): one dispatch of the full global batch —
+      the per-rank share rescales implicitly through the shard_map
+      in_spec.  Requires ``global_bs % world_size == 0`` and (when
+      given) ``per_rank_bs <= max_per_rank``; otherwise falls through
+      to the accumulation search so the caller still gets a feasible
+      plan to act on (or reject).
+    * ``"accumulate"``: the smallest ``accum_steps`` dividing
+      ``global_bs`` whose dispatch batch divides over the world (and
+      fits ``max_per_rank``) — per-rank memory stays bounded on a
+      shrink at the cost of extra dispatches.
+
+    Pure function — every member computes the identical plan from the
+    identical (global_bs, world_size) pair.
+    """
+    if policy not in ("rescale", "accumulate"):
+        raise ValueError(f"unknown global-batch policy {policy!r} "
+                         f"(rescale|accumulate)")
+    global_bs = int(global_bs)
+    world_size = int(world_size)
+    if global_bs < 1 or world_size < 1:
+        raise ValueError(f"global_bs={global_bs}/world_size={world_size} "
+                         f"must be >= 1")
+
+    def fits(dispatch):
+        per = dispatch // world_size
+        return dispatch % world_size == 0 and per >= 1 \
+            and (max_per_rank is None or per <= max_per_rank)
+
+    if policy == "rescale" and fits(global_bs):
+        return {"policy": "rescale", "global_bs": global_bs,
+                "world_size": world_size, "dispatch_bs": global_bs,
+                "per_rank_bs": global_bs // world_size, "accum_steps": 1}
+    for k in range(1 if policy == "accumulate" else 2, global_bs + 1):
+        if global_bs % k:
+            continue
+        dispatch = global_bs // k
+        if fits(dispatch):
+            return {"policy": "accumulate", "global_bs": global_bs,
+                    "world_size": world_size, "dispatch_bs": dispatch,
+                    "per_rank_bs": dispatch // world_size,
+                    "accum_steps": k}
+    raise ElasticConfigError(
+        f"no feasible batch plan: global_bs={global_bs} cannot be "
+        f"preserved over world_size={world_size}"
+        + (f" within max_per_rank={max_per_rank}" if max_per_rank
+           else ""),
+        plan=None)
+
+
+def create_elastic_membership(comm, **kwargs):
+    """An :class:`ElasticMembership` bound to this process, over the
+    communicator's coordination-service client.  Returns ``None`` when
+    no cross-process channel exists (single-controller runs inject a
+    scripted membership in tests, or run without elasticity)."""
+    ch = comm._host_channel() if hasattr(comm, "_host_channel") else None
+    if ch is None:
+        return None
+    import jax
+    kwargs.setdefault("namespace", ch._ns.split("/el", 1)[0])
+    return ElasticMembership(ch._client, rank=jax.process_index(),
+                             world=jax.process_count(), **kwargs)
+
+
+class ElasticRecovery(FailureRecovery):
+    """The elastic supervisor extension (see module docstring).
+
+    Beyond :class:`FailureRecovery`'s arguments:
+
+    ``membership``: an :class:`ElasticMembership` (default: built from
+    the communicator's coordination client; ``None`` on single-process
+    runs — elasticity then requires an injected membership).
+    ``comm_factory``: ``factory(view) -> communicator`` called
+    lock-step by every member of a decided view (default:
+    :class:`ElasticMeshCommunicator` over the view's members,
+    inheriting the boot communicator's exchange knobs and re-forcing
+    its ici×dcn split when one existed and still divides).
+    ``min_world``: shrink floor — a view smaller than this raises
+    :class:`RecoveryGivingUp` (with the view in the message) instead
+    of limping on.
+    ``rejoin_after_s``: preempted-rank behavior — ``None`` (default)
+    re-raises and fail-stops (the production scheduler restarts the
+    process); a number parks that long, announces ``join``, and waits
+    for re-admission (the chaos harness's preempt-and-return).
+    ``batch_policy``/``max_per_rank_bs``: the global-batch
+    preservation policy validated at each resize
+    (:func:`global_batch_plan`).
+    ``join_poll_interval``: iterations between the survivors'
+    lock-step join polls (one object-channel broadcast each).
+    """
+
+    priority = 100
+    name = "ElasticRecovery"
+
+    def __init__(self, checkpointer=None, comm=None, membership=None,
+                 comm_factory=None, min_world=1, rejoin_after_s=None,
+                 batch_policy="rescale", max_per_rank_bs=None,
+                 join_poll_interval=1, recoverable=None,
+                 unrecoverable=None, max_recoveries=3, cooldown_s=0.0,
+                 sleep=time.sleep, on_recover=None, on_resize=None,
+                 verbose=True, resolve_timeout_ms=None):
+        super().__init__(checkpointer=checkpointer, comm=comm,
+                         recoverable=(tuple(recoverable)
+                                      if recoverable is not None
+                                      else _ELASTIC_RECOVERABLE),
+                         # a lost peer is exactly what elasticity
+                         # recovers — nothing is unrecoverable by
+                         # default here
+                         unrecoverable=(tuple(unrecoverable)
+                                        if unrecoverable is not None
+                                        else ()),
+                         max_recoveries=max_recoveries,
+                         cooldown_s=cooldown_s, sleep=sleep,
+                         on_recover=on_recover, verbose=verbose)
+        if membership is None and self.comm is not None:
+            membership = create_elastic_membership(self.comm)
+        self.membership = membership
+        self._boot_comm = self.comm
+        self._boot_channel = (self.comm._host_channel()
+                              if self.comm is not None
+                              and hasattr(self.comm, "_host_channel")
+                              else None)
+        self.comm_factory = comm_factory
+        self.min_world = int(min_world)
+        self.rejoin_after_s = rejoin_after_s
+        self.batch_policy = batch_policy
+        self.max_per_rank_bs = max_per_rank_bs
+        self.on_resize = on_resize
+        self.resolve_timeout_ms = resolve_timeout_ms
+        self.view = membership.current_view() if membership is not None \
+            else None
+        self.trigger = (int(join_poll_interval), "iteration")
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def stable_rank(self):
+        """This process's global controller rank (membership identity)."""
+        if self.membership is not None:
+            return self.membership.rank
+        return getattr(self.comm, "stable_rank",
+                       getattr(self.comm, "rank", 0))
+
+    def _log(self, msg):
+        if self.verbose:
+            print(f"chainermn_tpu elastic[r{self.stable_rank}]: {msg}",
+                  file=sys.stderr)
+
+    # -- per-iteration join poll (the grow trigger) -------------------------
+    def __call__(self, trainer):
+        if self.membership is None or self.view is None:
+            return
+        # lock-step poll: slot 0 reads the KV store, the result is
+        # broadcast over the members' object channel so every survivor
+        # enters (or skips) the resize at the same call site — two
+        # survivors seeing a join one iteration apart would otherwise
+        # split the resolve
+        mine = self.membership.pending_joins(self.view) \
+            if self.comm.inter_rank == 0 else None
+        joins = tuple(self.comm.bcast_obj(mine, root=0) or ())
+        if joins:
+            self._log(f"admitting joins {list(joins)} at iteration "
+                      f"{trainer.updater.iteration}")
+            self._resize(trainer,
+                         expect=set(self.view.members) | set(joins))
+
+    # -- supervisor protocol -------------------------------------------------
+    def recover(self, trainer, exc):
+        if self.membership is None:
+            # no membership protocol: elastic behavior is impossible;
+            # degrade to the fixed-size supervisor for in-place faults
+            # (RankPreempted then fail-stops through the type check
+            # below)
+            if isinstance(exc, RankPreempted):
+                raise exc
+            return super().recover(trainer, exc)
+        self._spend_recovery_budget(exc)
+        if self.cooldown_s:
+            self._sleep(self.cooldown_s)
+        if isinstance(exc, RankPreempted) and (
+                exc.rank is None or exc.rank == self.stable_rank):
+            return self._preempted(trainer, exc)
+        # survivor path: a typed failure that may mean lost peers —
+        # resolve the membership (unresponsive ranks time out of the
+        # view), rebuild, converge.  A fault with no casualties decides
+        # the SAME member set at a new epoch: the rebuild then doubles
+        # as the fixed-size quiesce.
+        self._log(f"recovering from {type(exc).__name__}: {exc} "
+                  f"(attempt {self.stats['recoveries']}"
+                  f"/{self.max_recoveries})")
+        self._quiesce_transport()
+        suspects = set()
+        rank = getattr(exc, "rank", None)
+        if rank is not None and not isinstance(exc, InjectedFault):
+            rank = int(rank)
+            # channel-borne ranks (PeerLostError from the members-only
+            # sub-channel) are dense SLOTS of the current view, not
+            # global ids — translate, or a post-resize suspect would
+            # drop the wrong member from the fast path
+            members = getattr(self.comm, "members", None)
+            if members is not None and 0 <= rank < len(members):
+                rank = members[rank]
+            suspects.add(rank)
+        expect = set(self.view.members) - suspects
+        resumed = self._resize(trainer, expect=expect)
+        if self.on_recover is not None:
+            self.on_recover(trainer, exc, resumed)
+        return resumed
+
+    # -- the three moves -----------------------------------------------------
+    def _preempted(self, trainer, exc):
+        """This rank's capacity was reclaimed: announce the departure
+        (survivors then shrink without burning a timeout on us), then
+        fail-stop — or park and re-join when the harness asks for the
+        full preempt-and-return arc.
+
+        The park waits for the survivors' shrink decision (the epoch
+        advancing past the one we left at) BEFORE the ``rejoin_after_s``
+        dwell: a join announced while the departure is still being
+        resolved would collapse the shrink and the grow into one no-op
+        resolve — the world would never actually change size."""
+        epoch_at_leave = self.membership.current_epoch()
+        self.membership.announce_leave(note=str(exc))
+        self._log(f"preempted ({exc}); leave announced")
+        if self.rejoin_after_s is None:
+            raise exc  # hard exit: the scheduler owns the restart
+        timeout_ms = self.resolve_timeout_ms \
+            if self.resolve_timeout_ms is not None \
+            else self.membership.timeout_ms
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while self.membership.current_epoch() == epoch_at_leave \
+                and time.monotonic() < deadline:
+            self._sleep(self.membership.poll_s)
+        self._sleep(self.rejoin_after_s)
+        # two admission attempts: the first resolve can race a
+        # CONCURRENT survivors' resolve (another failure, or a join
+        # poll that predates our announce) and adopt a view deciding
+        # that event without us — the join intent is still standing, so
+        # one re-announce + resolve rides the survivors' next poll
+        # (the same exclusion retry _resize applies)
+        for attempt in range(2):
+            self.membership.announce_join(note="rejoin after preemption")
+            prev = self.membership.current_view()
+            self._log(f"re-joining (current view {list(prev.members)}, "
+                      f"attempt {attempt + 1})")
+            # require= the survivors: a joiner must never settle a
+            # world by itself (a resolve that cannot reach them times
+            # out typed)
+            view = self.membership.resolve(
+                expect=set(prev.members) | {self.stable_rank},
+                require=set(prev.members) - {self.stable_rank},
+                timeout_ms=self.resolve_timeout_ms)
+            if self.stable_rank in view:
+                break
+        if self.stable_rank not in view:
+            raise RecoveryGivingUp(
+                "re-join was not admitted", membership=view) from exc
+        return self._adopt(trainer, view, prev_view=prev)
+
+    def _resize(self, trainer, expect):
+        """Survivor-side resolve → rebuild → converge (both shrink and
+        grow ride this; the joiner enters at :meth:`_adopt` after its
+        own resolve returns the same view)."""
+        prev = self.view
+        view = self.membership.resolve(
+            expect=expect, timeout_ms=self.resolve_timeout_ms)
+        if self.stable_rank not in view:
+            # the split-brain escape: we were too slow and the leader
+            # settled without us — re-enter as a joiner rather than
+            # continuing a second, disjoint world
+            self._log(f"excluded from view {list(view.members)}")
+            if self.rejoin_after_s is None:
+                raise RecoveryGivingUp(
+                    "excluded from the decided membership view",
+                    membership=view)
+            self.membership.announce_join(note="excluded, re-joining")
+            view = self.membership.resolve(
+                expect=set(view.members) | {self.stable_rank},
+                require=set(view.members) - {self.stable_rank},
+                timeout_ms=self.resolve_timeout_ms)
+            if self.stable_rank not in view:
+                raise RecoveryGivingUp(
+                    "re-join after exclusion was not admitted",
+                    membership=view)
+        if view.size < self.min_world:
+            raise RecoveryGivingUp(
+                f"world shrank below min_world={self.min_world}",
+                membership=view)
+        return self._adopt(trainer, view, prev_view=prev)
+
+    def _adopt(self, trainer, view, prev_view):
+        """Lock-step across ``view.members``: rebuild the communicator,
+        re-plan all size-dependent state, sync the newest snapshot to
+        joiners, and converge everyone on it."""
+        lost = [r for r in prev_view.members if r not in view]
+        joined = [r for r in view.members if r not in prev_view]
+        self.last_view = view
+        self.view = view
+        new_comm = (self.comm_factory(view) if self.comm_factory
+                    is not None else self._default_factory(view))
+        self._check_batch(trainer, new_comm)
+        self._swap_communicator(trainer, new_comm)
+        self.stats["ranks_lost"] += len(lost)
+        self.stats["ranks_joined"] += len(joined)
+        if view.size != prev_view.size:
+            self.stats["resizes"] += 1
+        self._log(f"world e{view.epoch}: members {list(view.members)} "
+                  f"(lost {lost}, joined {joined}, size {prev_view.size}"
+                  f"->{view.size})")
+        resumed = None
+        if self.checkpointer is not None:
+            if joined:
+                self._sync_snapshot_to_joiners(trainer, joined)
+            resumed = self.checkpointer.maybe_load(trainer)
+        elif joined:
+            raise ElasticConfigError(
+                "growing the world needs a checkpointer: the joiner's "
+                "state must be adopted from the survivors' newest "
+                "snapshot (pass checkpointer= to ElasticRecovery)")
+        self.stats["resumed_iterations"].append(resumed)
+        self._log(f"converged -> iteration "
+                  f"{resumed if resumed is not None else '(live state)'}")
+        if self.on_resize is not None:
+            self.on_resize(trainer, view, resumed)
+        return resumed
+
+    # -- rebuild plumbing ----------------------------------------------------
+    def _default_factory(self, view):
+        """Members-only communicator inheriting the boot communicator's
+        exchange knobs.  A hierarchical boot split is RE-FORCED when the
+        per-group device count still divides the new world (the ici
+        size is a property of the hosts, which did not change) and
+        degrades to flat otherwise — with the per-hop dtype intent
+        collapsing onto the single hop exactly like the
+        ``CHAINERMN_TPU_HIERARCHY=flat`` hatch."""
+        old = self._boot_comm
+        kwargs = dict(batch_collectives=getattr(old, "batch_collectives",
+                                                True),
+                      bucket_mb=getattr(old, "bucket_mb", None),
+                      error_feedback=getattr(old, "error_feedback", True),
+                      channel=self._boot_channel)
+        grad_dtype = getattr(old, "allreduce_grad_dtype", None)
+        if getattr(old, "hierarchy", None) is not None:
+            import jax
+            n_devices = sum(
+                1 for d in jax.devices()
+                if getattr(d, "process_index", 0) in view.members)
+            intra = old.ici_size
+            dcn_dtype = old.dcn_grad_dtype
+            if n_devices % intra == 0 and n_devices // intra >= 1:
+                kwargs["intra_size"] = intra
+                kwargs["axis_name"] = (f"dcn_e{view.epoch}",
+                                       f"ici_e{view.epoch}")
+                kwargs["allreduce_grad_dtype"] = {
+                    "ici": grad_dtype, "dcn": dcn_dtype}
+            else:
+                # the dcn (slow-hop) intent wins on the one flat hop —
+                # never a silent drop to lossless
+                kwargs["allreduce_grad_dtype"] = dcn_dtype or grad_dtype
+        else:
+            kwargs["allreduce_grad_dtype"] = grad_dtype
+        return ElasticMeshCommunicator(view.members, epoch=view.epoch,
+                                       **kwargs)
+
+    def _swap_communicator(self, trainer, new_comm):
+        """Point every comm consumer at the rebuilt transport: the
+        supervisor itself, the checkpointer, every multi-node optimizer
+        (``change_communicator`` re-plans buckets/chunking and re-seeds
+        the stale/EF buffers), comm-holding iterators (the multi-node /
+        synchronized batch broadcasters — left on the boot comm their
+        every batch fetch would ride the dead world's channel), and the
+        model's replicated placement."""
+        self.comm = new_comm
+        if self.checkpointer is not None:
+            self.checkpointer.comm = new_comm
+        for it in (getattr(trainer.updater, "_iterators", None)
+                   or {}).values():
+            while it is not None:
+                if hasattr(it, "comm"):
+                    it.comm = new_comm
+                it = getattr(it, "actual_iterator", None)
+        for opt in trainer.updater.get_all_optimizers().values():
+            if hasattr(opt, "change_communicator"):
+                opt.change_communicator(
+                    new_comm, via_checkpoint=self.checkpointer is not None)
+            target = getattr(opt, "target", None)
+            if target is not None:
+                _rehome_model(target, new_comm)
+
+    def _check_batch(self, trainer, new_comm):
+        """Validate the global-batch policy against the new world BEFORE
+        the first resized step: a failure here carries the computed plan
+        instead of surfacing as a shape error inside shard_map."""
+        try:
+            it = trainer.updater.get_iterator("main")
+        except Exception:
+            return
+        # unwrap comm-holding broadcasters (_MultiNodeIterator /
+        # _SynchronizedIterator): batch_size and the scattered dataset
+        # live on the wrapped base iterator — skipping here would defer
+        # an indivisible batch to a shard_map shape error inside the
+        # first resized step, exactly what this hook pre-empts
+        while not hasattr(it, "batch_size") \
+                and getattr(it, "actual_iterator", None) is not None:
+            it = it.actual_iterator
+        global_bs = getattr(it, "batch_size", None)
+        if not global_bs:
+            return
+        plan = global_batch_plan(global_bs, new_comm.size,
+                                 policy=self.batch_policy,
+                                 max_per_rank=self.max_per_rank_bs)
+        if plan["accum_steps"] != 1:
+            raise ElasticConfigError(
+                f"global batch {global_bs} needs "
+                f"{plan['accum_steps']}-step gradient accumulation at "
+                f"world size {new_comm.size} "
+                f"(dispatch_bs={plan['dispatch_bs']}); plain updaters "
+                f"dispatch one global batch per step — re-shard the "
+                f"iterator or use an accumulation-aware updater "
+                f"(docs/resilience.md §7 policy table)", plan=plan)
+        # rescale: nothing to mutate — update() feeds the global batch
+        # and the new mesh's in_spec re-splits it.  A host-scattered
+        # dataset re-slices for the new topology at EVERY world size:
+        # a shrink to one controller must widen the survivor's shard to
+        # the full order (keeping the old partial shard would silently
+        # train on a fraction of the epoch — the union-preservation
+        # contract docs/resilience.md §7 commits).
+        from ..dataset.datasets import SubDataset
+        from ..datasets import rescatter_dataset
+        ds = getattr(it, "dataset", None)
+        if isinstance(ds, SubDataset) and hasattr(it, "reset"):
+            it.dataset = rescatter_dataset(ds, new_comm)
+            it.reset()
+
+    # -- snapshot shipping (the grow convergence) ---------------------------
+    def _sync_snapshot_to_joiners(self, trainer, joined):
+        """Survivors snapshot their CURRENT state; slot 0 ships its file
+        to the joiners over the new members-only channel; joiners adopt
+        the bytes under their OWN stable-rank filename (+ checksum
+        sidecar).  The consensus ``maybe_load`` right after then finds
+        the fresh generation on every member — the joiner resumes
+        bit-exact from the survivors' live state, and the survivors
+        reload the snapshot they just wrote (a no-op by construction).
+
+        Cost note: the bcast ships the snapshot to EVERY member, so
+        surviving non-roots download bytes they discard.  Deliberate at
+        this scale — bcast is the one lock-step collective whose done
+        barrier already synchronizes file durability with the vote; a
+        targeted ``send_obj`` per joiner (+ explicit barrier) is the
+        optimization seam when large-N grows with large snapshots hurt.
+        """
+        cp = self.checkpointer
+        me_joined = self.stable_rank in joined
+        iteration = None
+        if not me_joined:
+            iteration = trainer.updater.iteration
+            cp.save(trainer, iteration)
+        # the shipping root is the lowest-ranked SURVIVOR (a joiner has
+        # nothing current to ship); every member computes the same slot
+        # from the same view
+        survivors = [r for r in self.view.members if r not in joined]
+        root = self.view.slot(min(survivors))
+        payload = None
+        if self.stable_rank == min(survivors):
+            out = cp._dir(trainer)
+            with open(os.path.join(out, cp._filename(iteration)),
+                      "rb") as f:
+                payload = (iteration, f.read())
+        iteration, data = self.comm.bcast_obj(payload, root=root)
+        if me_joined:
+            out = cp._dir(trainer)
+            os.makedirs(out, exist_ok=True)
+            fname = cp._filename(iteration)
+            digest = hashlib.sha256(data).hexdigest()
+            with open(os.path.join(out, fname + ".sum"), "w") as f:
+                f.write(digest)  # sidecar before data: same durability
+            with open(os.path.join(out, fname), "wb") as f:
+                f.write(data)    # order as checkpoint.save documents
+            self._log(f"adopted snapshot generation {iteration} "
+                      f"({len(data)} bytes)")
+        # every member (joiners included) barriers through the bcast
+        # above, so the files are durable before the consensus vote
+
+
+def _rehome_model(model, comm):
+    """Re-place a model's params/persistents replicated on ``comm``'s
+    mesh by VALUE (the old mesh may span departed processes, so
+    ``bcast_data``'s direct device_put cannot be used)."""
+    from ..optimizers import _rehome_replicated
+    for param in model.params():
+        if param.array is not None:
+            param.array = _rehome_replicated(param.array, comm)
+        # a gradient from the old world has no meaning in the new one
+        # (and may live on the old mesh): drop it — the next step
+        # recomputes
+        param.grad = None
+    from ..core.link import _persistent_slots
+    for sublink, name, _ in _persistent_slots(model):
+        value = getattr(sublink, name)
+        if value is not None and not np.isscalar(value) \
+                and not isinstance(value, (int, float)):
+            placed = _rehome_replicated(value, comm)
+            object.__setattr__(sublink, name, placed)
+            sublink._persistent[name] = placed
+    return model

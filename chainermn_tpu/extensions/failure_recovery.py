@@ -55,7 +55,23 @@ def _never_fire(trainer):
 
 
 class RecoveryGivingUp(RuntimeError):
-    """Raised (chaining the fault) when the recovery budget is spent."""
+    """Raised (chaining the fault) when the recovery budget is spent.
+
+    Carries the last known membership view (``membership`` — an
+    :class:`~..communicators.MembershipView` on elastic runs, None on
+    fixed-size ones) IN THE MESSAGE: a give-up is precisely the moment
+    an operator reads one line of a crash log, and "who was in the
+    world when we stopped trying" is the first question (ISSUE 10
+    satellite — a bare budget count told you nothing about *who* was
+    missing)."""
+
+    def __init__(self, message, membership=None):
+        self.membership = membership
+        if membership is not None:
+            message = (f"{message} [last membership view: epoch "
+                       f"{membership.epoch}, members "
+                       f"{list(membership.members)}]")
+        super().__init__(message)
 
 
 class FailureRecovery(Extension):
@@ -102,7 +118,15 @@ class FailureRecovery(Extension):
         self.on_recover = on_recover
         self.verbose = verbose
         self.stats = {"recoveries": 0, "resumed_iterations": [],
-                      "generation_bumps": 0}
+                      "generation_bumps": 0,
+                      # elastic telemetry (ISSUE 10): world-size changes
+                      # and the rank churn behind them — zero forever on
+                      # fixed-size runs, filled by ElasticRecovery
+                      "resizes": 0, "ranks_lost": 0, "ranks_joined": 0}
+        # the last membership view this supervisor acted on (elastic
+        # runs); attached to RecoveryGivingUp so a give-up names who
+        # was present
+        self.last_view = None
 
     def __call__(self, trainer):
         pass  # all behavior lives on the supervisor path
@@ -118,16 +142,26 @@ class FailureRecovery(Extension):
         return (isinstance(exc, self.recoverable)
                 and not isinstance(exc, self.unrecoverable))
 
+    def _spend_recovery_budget(self, exc):
+        """Shared budget gate (fixed-size AND elastic recover paths):
+        exhaustion raises :class:`RecoveryGivingUp` chaining the fault
+        and naming the last membership view; otherwise one attempt is
+        spent."""
+        if self.stats["recoveries"] >= self.max_recoveries:
+            raise RecoveryGivingUp(
+                f"recovery budget exhausted "
+                f"({self.stats['recoveries']}/{self.max_recoveries})",
+                membership=self.last_view
+                if self.last_view is not None
+                else getattr(self, "view", None),
+            ) from exc
+        self.stats["recoveries"] += 1
+
     def recover(self, trainer, exc):
         """Run the recovery state machine; returns the resumed iteration
         (or None when no common snapshot existed and training restarts
         from live state)."""
-        if self.stats["recoveries"] >= self.max_recoveries:
-            raise RecoveryGivingUp(
-                f"recovery budget exhausted "
-                f"({self.stats['recoveries']}/{self.max_recoveries})"
-            ) from exc
-        self.stats["recoveries"] += 1
+        self._spend_recovery_budget(exc)
         if self.verbose:
             print(f"chainermn_tpu: recovering from {type(exc).__name__}: "
                   f"{exc} (attempt {self.stats['recoveries']}"

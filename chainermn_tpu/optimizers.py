@@ -65,6 +65,34 @@ __all__ = ["create_multi_node_optimizer", "_MultiNodeOptimizer",
            "_DoubleBufferingOptimizer"]
 
 
+def _rehome_replicated(tree, communicator):
+    """Re-place a REPLICATED pytree onto ``communicator``'s mesh by
+    value (elastic resize, ISSUE 10): a jax.Array committed to the OLD
+    mesh — possibly spanning processes that are gone — cannot be fed to
+    the new mesh's compiled step, but a replicated array's every local
+    shard holds the full value, so the move is a host round-trip that
+    needs no collective and no dead peer.  The commit goes through
+    ``make_array_from_callback`` (like ``_commit_opt_state_to_mesh``),
+    NOT ``device_put``: multi-process device_put runs a cross-process
+    value-equality collective, and mid-resize the values are allowed to
+    differ (a joiner's stale state is about to be replaced by the
+    consensus load — it only has to be SHAPED right here)."""
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(communicator.mesh, P())
+
+    def move(leaf):
+        if not isinstance(leaf, jax.Array):
+            return leaf
+        if leaf.is_fully_addressable:
+            host = np.asarray(leaf)
+        else:
+            host = np.asarray(leaf.addressable_shards[0].data)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    return jax.tree.map(move, tree)
+
+
 def create_multi_node_optimizer(actual_optimizer, communicator,
                                 double_buffering=False, zero_fill=True,
                                 zero_sharding=False, exchange=None):
@@ -225,6 +253,85 @@ class _MultiNodeOptimizer:
         super().__setattr__("_stale_grads", None)
         super().__setattr__("_residual", None)
         self._mn_step_cache.clear()
+        return self
+
+    # -- elastic resize (ISSUE 10) -----------------------------------------
+    def change_communicator(self, communicator, via_checkpoint=False):
+        """Swap the transport after an elastic resize, re-planning every
+        piece of state whose layout depends on the world size.
+
+        What is PRESERVED vs RE-SEEDED (the contract
+        ``docs/resilience.md`` §7 documents):
+
+        * model params and (replicated) optimizer state — preserved:
+          re-homed onto the new mesh by value;
+        * compiled steps, bucket plans, the ZeRO flat layout —
+          re-derived lazily (cache cleared; the padding multiple and
+          chunk specs follow the new size);
+        * the double-buffer stale-grad buffer and the error-feedback
+          ``_residual`` — RE-SEEDED ZEROS: both are per-device content
+          with no cross-partition meaning (the same rule size-changed
+          snapshot resume already applies), costing one step of
+          staleness/correction, never correctness;
+        * SHARDED (``zero_sharding`` / ``exchange="reduce_scatter"``)
+          optimizer state: fully-addressable flat leaves are sliced to
+          the true length and re-committed to the new mesh's padded
+          chunk layout (the PR 5 size-changed-resume brick, applied
+          in-memory).  REAL multi-controller sharded leaves cannot be
+          reassembled here — the old mesh's collectives may span dead
+          processes — so they require ``via_checkpoint=True``: the
+          state is dropped and the caller's consensus ``maybe_load``
+          (which the elastic supervisor always runs next) restores it
+          onto the new layout.
+        """
+        old = self.communicator
+        if communicator is old:
+            return self
+        actual = self.actual_optimizer
+        if self._sharded_update and actual._opt_state is not None:
+            leaves = jax.tree.leaves(actual._opt_state)
+            nonaddr = any(isinstance(l, jax.Array)
+                          and not l.is_fully_addressable for l in leaves)
+            if nonaddr:
+                if not via_checkpoint:
+                    raise RuntimeError(
+                        "change_communicator on a multi-controller "
+                        "sharded optimizer needs via_checkpoint=True: "
+                        "the old mesh's chunks cannot be reassembled "
+                        "without the departed processes — resume the "
+                        "state through the checkpointer's consensus "
+                        "maybe_load instead")
+                actual._opt_state = None
+                old_state = None
+            else:
+                old_state = actual._opt_state
+        else:
+            old_state = None
+        super().__setattr__("communicator", communicator)
+        super().__setattr__("_zero_layout", None)
+        super().__setattr__("_stale_grads", None)  # re-seed zeros
+        super().__setattr__("_residual", None)     # re-seed zeros
+        self._mn_step_cache.clear()
+        if old_state is not None:
+            # recompute the flat layout at the NEW size, then slice/
+            # re-pad + re-commit each flat leaf (what
+            # _commit_opt_state_to_mesh does for a size-changed load)
+            params = extract_state(actual.target)["params"]
+            if params and all(v is not None for v in params.values()):
+                from .communicators._memory_utility import tree_pack
+                flat, spec = tree_pack(params)
+                n = flat.shape[0]
+                size = communicator.size
+                n_pad = -(-n // size) * size
+                super().__setattr__("_zero_layout", (spec, n, n_pad))
+                actual._opt_state = \
+                    self._commit_opt_state_to_mesh(old_state)
+        elif not self._sharded_update and actual._opt_state is not None:
+            # replicated per-param state: re-home by value onto the new
+            # mesh (multi-controller arrays on the old mesh cannot be
+            # fed to the new mesh's program directly)
+            actual._opt_state = _rehome_replicated(
+                actual._opt_state, communicator)
         return self
 
     # -- update -------------------------------------------------------------
@@ -1069,6 +1176,11 @@ class _MultiNodeOptimizer:
                 serialize_flat_tree(
                     sub, self._gather_opt_state_to_host(self._residual),
                     "n", "r")
+                # the residual is per-DEVICE content: record the world
+                # size it was partitioned for, so a size-changed resume
+                # re-seeds even when the GLOBAL lengths coincide (e.g.
+                # ceil(n/4)·8 == ceil(n/2)·4 — ISSUE 10 satellite)
+                sub("world_size", self.communicator.size)
             return
         if actual.target is None:
             return
@@ -1087,6 +1199,16 @@ class _MultiNodeOptimizer:
         restored = deserialize_flat_tree(sub, template, "n", "r")
         if restored is None:
             # pre-feature snapshot: fresh zero-seed on first update
+            super().__setattr__("_residual", None)
+            return
+        try:
+            saved_size = int(sub("world_size", -1))
+        except KeyError:
+            saved_size = -1  # strict reader, pre-field snapshot
+        if saved_size not in (-1, self.communicator.size):
+            # partitioned for a DIFFERENT world: zero-seed even when the
+            # global length happens to coincide (the shape check below
+            # cannot see a re-partition at equal length)
             super().__setattr__("_residual", None)
             return
         if not (isinstance(restored, jax.Array)

@@ -291,6 +291,108 @@ def test_quantized_residual_pre_feature_snapshot_zero_seeds(tmp_path):
     assert np.isfinite(float(ef.update(ef.target, x, t)))
 
 
+def _run_sized(exchange, n_devices, double_buffering=False,
+               grad_dtype=None, steps=2):
+    """Like :func:`_run` but over an explicit device-count world — the
+    changed-communicator-size resume grid (ISSUE 10 satellite).  The
+    hierarchical legs keep the forced dcn=2 split, so 8 devices = 2×4
+    and 4 devices = 2×2: a genuinely different chunk partition."""
+    comm = ct.create_communicator(
+        "hierarchical" if exchange in _HIER else "jax_ici",
+        devices=jax.devices()[:n_devices],
+        inter_size=2 if exchange in _HIER else None,
+        batch_collectives=_BC.get(exchange, True),
+        allreduce_grad_dtype=grad_dtype)
+    model = _model()
+    comm.bcast_data(model)
+    inner = MomentumSGD(lr=0.1, momentum=0.9)
+    opt = ct.create_multi_node_optimizer(
+        inner, comm, double_buffering=double_buffering,
+        exchange="reduce_scatter"
+        if exchange in ("reduce_scatter", "hierarchical_rs")
+        else "allreduce").setup(model)
+    x, t = _data()
+    losses = [float(opt.update(model, x, t)) for _ in range(steps)]
+    return losses, opt
+
+
+def test_size_changed_resume_reseeds_ef_residual(tmp_path):
+    """ISSUE 10 satellite: the re-seed-zeros contract for the
+    error-feedback ``_residual`` was documented but only SAME-size
+    resume was pinned.  Changed size: a snapshot from the 2×4 world
+    loads into a 2×2 world — params carry over, the residual (per-
+    DEVICE quantization error, meaningless under a new partition)
+    re-seeds zeros, and training continues finite."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, opt8 = _run_sized("hierarchical", 8, grad_dtype={"dcn": "int8"})
+    assert opt8._residual is not None
+    save_npz(path, opt8)
+    saved_params = [np.asarray(p.array) for p in opt8.target.params()]
+
+    _, opt4 = _run_sized("hierarchical", 4, grad_dtype={"dcn": "int8"})
+    assert opt4._residual is not None
+    load_npz(path, opt4)
+    # params resumed from the snapshot bit-exact (size-independent)...
+    for a, b in zip(opt4.target.params(), saved_params):
+        np.testing.assert_array_equal(np.asarray(a.array), b)
+    # ...the residual re-seeded (zero on the next update), explicitly
+    # EXCLUDED from the bit-exact contract
+    assert opt4._residual is None
+    assert np.isfinite(float(opt4.update(opt4.target, x, t)))
+
+
+def test_size_changed_resume_reseeds_sharded_ef_residual(tmp_path):
+    """Same pin for the sharded-update (hierarchical_rs) residual: its
+    length follows the flat chunk layout, so a changed world size can
+    never reuse it — zero-seed, while the flat opt-state re-pads to
+    the new multiple (the PR 5 brick)."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, opt8 = _run_sized("hierarchical_rs", 8,
+                         grad_dtype={"dcn": "int8"})
+    assert opt8._residual is not None
+    save_npz(path, opt8)
+
+    _, opt4 = _run_sized("hierarchical_rs", 4,
+                         grad_dtype={"dcn": "int8"})
+    load_npz(path, opt4)
+    assert opt4._residual is None  # re-seeded
+    _, n, n_pad = opt4._zero_layout
+    assert n_pad % 4 == 0
+    # the flat opt-state slices to the true length and re-pads to the
+    # NEW world's multiple — the compiled step runs on it directly
+    assert np.isfinite(float(opt4.update(opt4.target, x, t)))
+
+
+def test_size_changed_resume_repads_stale_chunk(tmp_path):
+    """The double-buffer stale CHUNK has the complementary contract: it
+    is GLOBAL content (the flat one-step-stale mean gradient), so a
+    size-changed resume slices/re-pads it instead of zero-seeding —
+    the first resumed update still applies the saved step's gradient."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, opt8 = _run_sized("reduce_scatter", 8, double_buffering=True)
+    assert opt8._stale_grads is not None
+    saved = np.asarray(opt8._stale_grads)
+    save_npz(path, opt8)
+
+    _, opt4 = _run_sized("reduce_scatter", 4, double_buffering=True)
+    load_npz(path, opt4)
+    assert opt4._stale_grads is not None
+    _, n, n_pad4 = opt4._zero_layout
+    restored = np.asarray(opt4._stale_grads)
+    assert restored.shape[0] == n_pad4
+    np.testing.assert_array_equal(restored[:n], saved[:n])
+    assert np.isfinite(float(opt4.update(opt4.target, x, t)))
+
+
 def test_double_buffered_reduce_scatter_resume_bit_exact(tmp_path):
     """Serialize → restore → continue must be bit-exact for the
     reduce-scatter double-buffering pair: the stale CHUNK is observable

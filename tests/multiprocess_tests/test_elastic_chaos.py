@@ -1,0 +1,32 @@
+"""End-to-end elastic chaos over REAL 2-process gloo transport (the
+ISSUE 10 acceptance gate, see docs/resilience.md §7).
+
+One training run: a seeded rank-targeted ``preempt`` fault hard-stops
+rank 1 mid-run → rank 0 detects through a typed channel timeout, the
+membership protocol shrinks the world to {0}, and training continues
+solo (global batch preserved) → rank 1 parks, announces ``join``, is
+re-admitted, adopts the survivors' newest snapshot, and the world grows
+back to {0, 1} → the run finishes at the full iteration count with the
+final loss inside the committed ±5% convergence-parity band of the
+uninterrupted baseline, bit-identical params across the re-grown world,
+and a world-size-1 snapshot proven to resume bit-exact into a
+2-process-shaped trainer (params/opt-state; re-seeded elastic buffers
+excluded by contract)."""
+
+import pytest
+
+from .test_two_process import _launch
+
+pytestmark = pytest.mark.chaos
+
+
+def test_two_process_elastic_preempt_and_rejoin(tmp_path):
+    outs = _launch("elastic", 2, tmp_path, timeout=420)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-6000:]}"
+        assert "ALL_OK" in out, out[-6000:]
+    for name in ("elastic_baseline", "elastic_shrink_and_regrow",
+                 "elastic_world_consistent", "elastic_convergence_parity",
+                 "elastic_cross_size_resume_bit_exact"):
+        for rc, out in outs:
+            assert f"PASS {name}" in out, (name, out[-6000:])

@@ -1,0 +1,608 @@
+"""Elastic shrink/grow machinery, single process, tier-1 (ISSUE 10).
+
+Three layers, all deterministic:
+
+* the MEMBERSHIP PROTOCOL against an in-memory KV store — leave-
+  excluded shrink consensus, join admission, epoch monotonicity,
+  zombie-presence screening, typed timeout when no decision lands, and
+  adoption of a view that excludes the caller (the split-brain escape);
+* the RESIZE MACHINERY — ``change_communicator`` re-planning (zero
+  layout recomputed, compiled-step cache dropped, stale/EF buffers
+  re-seeding zeros, sharded flat state re-committed), the
+  ``global_batch_plan`` policy table, and ``rescatter_dataset``'s
+  no-sample-dropped/no-double-count partition property;
+* the FULL SUPERVISOR ARC on the simulated 8-device CPU host — a
+  scripted membership shrinks a 4-device world to 2 and grows it back
+  mid-training through the real ``Trainer.run`` supervisor +
+  fault-injected preemption, asserting convergence parity against the
+  uninterrupted golden and the stats/giving-up satellite surface.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu.communicators import (ElasticMembership,
+                                         ElasticMeshCommunicator,
+                                         FaultInjectionCommunicator,
+                                         FaultSchedule, MembershipView,
+                                         RankPreempted)
+from chainermn_tpu.communicators._host_channel import ChannelTimeoutError
+from chainermn_tpu.core.optimizer import MomentumSGD
+from chainermn_tpu.extensions import (ElasticConfigError, ElasticRecovery,
+                                      RecoveryGivingUp, global_batch_plan)
+from chainermn_tpu.models import MLP, Classifier
+
+pytestmark = pytest.mark.chaos
+
+
+class KV:
+    """Thread-safe in-memory stand-in for the coordination KV store
+    (the real client's narrow surface: try_get raises on missing)."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def key_value_set(self, k, v):
+        with self.lock:
+            self.store[k] = str(v)
+
+    def key_value_try_get(self, k):
+        with self.lock:
+            if k not in self.store:
+                raise KeyError(k)
+            return self.store[k]
+
+    def key_value_delete(self, k):
+        with self.lock:
+            self.store.pop(k, None)
+
+
+def _member(kv, rank, world=2, **kw):
+    kw.setdefault("settle_s", 0.05)
+    kw.setdefault("poll_s", 0.002)
+    kw.setdefault("timeout_ms", 4000)
+    return ElasticMembership(kv, rank=rank, world=world, **kw)
+
+
+# -- membership protocol -----------------------------------------------------
+
+def test_bootstrap_view_and_epoch():
+    m = _member(KV(), 0)
+    assert m.current_epoch() == 0
+    v = m.current_view()
+    assert v.epoch == 0 and v.members == (0, 1)
+    assert v.slot(1) == 1 and v.slot(5) is None
+    assert 0 in v and 7 not in v
+
+
+def test_leave_excluded_shrink_consensus():
+    kv = KV()
+    m0, m1 = _member(kv, 0), _member(kv, 1)
+    m1.announce_leave(note="preempted")
+    v = m0.resolve(expect={0})
+    assert v == MembershipView(1, (0,))
+    # the decision is durable: the departed rank adopts it too
+    assert m1.current_view() == v
+    assert m0.stats["led"] == 1
+
+
+def test_grow_consensus_and_join_scrub():
+    kv = KV()
+    m0, m1 = _member(kv, 0), _member(kv, 1)
+    m1.announce_leave()
+    m0.resolve(expect={0})
+    m1.announce_join()
+    assert m0.pending_joins() == (1,)
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.setdefault(1, m1.resolve(expect={0, 1})))
+    t.start()
+    out[0] = m0.resolve(expect={0, 1})
+    t.join()
+    assert out[0] == out[1] == MembershipView(2, (0, 1))
+    # consumed intents are scrubbed: no standing join re-admits
+    assert m0.pending_joins() == ()
+
+
+def test_epochs_monotonic_across_resolves():
+    kv = KV()
+    m0 = _member(kv, 0)
+    _member(kv, 1).announce_leave()
+    epochs = [m0.resolve(expect={0}).epoch for _ in range(3)]
+    assert epochs == [1, 2, 3]
+
+
+def test_announce_join_retracts_leave():
+    kv = KV()
+    m1 = _member(kv, 1)
+    m1.announce_leave()
+    m1.announce_join()
+    v = _member(kv, 0).resolve(expect={0, 1}, timeout_ms=500) \
+        if False else None
+    # rank 1 has no live resolve loop here; just check the intent keys
+    assert "cmn/elastic/leave/1" not in kv.store
+    assert "cmn/elastic/join/1" in kv.store
+
+
+def test_zombie_presence_screened_at_settle():
+    """A presence key stranded by a dead rank's earlier attempt (its
+    token never changes) must not be decided into the view."""
+    kv = KV()
+    kv.key_value_set("cmn/elastic/e1/present/1", "99")  # frozen token
+    v = _member(kv, 0).resolve()  # settle path: no expect
+    assert v.members == (0,)
+
+
+def test_resolve_typed_timeout_when_leader_never_decides():
+    """A live lower-ranked candidate that never publishes (it keeps
+    beating but is stuck) leaves the higher rank with a TYPED timeout,
+    not a hang."""
+    kv = KV()
+    beat = [0]
+
+    def sleep(s):
+        # rank 0 'exists': its token keeps changing, so rank 1 neither
+        # leads (not the minimum) nor screens it out as a zombie
+        beat[0] += 1
+        kv.key_value_set("cmn/elastic/e1/present/0", str(beat[0]))
+        time.sleep(0)
+
+    m1 = _member(kv, 1, sleep=sleep, timeout_ms=300)
+    with pytest.raises(ChannelTimeoutError) as e:
+        m1.resolve()
+    assert e.value.op == "membership.resolve"
+
+
+def test_adopts_in_flight_decision_that_excludes_caller():
+    """A late rank whose epoch was decided without it ADOPTS the
+    published view (the caller handles its exclusion — the supervisor's
+    rejoin path), never publishing a second one."""
+    kv = KV()
+    kv.key_value_set("cmn/elastic/e1/view", "0")  # decided without 1
+    adopted = _member(kv, 1).resolve()
+    assert adopted == MembershipView(1, (0,))
+    assert 1 not in adopted
+
+
+def test_require_blocks_lone_joiner_from_disjoint_world():
+    """The split-brain guard: a joiner resolving with require=
+    (the survivors) can NEVER settle a world by itself — unsatisfiable
+    require ends in the typed timeout, not a disjoint view."""
+    kv = KV()
+    m0, m1 = _member(kv, 0), _member(kv, 1)
+    m1.announce_leave()
+    m0.resolve(expect={0})
+    m1.announce_join()
+    with pytest.raises(ChannelTimeoutError):
+        m1.resolve(expect={0, 1}, require={0}, timeout_ms=300)
+    # and WITH the survivor participating, the same resolve admits
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault(
+        1, m1.resolve(expect={0, 1}, require={0})))
+    t.start()
+    out[0] = m0.resolve(expect={0, 1})
+    t.join()
+    assert out[0] == out[1]
+    assert out[0].members == (0, 1)
+
+
+# -- batch policy + rescatter ------------------------------------------------
+
+def test_global_batch_plan_table():
+    assert global_batch_plan(64, 8) == {
+        "policy": "rescale", "global_bs": 64, "world_size": 8,
+        "dispatch_bs": 64, "per_rank_bs": 8, "accum_steps": 1}
+    # shrink 8 -> 2 at fixed global batch: per-rank grows 4x
+    assert global_batch_plan(64, 2)["per_rank_bs"] == 32
+    # bounded per-rank memory falls through to accumulation
+    plan = global_batch_plan(64, 2, max_per_rank=8)
+    assert plan == {"policy": "accumulate", "global_bs": 64,
+                    "world_size": 2, "dispatch_bs": 16,
+                    "per_rank_bs": 8, "accum_steps": 4}
+    # explicit accumulate policy prefers the fewest dispatches
+    assert global_batch_plan(64, 2, policy="accumulate")[
+        "accum_steps"] == 1
+    with pytest.raises(ElasticConfigError):
+        global_batch_plan(12, 8)
+    with pytest.raises(ValueError):
+        global_batch_plan(8, 2, policy="bogus")
+
+
+class _FakeTopology:
+    def __init__(self, size, inter_size, inter_rank):
+        self.size = size
+        self.inter_size = inter_size
+        self.inter_rank = inter_rank
+
+    def allgather_obj(self, obj):
+        return [obj] * self.inter_size
+
+
+def test_rescatter_dataset_no_loss_no_double_count():
+    """Re-slicing a scattered shard for a resized world is a pure
+    function of (order, topology): the union over the new hosts equals
+    the union over the old ones and every sample appears exactly once
+    (beyond the documented equal-length wrap padding)."""
+    data = list(range(21))
+    comm2 = _FakeTopology(size=2, inter_size=2, inter_rank=0)
+    shard0 = ct.scatter_dataset(data, comm2, shuffle=True, seed=5)
+    # shrink to one host: re-slice from the SAME agreed order
+    comm1 = _FakeTopology(size=1, inter_size=1, inter_rank=0)
+    new = ct.rescatter_dataset(shard0, comm1)
+    assert sorted(set(new[i] for i in range(len(new)))) == data
+    assert len(new) == 21  # exact multiple of 1: padding gone
+    # grow to four hosts: the four shards partition the order with
+    # only the wrap-padding duplicated, and every member computes its
+    # slice independently
+    shards = [ct.rescatter_dataset(
+        shard0, _FakeTopology(size=4, inter_size=4, inter_rank=r))
+        for r in range(4)]
+    seen = [s[i] for s in shards for i in range(len(s))]
+    assert set(seen) == set(data)
+    assert len(seen) == 24  # 21 padded to the next multiple of 4
+    assert len(seen) - len(set(seen)) == 3  # exactly the wrap padding
+    with pytest.raises(TypeError):
+        ct.rescatter_dataset(data, comm1)
+
+
+# -- elastic communicator + optimizer re-plan --------------------------------
+
+def _data(n=16, d=12, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32)),
+            jnp.asarray(rng.randint(0, k, n).astype(np.int32)))
+
+
+def _world(n_devices, epoch=0, **kw):
+    return ElasticMeshCommunicator(members=[0], epoch=epoch,
+                                   devices=jax.devices()[:n_devices],
+                                   **kw)
+
+
+def test_elastic_communicator_surface():
+    comm = _world(4, epoch=3)
+    assert comm.size == 4
+    assert comm.members == (0,)
+    assert comm.inter_size == 1 and comm.inter_rank == 0
+    assert comm.stable_rank == 0
+    assert comm.axis_name == "elastic_e3"
+    assert comm._local_device_counts() == [4]
+    # loopback object plane: never the all-boot-processes fallback
+    assert comm._process_allgather_pickled({"a": 1}) == [{"a": 1}]
+    with pytest.raises(ValueError):
+        ElasticMeshCommunicator(members=[])
+
+
+def test_change_communicator_reseeds_and_replans():
+    """The documented resize contract: compiled steps re-derive, the
+    ZeRO layout follows the new size, and the stale-grad/EF buffers
+    re-seed zeros."""
+    from chainermn_tpu.extensions.elastic import _rehome_model
+    x, t = _data()
+    comm = _world(4)
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm,
+        double_buffering=True).setup(model)
+    for _ in range(2):
+        opt.update(model, x, t)
+    assert opt._stale_grads is not None
+    comm2 = _world(2, epoch=1)
+    opt.change_communicator(comm2)
+    assert opt.communicator is comm2
+    assert opt._stale_grads is None  # re-seed zeros
+    assert opt._residual is None
+    assert len(opt._mn_step_cache) == 0
+    _rehome_model(model, comm2)
+    # first post-resize update applies zeros (fresh double-buffer
+    # semantics) and runs on the 2-device mesh
+    assert np.isfinite(float(opt.update(model, x, t)))
+
+
+def test_change_communicator_recommits_sharded_state():
+    """Fully-addressable flat opt-state survives a resize by value:
+    sliced to the true length and re-padded to the new world's
+    multiple (the PR 5 size-changed-resume brick, in memory)."""
+    from chainermn_tpu.extensions.elastic import _rehome_model
+    x, t = _data()
+    golden_m = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    gopt = MomentumSGD(lr=0.1, momentum=0.9).setup(golden_m)
+    glosses = [float(gopt.update(golden_m, x, t)) for _ in range(4)]
+
+    comm = _world(4)
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm,
+        exchange="reduce_scatter").setup(model)
+    losses = [float(opt.update(model, x, t)) for _ in range(2)]
+    comm2 = _world(2, epoch=1)
+    opt.change_communicator(comm2)
+    _rehome_model(model, comm2)
+    assert opt._zero_layout is not None
+    _, n, n_pad = opt._zero_layout
+    assert n_pad % comm2.size == 0
+    losses += [float(opt.update(model, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(losses, glosses, rtol=1e-5, atol=1e-7)
+
+
+def test_change_communicator_same_comm_is_noop():
+    comm = _world(2)
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1), comm).setup(model)
+    assert opt.change_communicator(comm) is opt
+
+
+# -- the full supervisor arc (simulated single-controller world) -------------
+
+class _ScriptedMembership:
+    """Duck-typed ElasticMembership whose decisions are scripted — the
+    single-controller way to drive the supervisor through a shrink and
+    a grow without real processes."""
+
+    def __init__(self, views):
+        self.rank = 0
+        self.world = 2
+        self.timeout_ms = 1000
+        self.poll_s = 0.0
+        self._epoch = 0
+        self._views = list(views)  # member tuples, popped per resolve
+        self.joins = ()
+        self.left = []
+        self.joined = []
+
+    def current_epoch(self):
+        return self._epoch
+
+    def current_view(self):
+        return MembershipView(self._epoch, (0, 1) if self._epoch == 0
+                              else self._last)
+
+    def bootstrap_view(self):
+        return MembershipView(0, (0, 1))
+
+    def announce_leave(self, note=""):
+        self.left.append(note)
+
+    def announce_join(self, note=""):
+        self.joined.append(note)
+
+    def pending_joins(self, view=None):
+        joins, self.joins = self.joins, ()
+        return joins
+
+    def resolve(self, expect=None, require=None, timeout_ms=None):
+        members = self._views.pop(0)
+        self._epoch += 1
+        self._last = tuple(members)
+        return MembershipView(self._epoch, members)
+
+
+def _elastic_trainer(tmp_path, schedule, membership, factory, iters=12):
+    from chainermn_tpu.dataset import SerialIterator, TupleDataset
+    from chainermn_tpu.training import StandardUpdater, Trainer
+    from chainermn_tpu.training.trainer import Extension
+
+    x, t = _data(n=32)
+
+    class _Beacon(Extension):
+        trigger = (1, "iteration")
+        priority = 400
+
+        def __init__(self, recovery):
+            self.recovery = recovery
+
+        def __call__(self, trainer):
+            self.recovery.comm.bcast_obj(
+                {"it": trainer.updater.iteration}, root=0)
+
+    comm = _world(4)
+    if schedule is not None:
+        comm = FaultInjectionCommunicator(comm, schedule)
+    model = Classifier(MLP(n_units=16, n_out=3, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.05, momentum=0.9), comm).setup(model)
+    it = SerialIterator(TupleDataset(np.asarray(x), np.asarray(t)), 8,
+                        shuffle=False)
+    trainer = Trainer(StandardUpdater(it, opt), (iters, "iteration"),
+                      out=str(tmp_path))
+    cp = ct.create_multi_node_checkpointer(comm, name="els",
+                                           path=str(tmp_path))
+    recovery = ElasticRecovery(checkpointer=cp, comm=comm,
+                               membership=membership,
+                               comm_factory=factory, verbose=False)
+    trainer.extend(_Beacon(recovery))
+    trainer.extend(cp, trigger=(3, "iteration"))
+    trainer.extend(recovery)
+    return trainer, model, opt, recovery
+
+
+def _subset_factory(split):
+    """view -> device-subset world: the simulated-host map (member set
+    -> how many of the 8 local devices the world covers)."""
+    def factory(view):
+        return ElasticMeshCommunicator(
+            members=[0], epoch=view.epoch,
+            devices=jax.devices()[:split[view.members]],
+            axis_name=f"sim_e{view.epoch}")
+    return factory
+
+
+def test_supervisor_shrinks_and_regrows_with_parity(tmp_path):
+    """The full arc through the REAL Trainer.run supervisor on the
+    simulated host: injected fault at iteration 4 → scripted shrink to
+    a 2-device world → training continues → scripted join at the next
+    poll → grow back to 4 devices → the run finishes at the full
+    iteration count with the final params inside parity of the
+    uninterrupted golden run."""
+    split = {(0,): 2, (0, 1): 4}
+    sched = FaultSchedule([dict(op="bcast_obj", nth=7)], seed=0)
+    membership = _ScriptedMembership(views=[(0,), (0, 1)])
+    trainer, model, opt, rec = _elastic_trainer(
+        tmp_path / "el", sched, membership, _subset_factory(split))
+
+    # plant the join: after the shrink has happened, the next poll
+    # admits member 1 back
+    orig_resolve = membership.resolve
+
+    def resolve(expect=None, timeout_ms=None):
+        v = orig_resolve(expect, timeout_ms)
+        if v.members == (0,):
+            membership.joins = (1,)
+        return v
+    membership.resolve = resolve
+
+    trainer.run()
+    assert trainer.updater.iteration == 12
+    assert rec.stats["resizes"] == 2, rec.stats
+    assert rec.stats["ranks_lost"] == 1
+    assert rec.stats["ranks_joined"] == 1
+    assert rec.view.members == (0, 1)
+    assert rec.comm.size == 4
+
+    # golden: uninterrupted 12 iterations on the 4-device world
+    g_trainer, g_model, _, g_rec = _elastic_trainer(
+        tmp_path / "g", None, _ScriptedMembership([]), None)
+    g_trainer.run()
+    assert g_rec.stats["resizes"] == 0
+    for a, b in zip(model.params(), g_model.params()):
+        np.testing.assert_allclose(np.asarray(a.array),
+                                   np.asarray(b.array),
+                                   rtol=5e-2, atol=1e-4)
+
+
+def test_preempted_rank_fail_stops_without_rejoin(tmp_path):
+    """Production default (rejoin_after_s=None): RankPreempted
+    announces the leave, then re-raises — the scheduler owns the
+    restart, the process exits hard."""
+    sched = FaultSchedule([dict(op="bcast_obj", nth=3,
+                                action="preempt", rank=0)], seed=0)
+    membership = _ScriptedMembership(views=[])
+    trainer, _, _, rec = _elastic_trainer(
+        tmp_path, sched, membership, None)
+    with pytest.raises(RankPreempted):
+        trainer.run()
+    assert membership.left, "leave was not announced"
+
+
+def test_min_world_floor_gives_up_with_view(tmp_path):
+    """Shrinking below min_world raises RecoveryGivingUp carrying the
+    membership view in its message (the satellite's who-was-there
+    requirement)."""
+    sched = FaultSchedule([dict(op="bcast_obj", nth=3)], seed=0)
+    membership = _ScriptedMembership(views=[(0,)])
+    trainer, _, _, rec = _elastic_trainer(
+        tmp_path, sched, membership, None)
+    rec.min_world = 2
+    with pytest.raises(RecoveryGivingUp) as e:
+        trainer.run()
+    assert "members [0]" in str(e.value)
+    assert e.value.membership.members == (0,)
+
+
+def test_resize_rescatters_host_shard_even_to_one_controller():
+    """The resize batch hook re-slices a scattered shard at EVERY new
+    world size: a shrink to ONE controller must widen the survivor's
+    partial shard to the full order — keeping the old half-shard would
+    silently train on a fraction of each epoch."""
+    from types import SimpleNamespace
+
+    from chainermn_tpu.dataset import SerialIterator
+
+    data = list(range(16))
+    shard = ct.scatter_dataset(
+        data, _FakeTopology(size=2, inter_size=2, inter_rank=0),
+        shuffle=True, seed=3)
+    assert len(shard) == 8  # the survivor's old half-shard
+    it = SerialIterator(shard, 8, shuffle=False)
+    trainer = SimpleNamespace(updater=SimpleNamespace(
+        get_iterator=lambda name: it))
+    rec = ElasticRecovery(membership=_ScriptedMembership([]),
+                          comm=_world(1), verbose=False)
+    rec._check_batch(trainer, _world(1, epoch=1))
+    assert sorted(set(it.dataset[i] for i in range(len(it.dataset)))) \
+        == data
+    assert len(it.dataset) == 16
+
+
+def test_swap_communicator_repoints_comm_holding_iterators():
+    """Comm-holding iterators (the multi-node batch broadcaster) must
+    follow a resize: left on the boot comm, every batch fetch would
+    ride the dead world's channel (review fix)."""
+    from types import SimpleNamespace
+
+    from chainermn_tpu.dataset import SerialIterator
+
+    boot = _world(4)
+    base = SerialIterator(list(range(8)), 4, shuffle=False)
+    mni = ct.create_multi_node_iterator(base, boot)
+    assert mni.comm is boot
+    trainer = SimpleNamespace(updater=SimpleNamespace(
+        _iterators={"main": mni},
+        get_all_optimizers=lambda: {}))
+    rec = ElasticRecovery(membership=_ScriptedMembership([]),
+                          comm=boot, verbose=False)
+    new = _world(2, epoch=1)
+    rec._swap_communicator(trainer, new)
+    assert mni.comm is new
+    assert rec.comm is new
+
+
+def test_check_batch_unwraps_multi_node_iterator():
+    """The batch-plan validation reaches through comm-wrapping
+    iterators to the base iterator's batch_size — an indivisible
+    global batch must fail TYPED at resize time, not as a shard_map
+    shape error inside the first resized step (review fix)."""
+    from types import SimpleNamespace
+
+    from chainermn_tpu.dataset import SerialIterator
+
+    boot = _world(4)
+    base = SerialIterator(list(range(12)), 12, shuffle=False)
+    mni = ct.create_multi_node_iterator(base, boot)
+    trainer = SimpleNamespace(updater=SimpleNamespace(
+        get_iterator=lambda name: mni))
+    rec = ElasticRecovery(membership=_ScriptedMembership([]),
+                          comm=boot, verbose=False,
+                          max_per_rank_bs=2)  # shrink blows the bound
+    with pytest.raises(ElasticConfigError) as e:
+        rec._check_batch(trainer, _world(2, epoch=1))
+    assert e.value.plan["accum_steps"] > 1
+
+
+def test_epoch_discovery_is_monotone_append_only():
+    """Decided epochs are append-only keys: discovery can never regress
+    through a pointer-overwrite gap (review fix — the real client's
+    delete-then-set emulation has a missing-key window)."""
+    kv = KV()
+    m0 = _member(kv, 0)
+    _member(kv, 1).announce_leave()
+    m0.resolve(expect={0})
+    m0.resolve(expect={0})
+    assert m0.current_epoch() == 2
+    # no single mutable pointer exists to race on
+    assert "cmn/elastic/epoch" not in kv.store
+    assert "cmn/elastic/epochs/1" in kv.store
+    assert "cmn/elastic/epochs/2" in kv.store
+    # a FRESH instance (cache cold) discovers the same epoch
+    assert _member(kv, 0).current_epoch() == 2
+
+
+def test_giving_up_message_carries_last_view():
+    err = RecoveryGivingUp("budget exhausted (3/3)",
+                           membership=MembershipView(4, (0, 2, 3)))
+    assert "epoch 4" in str(err)
+    assert "members [0, 2, 3]" in str(err)
+    plain = RecoveryGivingUp("budget exhausted (3/3)")
+    assert "membership" not in str(plain)

@@ -67,6 +67,57 @@ def test_drop_without_silent_result_degrades_to_raise():
         comm.scatter([1, 2, 3])
 
 
+def test_preempt_action_raises_rank_preempted():
+    """The elastic chaos action (ISSUE 10): a ``preempt`` spec surfaces
+    as RankPreempted at the API surface — and wrapping BINDS the base
+    communicator's rank, so a shared rank-targeted schedule fires only
+    on its target."""
+    from chainermn_tpu.communicators import RankPreempted
+    # DummyCommunicator.rank == 0: a rank-0-targeted spec fires here...
+    comm, sched = _wrap([dict(op="allreduce", nth=1, action="preempt",
+                              rank=0)])
+    assert sched.rank == 0  # bound at wrap time
+    with pytest.raises(RankPreempted) as e:
+        comm.allreduce(np.ones(2))
+    assert e.value.rank == 0
+    # ...and a rank-1-targeted one never does
+    comm1, _ = _wrap([dict(op="allreduce", nth=1, action="preempt",
+                           rank=1)])
+    np.testing.assert_array_equal(
+        np.asarray(comm1.allreduce(np.ones(2))), np.ones(2))
+
+
+def test_preempt_not_absorbed_by_host_channel_retry():
+    """An injected hc-level preempt is NON-transient: the channel's
+    bounded-retry loop re-raises it immediately instead of burning the
+    backoff budget on a host that is gone."""
+    from chainermn_tpu.communicators import RankPreempted
+    from chainermn_tpu.communicators._host_channel import HostChannel
+
+    class _Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += s
+
+    clock = _Clock()
+    ch = HostChannel(namespace="t", client=object(), clock=clock,
+                     sleep=clock.sleep, process_id=0, num_processes=2,
+                     timeout_ms=1000)
+    calls = []
+
+    def fn(remaining_ms):
+        calls.append(remaining_ms)
+        raise RankPreempted("hc.get", 1, rank=0)
+
+    with pytest.raises(RankPreempted):
+        ch._retrying("p2p", "k", fn)
+    assert len(calls) == 1  # no retry, no backoff
+
+
 def test_delay_uses_injected_sleep_then_executes():
     slept = []
     comm, _ = _wrap([dict(op="bcast_obj", nth=2, action="delay",

@@ -136,3 +136,80 @@ def test_custom_exception_type():
     s = FaultSchedule([dict(op="op", nth=1, exc=MyFault)])
     fault = s.on_call("op")
     assert isinstance(fault.make_exception(), MyFault)
+
+
+# -- preempt action + rank targeting (ISSUE 10 satellite) --------------------
+
+def test_preempt_spec_round_trips_new_fields():
+    """FaultSpec.to_dict carries the elastic fields — action='preempt'
+    and the rank target — through the dict/JSON round trip."""
+    spec = FaultSpec(op="bcast_obj", action="preempt", nth=5, rank=1,
+                     note="spot reclaim")
+    d = spec.to_dict()
+    assert d == {"op": "bcast_obj", "action": "preempt", "nth": 5,
+                 "rank": 1, "note": "spot reclaim"}
+    assert FaultSpec(**d).to_dict() == d
+    import json
+    s = FaultSchedule([spec], seed=3)
+    assert FaultSchedule.from_json(
+        json.dumps(s.to_dict())).to_dict() == s.to_dict()
+
+
+def test_preempt_fires_as_rank_preempted():
+    from chainermn_tpu.communicators.fault_schedule import RankPreempted
+    s = FaultSchedule([dict(op="allreduce", action="preempt", nth=2,
+                            rank=3)], seed=0, rank=3)
+    assert s.on_call("allreduce") is None
+    fault = s.on_call("allreduce")
+    exc = fault.make_exception()
+    assert isinstance(exc, RankPreempted)
+    assert (exc.op, exc.call_index, exc.rank) == ("allreduce", 2, 3)
+    # preempt owns its type: InjectedFault-recoverable supervisors must
+    # NOT see it as an in-place-retryable fault
+    from chainermn_tpu.communicators.fault_schedule import InjectedFault
+    assert not isinstance(exc, InjectedFault)
+
+
+def test_rank_targeted_spec_fires_only_on_bound_rank():
+    spec = dict(op="op", action="preempt", nth=1, rank=1)
+    assert FaultSchedule([spec], seed=0).bind_rank(1).on_call("op") \
+        is not None
+    assert FaultSchedule([spec], seed=0).bind_rank(0).on_call("op") is None
+    # unbound schedules never fire rank-restricted specs
+    assert FaultSchedule([spec], seed=0).on_call("op") is None
+
+
+def test_rank_filter_preserves_rng_stream_alignment():
+    """A rank-restricted PROBABILISTIC spec consumes its draw on every
+    rank (filtering happens after the draw), so a shared schedule's
+    other specs fire at identical call sites regardless of binding."""
+    specs = [dict(op="op", action="preempt", prob=0.5, rank=1,
+                  count=None),
+             dict(op="op", prob=0.3, count=None)]
+    ops = ["op"] * 40
+
+    def fired_sites(rank):
+        s = FaultSchedule(specs, seed=11).bind_rank(rank)
+        out = []
+        for i, op in enumerate(ops):
+            f = s.on_call(op)
+            if f is not None:
+                out.append((i, f.action))
+        return out
+
+    sites0 = fired_sites(0)
+    sites1 = fired_sites(1)
+    # only rank 1 sees the preempts
+    assert not any(a == "preempt" for _, a in sites0)
+    preempt1 = {i for i, a in sites1 if a == "preempt"}
+    assert preempt1
+    # outside the sites where rank 1's preempt won (first match wins),
+    # the shared 'raise' spec fires at IDENTICAL indices on both ranks
+    # — the draw stream stayed aligned through the rank filtering
+    assert [i for i, a in sites0 if a == "raise" and i not in preempt1] \
+        == [i for i, a in sites1 if a == "raise"]
+
+
+def test_rank_validation():
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", nth=1, rank=-2)

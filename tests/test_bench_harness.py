@@ -1063,6 +1063,30 @@ def test_donate_knob_excluded_from_flagship_cache(cache_path, capsys,
     assert bench._payload_flagship_ok("resnet50", TPU_RESULT)
 
 
+def test_resize_invalidates_flagship_cache(monkeypatch):
+    """ISSUE 10 satellite: a mid-run elastic resize is a different
+    measurement regime — the fingerprint knob (BENCH_PREEMPT_RANK) and
+    the payload gate (rows carrying resizes > 0) must both refuse it,
+    exactly like BENCH_INTER_SIZE fences the hierarchical legs."""
+    # env half: the elastic A/B knob defeats the flagship fingerprint
+    monkeypatch.setenv("BENCH_PREEMPT_RANK", "1")
+    assert bench._config_fingerprint("resnet50")["preempt_rank"] == 1
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_PREEMPT_RANK", raising=False)
+    assert bench._cacheable(TPU_RESULT)
+    # payload half: a planted row that resized mid-run is refused even
+    # with a clean environment (fingerprint-less planted-entry defense)
+    assert not bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "resizes": 2})
+    assert not bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "world_size": 2, "resizes": 1})
+    # fixed-size rows (resizes 0 or legacy rows lacking the key) stay
+    # flagship-eligible
+    assert bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "world_size": 1, "resizes": 0})
+    assert bench._payload_flagship_ok("resnet50", TPU_RESULT)
+
+
 def test_compile_credit_math(tmp_path):
     """The supervisor's deadline extension: recorded compile seconds,
     plus the in-flight phase's elapsed time, capped at grace, zero for

@@ -15,6 +15,7 @@ TOP_LEVEL = [
     "create_multi_node_evaluator", "scatter_dataset", "create_empty_dataset",
     "scatter_index", "create_multi_node_iterator",
     "create_synchronized_iterator", "create_multi_node_checkpointer",
+    "rescatter_dataset",
     "Parameter", "Link", "Chain", "ChainList", "Sequential",
     "report", "using_config", "F", "L",
 ]
@@ -29,7 +30,15 @@ MODULES = {
         "create_mnbn_model", "ParallelConvolution2D"],
     "chainermn_tpu.extensions": [
         "create_multi_node_checkpointer", "ObservationAggregator",
-        "OrbaxCheckpointer"],
+        "OrbaxCheckpointer",
+        # round 11 (elastic, docs/resilience.md §7)
+        "FailureRecovery", "RecoveryGivingUp", "ElasticRecovery",
+        "ElasticConfigError", "create_elastic_membership",
+        "global_batch_plan"],
+    "chainermn_tpu.communicators": [
+        "ElasticMembership", "MembershipView", "ElasticMeshCommunicator",
+        "RankPreempted", "FaultSchedule", "FaultSpec",
+        "FaultInjectionCommunicator"],
     "chainermn_tpu.parallel": [
         "ring_self_attention", "ring_attention", "ulysses_attention",
         "gpipe_apply", "one_f_one_b", "make_pipeline_train_step",

@@ -220,6 +220,13 @@ stepf=$STEPDIR/step_commab.log
   # cost across a genuine slow hop
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
     --gloo-exchange hierarchical
+  # ISSUE 10: the >=2-host ELASTIC A/B — rank 1 hard-preempted a third
+  # of the way in, survivors shrink and keep training, the rank
+  # re-joins and the world grows back; the summary line (wall delta vs
+  # the uninterrupted leg) is the end-to-end elasticity tax: typed
+  # detection + two membership resolves + two rebuilds + snapshot sync
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 60 \
+    --preempt-rank 1
 } > "$stepf" 2>&1 || true
 cat "$stepf"
 if grep -q '^{' "$stepf"; then
