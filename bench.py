@@ -367,6 +367,7 @@ _DEFAULT_FINGERPRINTS = {
                  "scan": 0, "remat": False, "n_steps": DEFAULT_STEPS,
                  "input_pipeline": False, "donate": True,
                  "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
+                 "stripe_ratio": 0,
                  "grad_dtype": "bfloat16", "error_feedback": True,
                  "preempt_rank": -1},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
@@ -377,6 +378,7 @@ _DEFAULT_FINGERPRINTS = {
                     "n_steps": DEFAULT_TF_STEPS,
                     "flash_blocks": ":", "donate": True,
                     "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
+                    "stripe_ratio": 0,
                     "grad_dtype": "bfloat16", "error_feedback": True,
                     "preempt_rank": -1},
 }
@@ -448,6 +450,9 @@ def _config_fingerprint(model=None):
             "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
             "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
             "inter_size": _env_int("BENCH_INTER_SIZE", 0),
+            # the striped ratio sweep (ISSUE 11) measures a different
+            # collective structure per ratio — never flagship data
+            "stripe_ratio": _env_float("BENCH_STRIPE_RATIO", 0),
             # the wire-dtype A/B (int8/fp8/lossless DCN) and the
             # error-feedback ablation compile different exchanges —
             # measurements, never flagship data
@@ -472,6 +477,7 @@ def _config_fingerprint(model=None):
         "exchange": os.environ.get("BENCH_EXCHANGE", "flat"),
         "bucket_mb": _env_float("BENCH_BUCKET_MB", 0),
         "inter_size": _env_int("BENCH_INTER_SIZE", 0),
+        "stripe_ratio": _env_float("BENCH_STRIPE_RATIO", 0),
         "grad_dtype": os.environ.get("BENCH_GRAD_DTYPE", "bfloat16"),
         "error_feedback":
             os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
@@ -829,12 +835,22 @@ def _make_dp_optimizer(inner, model, exchange, bucket_mb):
     inter_size = _env_int("BENCH_INTER_SIZE", 0) or None
     grad_dtype = os.environ.get("BENCH_GRAD_DTYPE", "bfloat16")
     grad_dtype = None if grad_dtype.lower() in ("none", "") else grad_dtype
+    # the striped legs (ISSUE 11) need a NONZERO ratio or they would
+    # silently measure the strict hierarchical schedule under the
+    # striped name: BENCH_STRIPE_RATIO, else the committed default
+    stripe_ratio = None
+    if exchange in ("striped", "striped_rs"):
+        from chainermn_tpu.communicators._memory_utility import \
+            DEFAULT_STRIPE_RATIO
+        stripe_ratio = _env_float("BENCH_STRIPE_RATIO", 0) \
+            or DEFAULT_STRIPE_RATIO
     comm = ct.create_communicator(comm_name,
                                   allreduce_grad_dtype=grad_dtype,
                                   batch_collectives=bc,
                                   bucket_mb=bucket_mb,
                                   inter_size=inter_size
                                   if comm_name == "hierarchical" else None,
+                                  stripe_ratio=stripe_ratio,
                                   error_feedback=os.environ.get(
                                       "BENCH_ERROR_FEEDBACK", "1") == "1")
     comm.bcast_data(model)
@@ -891,6 +907,80 @@ def _exchange_row_fields(model, comm, exchange):
               if comm.dcn_grad_dtype is not None else None,
               "error_feedback": comm.error_feedback
               if q_wire is not None else None}
+    if comm.striped:
+        # striped multi-path split (ISSUE 11): each path priced as its
+        # own two-level exchange — the ICI path fast-hop-major, the
+        # DCN path transposed — with the hop labels mapped back to
+        # FABRICS, padding element counts exactly like the wire does
+        # (each slice to its own ring multiple).  Rows carry the ratio
+        # plus the same per-fabric byte columns the hierarchical legs
+        # carry, so the A/B deltas line up column-for-column.
+        from chainermn_tpu.communicators._memory_utility import \
+            stripe_plan
+        fields["stripe_ratio"] = comm.stripe_ratio
+        intra, inter = comm.ici_size, comm.dcn_size
+        wire_itemsize = gdtype.itemsize if gdtype is not None else 4
+        dcn_itemsize = (comm.dcn_grad_dtype.itemsize
+                        if comm.dcn_grad_dtype is not None
+                        else wire_itemsize)
+        n_i, n_d = stripe_plan(n_params, comm.stripe_ratio)
+        if exchange == "striped_rs":
+            size = comm.size
+            n_pa = -(-n_i // size) * size
+            n_pb = -(-n_d // size) * size
+            ga = hierarchical_exchanged_bytes(
+                n_pa * wire_itemsize, intra, inter, "reduce_scatter",
+                dcn_n_bytes=n_pa // intra * dcn_itemsize)
+            gb = hierarchical_exchanged_bytes(
+                n_pb * dcn_itemsize, inter, intra, "reduce_scatter",
+                dcn_n_bytes=n_pb // inter * 4)
+            hops = {"ici": ga["ici"] + gb["dcn"],
+                    "dcn": ga["dcn"] + gb["ici"]}
+            pa = hierarchical_exchanged_bytes(n_pa * 4, intra, inter,
+                                              "all_gather")
+            pb = hierarchical_exchanged_bytes(n_pb * 4, inter, intra,
+                                              "all_gather")
+            p_hops = {"ici": pa["ici"] + pb["dcn"],
+                      "dcn": pa["dcn"] + pb["ici"]}
+        elif q_wire is not None:
+            # quantized DCN crossings on BOTH paths: the ICI path's
+            # chunk rides the gather-of-codewords hop, the DCN path
+            # quantizes its whole pre-reduction slice (gather over dcn
+            # + lossless full-slice psum over ici)
+            n_pa = -(-n_i // intra) * intra
+            hops = {
+                "ici": exchanged_bytes(n_pa * wire_itemsize, intra,
+                                       "psum")
+                + exchanged_bytes(n_d * 4, intra, "psum"),
+                "dcn": quantized_hop_bytes(n_pa // intra, inter,
+                                           "psum", q_wire)
+                + quantized_hop_bytes(n_d, inter, "psum", q_wire)}
+            p_hops = None
+        else:
+            # the ONE per-path pricing surface (also what the census
+            # identities are pinned against) — it pads each slice to
+            # its ring multiple exactly like the wire does
+            from chainermn_tpu.communicators._memory_utility import \
+                striped_exchanged_bytes
+            paths = striped_exchanged_bytes(
+                n_params * wire_itemsize, intra, inter,
+                comm.stripe_ratio, itemsize=wire_itemsize,
+                dcn_itemsize=dcn_itemsize
+                if comm.dcn_grad_dtype is not None else None)
+            hops = {"ici": paths["ici_path"]["ici"]
+                    + paths["dcn_path"]["ici"],
+                    "dcn": paths["ici_path"]["dcn"]
+                    + paths["dcn_path"]["dcn"]}
+            p_hops = None
+        fields["exchanged_grad_bytes"] = hops["ici"] + hops["dcn"]
+        fields["exchanged_dcn_bytes"] = hops["dcn"]
+        fields["exchanged_ici_bytes"] = hops["ici"]
+        fields["exchanged_bytes"] = fields["exchanged_grad_bytes"]
+        if exchange == "striped_rs":
+            fields["exchanged_bytes"] += p_hops["ici"] + p_hops["dcn"]
+            fields["exchanged_dcn_bytes"] += p_hops["dcn"]
+            fields["exchanged_ici_bytes"] += p_hops["ici"]
+        return fields
     if comm.hierarchy is not None:
         # per-hop split.  The accounting pads ELEMENTS exactly like the
         # wire does (pad_to_multiple on the packed vector: to intra for

@@ -152,7 +152,18 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
     # reduce_scatter routes through the optimizer-level step variant,
     # zero keeps the ZeRO-1 contract
     comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
-    comm = ct.create_communicator(comm_name, batch_collectives=bc)
+    # the striped legs (ISSUE 11) must run a NONZERO ratio or the curve
+    # would silently measure the strict hierarchical schedule under the
+    # striped name; the launcher exports CHAINERMN_TPU_STRIPE_RATIO for
+    # the ratio sweep
+    stripe = None
+    if exchange in ("striped", "striped_rs"):
+        from chainermn_tpu.communicators._memory_utility import (
+            DEFAULT_STRIPE_RATIO)
+        stripe = float(os.environ.get("CHAINERMN_TPU_STRIPE_RATIO", "")
+                       or DEFAULT_STRIPE_RATIO)
+    comm = ct.create_communicator(comm_name, batch_collectives=bc,
+                                  stripe_ratio=stripe)
     assert comm.size == nprocs == jax.device_count()
     model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
     comm.bcast_data(model)
@@ -197,11 +208,16 @@ def _gloo_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
             # bound) must be tellable apart downstream
             row["bucket_mb"] = comm.bucket_mb
             row["n_buckets"] = n_buckets
+        if comm.striped:
+            # the ratio sweep's independent variable travels with the
+            # row — three curves at {0.25, 0.5, 0.75} are only
+            # comparable if each datum names its split
+            row["stripe_ratio"] = comm.stripe_ratio
         print(json.dumps(row), flush=True)
 
 
 def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
-                    reps=1, exchange="flat"):
+                    reps=1, exchange="flat", stripe_ratio=None):
     """Launch each P-process measurement and report per-hop overhead:
     step_ms(P) - step_ms(1) is the cost the framework adds per step when
     the SAME compiled program's gradient mean must cross P real process
@@ -225,6 +241,10 @@ def _run_gloo_curve(proc_counts, per_rank_bs, hidden, steps, zero=False,
         env["XLA_FLAGS"] = re.sub(
             r"--xla_force_host_platform_device_count=\d+\s*", "",
             env["XLA_FLAGS"])
+    if stripe_ratio is not None:
+        # the ratio sweep's per-invocation knob: workers read it at
+        # communicator construction (ISSUE 11)
+        env["CHAINERMN_TPU_STRIPE_RATIO"] = str(stripe_ratio)
     if 1 not in proc_counts:
         # the per-hop summary is defined relative to the 1-process step;
         # computing it against rows[0] at some other count would publish
@@ -534,8 +554,9 @@ def main():
     parser.add_argument("--gloo-exchange", default="flat",
                         help="gradient-exchange structure under test: "
                              "per_leaf|flat|bucketed|reduce_scatter|"
-                             "hierarchical|hierarchical_rs (validated "
-                             "against communicators.EXCHANGES — the "
+                             "hierarchical|hierarchical_rs|striped|"
+                             "striped_rs (validated against "
+                             "communicators.EXCHANGES — the "
                              "ISSUE 5 exposed-comm A/B: run the curve "
                              "once with flat, once with bucketed — the "
                              "delta across real process boundaries is "
@@ -543,7 +564,18 @@ def main():
                              "hierarchical legs run the two-level "
                              "exchange with the DCN hop on the real "
                              "process boundary: dcn=P × ici=1 at one "
-                             "device per process)")
+                             "device per process; the ISSUE 11 striped "
+                             "legs run the multi-path exchange — sweep "
+                             "--stripe-ratio over {0.25, 0.5, 0.75} to "
+                             "measure the per-topology split a pod "
+                             "should commit)")
+    parser.add_argument("--stripe-ratio", type=float, default=None,
+                        help="DCN share of the striped exchange for "
+                             "this invocation (striped legs only; "
+                             "default: the committed "
+                             "DEFAULT_STRIPE_RATIO).  The first-chip-"
+                             "contact queue runs the {0.25, 0.5, 0.75} "
+                             "sweep as three invocations")
     args = parser.parse_args()
 
     if args.gloo_worker:
@@ -572,7 +604,8 @@ def main():
                          f"{args.gloo_exchange!r} "
                          f"({'|'.join(EXCHANGES)})")
         if args.gloo_zero and args.gloo_exchange in ("reduce_scatter",
-                                                     "hierarchical_rs"):
+                                                     "hierarchical_rs",
+                                                     "striped_rs"):
             # fail before any worker spawns: every worker would raise
             # create_multi_node_optimizer's zero×reduce_scatter
             # ValueError after ports are bound and gloo is up — in the
@@ -580,10 +613,15 @@ def main():
             parser.error("--gloo-zero already exchanges gradients via "
                          "reduce-scatter; drop --gloo-exchange "
                          f"{args.gloo_exchange}")
+        if args.stripe_ratio is not None \
+                and args.gloo_exchange not in ("striped", "striped_rs"):
+            parser.error("--stripe-ratio only applies to the striped "
+                         "legs; drop it or use --gloo-exchange striped")
         counts = [int(c) for c in args.gloo_procs.split(",")]
         _run_gloo_curve(counts, args.per_chip_bs, args.gloo_hidden,
                         args.steps, zero=args.gloo_zero,
-                        reps=args.gloo_reps, exchange=args.gloo_exchange)
+                        reps=args.gloo_reps, exchange=args.gloo_exchange,
+                        stripe_ratio=args.stripe_ratio)
         return
 
     if args.project:

@@ -78,7 +78,7 @@ _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
 #: gradient-exchange vocabulary shared by bench rows, the gloo A/B, and
 #: tools/comm_budgets.json configs
 EXCHANGES = ("per_leaf", "flat", "bucketed", "reduce_scatter",
-             "hierarchical", "hierarchical_rs")
+             "hierarchical", "hierarchical_rs", "striped", "striped_rs")
 
 
 def exchange_knobs(exchange):
@@ -92,7 +92,13 @@ def exchange_knobs(exchange):
     ``hierarchical`` is the two-level (ici × dcn) allreduce exchange;
     ``hierarchical_rs`` composes it with the reduce-scatter DP update
     (both hops reduce-scatter the gradient, both all-gather the
-    params)."""
+    params).  ``striped``/``striped_rs`` (ISSUE 11) are the multi-path
+    variants of those two: same communicator name, but the caller must
+    additionally pass a nonzero ``stripe_ratio`` to
+    ``create_communicator`` (bench surfaces default it to
+    ``DEFAULT_STRIPE_RATIO`` / the ``BENCH_STRIPE_RATIO`` /
+    ``CHAINERMN_TPU_STRIPE_RATIO`` knobs) — a zero ratio would silently
+    measure the strict hierarchical schedule under the striped name."""
     try:
         name, bc = {
             "per_leaf": ("jax_ici", False),
@@ -101,12 +107,15 @@ def exchange_knobs(exchange):
             "reduce_scatter": ("jax_ici", True),
             "hierarchical": ("hierarchical", True),
             "hierarchical_rs": ("hierarchical", True),
+            "striped": ("hierarchical", True),
+            "striped_rs": ("hierarchical", True),
         }[exchange]
     except KeyError:
         raise ValueError(f"unknown exchange {exchange!r} "
                          f"({'|'.join(EXCHANGES)})") from None
     return name, bc, ("reduce_scatter"
-                      if exchange in ("reduce_scatter", "hierarchical_rs")
+                      if exchange in ("reduce_scatter", "hierarchical_rs",
+                                      "striped_rs")
                       else "allreduce")
 
 
@@ -114,7 +123,8 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                         axis_name="mn_world", allreduce_grad_dtype=None,
                         batch_collectives=None, bucket_mb=None,
                         fault_schedule=None, intra_size=None,
-                        inter_size=None, error_feedback=True, **kwargs):
+                        inter_size=None, error_feedback=True,
+                        stripe_ratio=None, **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
@@ -141,9 +151,18 @@ def create_communicator(communicator_name="jax_ici", devices=None,
     two-level rs/allreduce/ag).  ``intra_size``/``inter_size``: force
     the (dcn, ici) split of the hierarchical flavors instead of
     inferring it from the controller topology (the simulated-multihost
-    knob tier-1 uses).  ``CHAINERMN_TPU_HIERARCHY=flat`` collapses
+    knob tier-1 uses).  ``stripe_ratio`` (ISSUE 11, hierarchical
+    flavors only; ``CHAINERMN_TPU_STRIPE_RATIO`` is the no-code-change
+    env knob): the DCN share of each bucket's payload in the STRIPED
+    multi-path exchange — that slice runs the transposed slow-hop-major
+    exchange concurrently with the fast-hop-major remainder, so both
+    fabrics carry bulk traffic at once instead of hierarchically
+    (docs/performance.md §10; 0 = the strict hierarchical schedule;
+    the committed per-topology value comes from the ``bench_scaling``
+    striped ratio sweep).  ``CHAINERMN_TPU_HIERARCHY=flat`` collapses
     ``hierarchical``/``two_dimensional`` back to the flat one-axis
-    alias (sizes ignored) — the no-code-change escape hatch.
+    alias (sizes ignored, striping dropped — one fabric has no second
+    path) — the no-code-change escape hatch.
     ``fault_schedule`` (``fault`` name only): a :class:`FaultSchedule` or
     spec dict; defaults to ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the
     environment — the chaos harness's entry point (see
@@ -174,7 +193,8 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             allreduce_grad_dtype=allreduce_grad_dtype,
             batch_collectives=batch_collectives, bucket_mb=bucket_mb,
             intra_size=intra_size, inter_size=inter_size,
-            error_feedback=error_feedback, **kwargs)
+            error_feedback=error_feedback, stripe_ratio=stripe_ratio,
+            **kwargs)
         # the hc.* transport hook gets its own schedule CLONE (same
         # specs + seed, separate RNG stream/counters): transport call
         # counts are inherently per-rank asymmetric (root puts,
@@ -247,6 +267,18 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                     allreduce_grad_dtype, chosen_key, dropped)
                 allreduce_grad_dtype = (allreduce_grad_dtype.get("dcn")
                                         or allreduce_grad_dtype.get("ici"))
+            try:
+                eff_stripe = stripe_ratio if stripe_ratio is not None \
+                    else float(os.environ.get(
+                        "CHAINERMN_TPU_STRIPE_RATIO", "") or 0)
+            except ValueError:
+                eff_stripe = 0
+            if eff_stripe:
+                # striping needs two fabrics; the flat alias has one.
+                # NOT silent (same contract as the per-hop dict
+                # degradation): the caller asked for multi-path wire
+                # use and gets the flat single-path exchange instead
+                _warn_hierarchy_flat_stripe_dropped(eff_stripe)
             return MeshCommunicator(
                 devices=devices, axis_name=axis_name,
                 allreduce_grad_dtype=allreduce_grad_dtype,
@@ -258,12 +290,30 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                             batch_collectives=batch_collectives,
                             bucket_mb=bucket_mb, name=name,
                             intra_size=intra_size, inter_size=inter_size,
-                            error_feedback=error_feedback)
+                            error_feedback=error_feedback,
+                            stripe_ratio=stripe_ratio)
 
 
 #: distinct degraded dicts already warned about (one-time per intent —
 #: a training loop constructing communicators repeatedly must not spam)
 _WARNED_FLAT_DICTS = set()
+
+#: stripe ratios already warned about under the flat escape hatch
+_WARNED_FLAT_STRIPES = set()
+
+
+def _warn_hierarchy_flat_stripe_dropped(stripe_ratio):
+    import warnings
+    if stripe_ratio in _WARNED_FLAT_STRIPES:
+        return
+    _WARNED_FLAT_STRIPES.add(stripe_ratio)
+    warnings.warn(
+        f"CHAINERMN_TPU_HIERARCHY=flat drops stripe_ratio="
+        f"{stripe_ratio}: the flat one-axis alias has a single fabric, "
+        f"so the multi-path striped exchange degrades to the flat "
+        f"single-path allreduce.  Unset CHAINERMN_TPU_HIERARCHY to "
+        f"restore the striped two-fabric schedule.",
+        UserWarning, stacklevel=3)
 
 
 def _warn_hierarchy_flat_dict_degraded(dtype_dict, chosen_key, dropped):

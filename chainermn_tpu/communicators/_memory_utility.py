@@ -27,8 +27,9 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
-           "plan_buckets", "bucket_table", "hop_schedule",
+           "plan_buckets", "bucket_table", "hop_schedule", "stripe_plan",
            "exchanged_bytes", "hierarchical_exchanged_bytes",
+           "striped_exchanged_bytes",
            "pad_to_multiple", "QUANTIZED_DTYPES", "resolve_grad_dtype",
            "is_quantized_dtype", "quantize_symmetric",
            "dequantize_symmetric", "quantization_residual",
@@ -39,6 +40,15 @@ __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
 #: allreduce chunking; ~4 MB keeps each collective large enough to hit
 #: ring bandwidth while leaving several schedulable units per step)
 DEFAULT_BUCKET_MB = 4.0
+
+#: default DCN share of the striped exchange (ISSUE 11) —
+#: ``CHAINERMN_TPU_STRIPE_RATIO`` / ``create_communicator(stripe_ratio=)``
+#: override.  Like ``bucket_mb`` this is a committed-per-topology knob:
+#: the right value is the slow fabric's share of the mesh's aggregate
+#: bandwidth, measured by the ``bench_scaling --gloo-exchange striped``
+#: ratio sweep {0.25, 0.5, 0.75} queued for first chip contact.  0.25
+#: is the conservative pre-measurement seed (DCN is the narrow fabric).
+DEFAULT_STRIPE_RATIO = 0.25
 
 
 def tree_pack(tree, dtype=None):
@@ -99,13 +109,14 @@ def plan_buckets(shapes, dtypes, bucket_bytes):
     return buckets
 
 
-def hop_schedule(n_buckets):
+def hop_schedule(n_buckets, mode="hierarchical"):
     """Emission schedule of the two-level (ici × dcn) bucketed exchange:
-    ordered ``(op, bucket)`` pairs the hierarchical ``grad_transform``
-    follows literally, so the slow-hop-first property is a tested pure
-    function rather than an accident of loop structure.
+    ordered ``(op, bucket)`` pairs the hierarchical/striped
+    ``grad_transform`` follows literally, so the ordering properties are
+    a tested pure function rather than an accident of loop structure.
 
-    Ops per bucket: ``"ici_reduce_scatter"`` (fast hop, full bucket) →
+    ``mode="hierarchical"`` (the strict two-level exchange, ISSUE 6) —
+    ops per bucket: ``"ici_reduce_scatter"`` (fast hop, full bucket) →
     ``"dcn_exchange"`` (slow hop, the 1/intra chunk) →
     ``"ici_all_gather"`` (fast hop, rebuild).  Ordering contract
     (HiCCL / the multi-process-per-GPU allreduce paper's hop-overlap
@@ -120,16 +131,85 @@ def hop_schedule(n_buckets):
       starts as early as dataflow allows and the ICI all-gathers
       overlap the remaining DCN traffic instead of serializing ahead
       of it.
+
+    ``mode="striped"`` (ISSUE 11, the multi-path exchange) — each
+    bucket's payload is split by :func:`stripe_plan` into an ICI-path
+    slice (fast-hop-major exchange: rs over ICI → chunk crossing over
+    DCN → ag over ICI) and a DCN-path slice (the TRANSPOSED, slow-hop-
+    major exchange: rs over DCN → chunk crossing over ICI → ag over
+    DCN), so both fabrics carry bulk traffic at the same time instead
+    of hierarchically (FlexLink's use-every-link-at-once result).  Ops
+    per bucket: ``dcn_path_scatter`` → ``ici_path_scatter`` →
+    ``dcn_path_exchange`` → ``ici_path_exchange``, then per-bucket
+    epilogue ``dcn_path_gather`` → ``ici_path_gather``.  Ordering
+    contract, generalized from the hierarchical one:
+
+    * within a bucket and phase, the SLOW path's op is issued first
+      (its wire is the long pole);
+    * per path, dataflow order holds (scatter < exchange < gather);
+    * BOTH paths' scatter+exchange ops of every bucket precede ANY
+      bucket's gather epilogue — the two paths are concurrently
+      eligible end to end, and the rebuilds overlap whatever bulk
+      traffic is still draining on either fabric.  This is the
+      per-path ordering the generalized census ``hop_ordered`` gate
+      validates.
     """
     if n_buckets < 0:
         raise ValueError(f"n_buckets must be >= 0, got {n_buckets}")
+    if mode not in ("hierarchical", "striped"):
+        raise ValueError(f"unknown hop_schedule mode {mode!r}")
     schedule = []
+    if mode == "striped":
+        for b in range(n_buckets):
+            schedule.append(("dcn_path_scatter", b))
+            schedule.append(("ici_path_scatter", b))
+            schedule.append(("dcn_path_exchange", b))
+            schedule.append(("ici_path_exchange", b))
+        for b in range(n_buckets):
+            schedule.append(("dcn_path_gather", b))
+            schedule.append(("ici_path_gather", b))
+        return schedule
     for b in range(n_buckets):
         schedule.append(("ici_reduce_scatter", b))
         schedule.append(("dcn_exchange", b))
     for b in range(n_buckets):
         schedule.append(("ici_all_gather", b))
     return schedule
+
+
+def stripe_plan(n_elems, ratio):
+    """Contiguous two-slice split of a bucket's flat payload for the
+    striped exchange: ``(ici_elems, dcn_elems)`` with the ICI-path slice
+    at ``flat[:ici_elems]`` and the DCN-path slice at
+    ``flat[ici_elems:]``.
+
+    Deterministic pure function of ``(n_elems, ratio)`` — every rank
+    traces the identical split, the same cross-process contract
+    :func:`plan_buckets` carries.  Properties, pinned by
+    tests/communicator_tests:
+
+    * every element lands in exactly one slice
+      (``ici_elems + dcn_elems == n_elems``);
+    * both slices are contiguous (one split point — the pack stays two
+      cheap dynamic slices, never a gather);
+    * the DCN share is the committed ratio rounded to whole elements
+      (``dcn_elems == round(ratio * n_elems)``);
+    * degenerate ratios collapse to a single path: ``ratio == 0`` is
+      the strict hierarchical exchange (everything fast-hop-major),
+      ``ratio == 1`` routes the whole payload over the slow-hop-major
+      path (the flat-one-fabric shape with DCN as the bulk wire).
+
+    The ratio itself is a committed per-topology constant (like
+    ``bucket_mb``): the ``bench_scaling --gloo-exchange striped`` ratio
+    sweep measures the real bandwidth split on ≥2 hosts and first chip
+    contact commits the winner.
+    """
+    if not 0.0 <= ratio <= 1.0:
+        raise ValueError(f"stripe ratio must be in [0, 1], got {ratio}")
+    if n_elems < 0:
+        raise ValueError(f"n_elems must be >= 0, got {n_elems}")
+    dcn_elems = int(round(ratio * n_elems))
+    return n_elems - dcn_elems, dcn_elems
 
 
 def pad_to_multiple(flat, multiple):
@@ -386,6 +466,76 @@ def hierarchical_exchanged_bytes(n_bytes, intra_size, inter_size,
     if collective in ("reduce_scatter", "all_gather"):
         return {"ici": ici, "dcn": dcn}
     raise ValueError(f"unknown collective {collective!r}")
+
+
+def striped_exchanged_bytes(n_bytes, intra_size, inter_size, ratio,
+                            itemsize=4, dcn_itemsize=None):
+    """Per-replica wire bytes of the STRIPED exchange (ISSUE 11) on an
+    ``n_bytes`` full buffer, split by PATH and by FABRIC::
+
+        {"ici_path": {"ici": ..., "dcn": ..., "total": ...},
+         "dcn_path": {"ici": ..., "dcn": ..., "total": ...}}
+
+    The ICI-path slice (share ``1 - ratio``) runs the fast-hop-major
+    exchange — its bulk (rs + ag) rides ICI, only its ``1/intra`` chunk
+    allreduce crosses DCN.  The DCN-path slice (share ``ratio``) runs
+    the TRANSPOSED slow-hop-major exchange — its bulk rides DCN, only
+    its ``1/inter`` chunk allreduce crosses ICI.  Each path is priced by
+    :func:`hierarchical_exchanged_bytes` with its own (fast, slow)
+    orientation.
+
+    Identities, pinned by tests (exact when the split divides cleanly;
+    each slice otherwise pads to its ring multiple exactly like the
+    wire does — ``pad_to_multiple`` before the bulk scatter — so the
+    figures track the traced program, with the usual pad slack):
+
+    * **conservation**: ``ici_path.total + dcn_path.total`` equals the
+      flat allreduce's per-replica figure over ``intra × inter`` ranks
+      (each path's hop totals already telescope to the flat ring figure
+      for its slice — striping relocates bytes, it adds none);
+    * **committed share**: ``dcn_path.total / grand total == ratio`` —
+      per-path totals are proportional to slice sizes, so the DCN
+      path's byte share IS the committed split ratio.
+
+    ``dcn_itemsize`` prices only the DCN-fabric crossings at a
+    different wire dtype (the per-hop-dtype variant: the ICI-path
+    chunk's DCN allreduce AND the DCN-path slice's bulk rs/ag both ride
+    the compressed wire, ICI stays lossless).  The DCN-path slice's ICI
+    chunk crossing is always priced at f32 — the transform upcasts it
+    before the fast-hop allreduce (lossless-over-ICI by design).
+
+    This is the ONE per-path pricing surface: bench.py's striped rows
+    route through it, so the committed identities and the bench
+    columns cannot drift apart.
+    """
+    elems = n_bytes // itemsize
+    if elems * itemsize != n_bytes:
+        raise ValueError(
+            f"n_bytes={n_bytes} is not a multiple of itemsize={itemsize}")
+    ici_elems, dcn_elems = stripe_plan(elems, ratio)
+    n_i = -(-ici_elems // intra_size) * intra_size * itemsize
+    n_d = -(-dcn_elems // inter_size) * inter_size * itemsize
+    dcn_scale = (dcn_itemsize / itemsize) if dcn_itemsize else 1.0
+    # fast-hop-major path: hierarchical_exchanged_bytes as-is (the
+    # per-hop-dtype override compresses only its DCN chunk crossing)
+    a = hierarchical_exchanged_bytes(
+        n_i, intra_size, inter_size, "psum",
+        dcn_n_bytes=int(n_i // intra_size * dcn_scale)
+        if dcn_itemsize else None) if n_i else {"ici": 0, "dcn": 0}
+    # slow-hop-major path: the same formula with the hops TRANSPOSED —
+    # its "intra" ring is the DCN axis (bulk rs+ag, compressed under the
+    # per-hop dtype) and its chunk crossing rides ICI (lossless by
+    # design: the chunk upcasts to f32 before the fast-hop allreduce);
+    # relabel the returned hops back to fabrics
+    b = hierarchical_exchanged_bytes(
+        int(n_d * dcn_scale), inter_size, intra_size, "psum",
+        dcn_n_bytes=n_d // itemsize // inter_size * 4) \
+        if n_d else {"ici": 0, "dcn": 0}
+    ici_path = {"ici": a["ici"], "dcn": a["dcn"]}
+    dcn_path = {"dcn": b["ici"], "ici": b["dcn"]}
+    for p in (ici_path, dcn_path):
+        p["total"] = p["ici"] + p["dcn"]
+    return {"ici_path": ici_path, "dcn_path": dcn_path}
 
 
 def pack_params(params, attr="grad", dtype=None):

@@ -102,7 +102,8 @@ class MeshCommunicator(CommunicatorBase):
     def __init__(self, devices=None, axis_name="mn_world",
                  allreduce_grad_dtype=None, batch_collectives=False,
                  bucket_mb=None, name="jax_ici", _mesh=None,
-                 intra_size=None, inter_size=None, error_feedback=True):
+                 intra_size=None, inter_size=None, error_feedback=True,
+                 stripe_ratio=None):
         self.name = name
         self.hierarchy = None
         self._hier_sizes = None
@@ -135,6 +136,29 @@ class MeshCommunicator(CommunicatorBase):
                                 int(self.mesh.shape[names[1]]))
             axis_name = names
         self.axis_name = axis_name
+        # striped multi-path exchange (ISSUE 11): the DCN share of each
+        # bucket's payload.  0 = the strict hierarchical schedule; the
+        # env knob is read at CONSTRUCTION time (like bucket_mb) and
+        # only where it can matter — a hierarchical mesh.  A flat
+        # communicator has ONE fabric: an explicit ratio there is a
+        # construction error, never a silent no-op.
+        if stripe_ratio is None and want_hier:
+            import os
+            raw = os.environ.get("CHAINERMN_TPU_STRIPE_RATIO", "").strip()
+            if raw:
+                stripe_ratio = float(raw)
+        if stripe_ratio is not None:
+            stripe_ratio = float(stripe_ratio)
+            if not 0.0 <= stripe_ratio <= 1.0:
+                raise ValueError(
+                    f"stripe_ratio must be in [0, 1], got {stripe_ratio}")
+            if stripe_ratio > 0 and self.hierarchy is None:
+                raise ValueError(
+                    "stripe_ratio needs a hierarchical communicator "
+                    "(name='hierarchical'/'two_dimensional' or an "
+                    "intra_size/inter_size split): a flat mesh has one "
+                    "fabric, there is nothing to stripe across")
+        self.stripe_ratio = float(stripe_ratio or 0.0)
         self.dcn_grad_dtype = None
         self.error_feedback = bool(error_feedback)
         from ._memory_utility import is_quantized_dtype, resolve_grad_dtype
@@ -401,6 +425,20 @@ class MeshCommunicator(CommunicatorBase):
         if self.hierarchy is not None:
             return P((self.ici_axis, self.dcn_axis))
         return P(self.axis_name)
+
+    def striped_chunk_specs(self):
+        """``(fast_major, slow_major)`` pair of chunk specs for the
+        STRIPED sharded update (ISSUE 11): the ICI-path slice's chained
+        reduce-scatter lands chunks fast-hop-major (== the
+        :meth:`flat_chunk_spec` layout) while the DCN-path slice's
+        transposed chain lands them slow-hop-major — the two flat
+        state vectors of the striped ZeRO layout each carry their own
+        spec."""
+        if self.hierarchy is None:
+            raise ValueError("striped chunk specs need a hierarchical "
+                             "communicator")
+        return (P((self.ici_axis, self.dcn_axis)),
+                P((self.dcn_axis, self.ici_axis)))
 
     # -- mode dispatch ---------------------------------------------------------
     def _axis_index(self):
@@ -753,11 +791,23 @@ class MeshCommunicator(CommunicatorBase):
 
     @property
     def topology(self):
-        """``"hierarchical"`` (two-level ici × dcn exchange) or
+        """``"striped"`` (multi-path ici ∥ dcn exchange, ISSUE 11),
+        ``"hierarchical"`` (strict two-level ici × dcn exchange) or
         ``"flat"`` (one mesh axis) — the topology column bench rows and
         the census carry, orthogonal to :attr:`exchange` (bucketing
-        composes with either topology)."""
-        return "hierarchical" if self.hierarchy is not None else "flat"
+        composes with any topology)."""
+        if self.hierarchy is None:
+            return "flat"
+        return "striped" if self.striped else "hierarchical"
+
+    @property
+    def striped(self):
+        """True when the gradient exchange stripes each bucket across
+        BOTH fabrics concurrently (ISSUE 11): a hierarchical mesh with
+        a nonzero :attr:`stripe_ratio`.  Ratio 0 is the strict
+        hierarchical schedule — the degenerate collapse
+        ``stripe_plan`` pins."""
+        return self.hierarchy is not None and self.stripe_ratio > 0
 
     # -- quantized wire (ISSUE 8) ------------------------------------------
     @property
@@ -793,9 +843,17 @@ class MeshCommunicator(CommunicatorBase):
         if self.quantized_wire_dtype is None:
             return 0
         total = 0
+        from ._memory_utility import stripe_plan
         for idx in self.grad_buckets(shapes, dtypes):
             elems = sum(int(np.prod(shapes[i])) for i in idx)
-            if self.hierarchy is not None:
+            if self.striped:
+                # per bucket: the DCN-path slice quantizes the full
+                # pre-reduction slice per device, the ICI-path slice
+                # quantizes its padded 1/ici chunk (layout: B then A —
+                # the schedule's consumption order)
+                n_i, n_d = stripe_plan(elems, self.stripe_ratio)
+                total += n_d + (-(-n_i // self.ici_size) if n_i else 0)
+            elif self.hierarchy is not None:
                 intra = self.ici_size
                 total += -(-elems // intra)
             else:
@@ -814,6 +872,26 @@ class MeshCommunicator(CommunicatorBase):
                 and not is_quantized_dtype(self.allreduce_grad_dtype):
             dtypes = [self.allreduce_grad_dtype] * len(dtypes)
         return self.grad_residual_len(shapes, dtypes)
+
+    def grad_dcn_stale_len_for(self, model):
+        """Length of the DCN-slice-only stale buffer the
+        ``double_buffering="dcn"`` variant threads (ISSUE 11): the
+        DCN-path slice elements of every bucket, concatenated in plan
+        order — the slow path's one-step-stale footprint, a
+        ``stripe_ratio`` fraction of a full stale buffer.  0 on
+        non-striped communicators."""
+        if not self.striped:
+            return 0
+        from ._memory_utility import is_quantized_dtype, stripe_plan
+        shapes, dtypes = self.grad_leaf_specs(model)
+        if self.allreduce_grad_dtype is not None \
+                and not is_quantized_dtype(self.allreduce_grad_dtype):
+            dtypes = [self.allreduce_grad_dtype] * len(dtypes)
+        total = 0
+        for idx in self.grad_buckets(shapes, dtypes):
+            elems = sum(int(np.prod(shapes[i])) for i in idx)
+            total += stripe_plan(elems, self.stripe_ratio)[1]
+        return total
 
     def grad_buckets(self, shapes, dtypes):
         """The bucket plan this communicator's ``grad_transform`` traces
@@ -887,6 +965,8 @@ class MeshCommunicator(CommunicatorBase):
         legacy 1-arg callers get inline quantization with the residual
         discarded (error feedback off for that call).
         """
+        if self.striped:
+            return self._striped_grad_transform()
         if self.hierarchy is not None:
             return self._hierarchical_grad_transform()
         from ._memory_utility import is_quantized_dtype
@@ -1101,6 +1181,243 @@ class MeshCommunicator(CommunicatorBase):
 
         return transform
 
+    def _striped_grad_transform(self):
+        """The multi-path striped exchange (ISSUE 11): each bucket's
+        flat payload splits by ``stripe_plan(n, stripe_ratio)`` into an
+        ICI-path slice and a DCN-path slice, and BOTH fabrics carry
+        bulk traffic at once instead of hierarchically (FlexLink's
+        use-every-link-simultaneously result; HiCCL-style compositional
+        schedule — the plan is the pure function
+        ``hop_schedule(k, mode="striped")`` and emission follows it
+        literally).
+
+        * **ICI path** (share ``1 − ratio``): the PR 6 fast-hop-major
+          exchange — ``psum_scatter`` over ICI → chunk allreduce over
+          DCN (per-hop dtype / int8+EF quantization apply here exactly
+          as on the hierarchical exchange) → ``all_gather`` over ICI.
+        * **DCN path** (share ``ratio``): the TRANSPOSED slow-hop-major
+          exchange — ``psum_scatter`` over DCN (the bulk rides the slow
+          wire, compressed under the per-hop dtype) → chunk allreduce
+          over ICI (lossless by design: the chunk upcasts to f32 before
+          the fast hop) → ``all_gather`` over DCN.  With a QUANTIZED
+          ``dcn_grad_dtype`` the slow wire cannot carry a psum_scatter
+          of codewords, so the path reshapes to lossless ``psum`` over
+          ICI first, then quantize (+ error feedback) →
+          ``all_gather(q + scale)`` over DCN → dequantize-sum — the
+          DynamiQ gather shape on the slice's single slow crossing.
+
+        Both paths' scatter+exchange ops are emitted before ANY
+        bucket's gather epilogue (the generalized hop_schedule
+        contract), so XLA's async scheduler can drain the two fabrics
+        concurrently.
+
+        ``stale_dcn`` (the DCN-slice-only double-buffering variant,
+        ``double_buffering="dcn"``): the assembled gradient uses the
+        PREVIOUS step's DCN-path results while this step's fresh
+        DCN-path values are returned (appended last) to become the next
+        stale buffer — the PR 5/6 one-step-stale contract applied
+        per-path, hiding the slow path's latency entirely behind
+        compute while the ICI path stays fresh.  Return shape:
+        ``grads`` | ``(grads, new_residual)`` | ``(grads, fresh_dcn)``
+        | ``(grads, new_residual, fresh_dcn)`` depending on which
+        optional operands were threaded.
+        """
+        ici, dcn = self.ici_axis, self.dcn_axis
+        intra, inter = self.ici_size, self.dcn_size
+        size = self.size
+        ratio = self.stripe_ratio
+        dtype = self.allreduce_grad_dtype
+        dcn_dtype = self.dcn_grad_dtype
+        from ._memory_utility import is_quantized_dtype
+        q_dcn = is_quantized_dtype(dcn_dtype)
+        comm = self
+
+        def transform(grads, residual=None, stale_dcn=None):
+            from ._memory_utility import (dequantize_sum, hop_schedule,
+                                          pad_to_multiple,
+                                          quantize_with_feedback,
+                                          stripe_plan, tree_pack,
+                                          tree_unpack)
+            if residual is None and q_dcn and comm.error_feedback:
+                _warn_inert_error_feedback()
+            leaves, treedef = jax.tree.flatten(grads)
+            if not leaves:
+                out = [grads]
+                if residual is not None:
+                    out.append(residual)
+                if stale_dcn is not None:
+                    out.append(stale_dcn)
+                return out[0] if len(out) == 1 else tuple(out)
+            orig_dtypes = [g.dtype for g in leaves]
+            if dtype is not None:
+                leaves = [g.astype(dtype) for g in leaves]
+            buckets = comm.grad_buckets([g.shape for g in leaves],
+                                        [g.dtype for g in leaves])
+            # pre-pass: per-bucket split sizes and the residual /
+            # stale-buffer offsets (pure python over the plan — the
+            # schedule consumes buckets out of offset order, so a
+            # running counter cannot work)
+            n_i, n_d, chunk_a = [], [], []
+            off_a, off_b, off_s = [], [], []
+            r_off = s_off = 0
+            for idx in buckets:
+                n_b = sum(int(np.prod(leaves[i].shape)) for i in idx)
+                a, d = stripe_plan(n_b, ratio)
+                n_i.append(a)
+                n_d.append(d)
+                chunk_a.append(-(-a // intra) if a else 0)
+                off_a.append(r_off + d)   # residual layout per bucket:
+                off_b.append(r_off)       # [B slice, then A chunk] —
+                r_off += d + chunk_a[-1]  # consumption order of the
+                off_s.append(s_off)       # schedule (dcn path first)
+                s_off += d
+            out = [None] * len(leaves)
+            specs = {}
+            a_chunk = {}
+            b_chunk = {}
+            b_full = {}
+            new_res = {}
+            fresh_b = {}
+            for op, b in hop_schedule(len(buckets), mode="striped"):
+                idx = buckets[b]
+                if op == "dcn_path_scatter":
+                    with jax.named_scope("mn_stripe_pack_scatter_dcn"):
+                        flat, spec = tree_pack([leaves[i] for i in idx])
+                        specs[b] = (spec, flat.dtype)
+                        a_flat = flat[:n_i[b]]
+                        b_slice = flat[n_i[b]:]
+                        a_chunk[b] = a_flat  # scattered at ici_path_scatter
+                        if not n_d[b]:
+                            continue
+                        if q_dcn:
+                            # quantized slow wire: each device quantizes
+                            # its OWN pre-reduction slice (+ its own
+                            # error-feedback residual — quantizing after
+                            # any cross-device reduce would mix distinct
+                            # residuals into codewords that disagree
+                            # across the ICI axis and de-replicate the
+                            # params), and the slice's single DCN
+                            # crossing is this gather of codewords —
+                            # issued FIRST in the bucket, so the slow
+                            # wire starts as early as possible
+                            r = None
+                            if residual is not None:
+                                r = residual[off_b[b]:off_b[b] + n_d[b]]
+                            q, scale, nr = quantize_with_feedback(
+                                b_slice, r, dcn_dtype)
+                            if nr is not None:
+                                new_res[(b, "b")] = nr
+                            b_chunk[b] = (lax.all_gather(q, dcn),
+                                          lax.all_gather(scale, dcn))
+                        else:
+                            b_pad, _ = pad_to_multiple(b_slice, inter)
+                            if dcn_dtype is not None:
+                                b_pad = b_pad.astype(dcn_dtype)
+                            b_chunk[b] = lax.psum_scatter(
+                                b_pad, dcn, scatter_dimension=0,
+                                tiled=True)
+                elif op == "ici_path_scatter":
+                    if not n_i[b]:
+                        continue
+                    with jax.named_scope("mn_stripe_rs_ici"):
+                        a_pad, _ = pad_to_multiple(a_chunk[b], intra)
+                        a_chunk[b] = lax.psum_scatter(
+                            a_pad, ici, scatter_dimension=0, tiled=True)
+                elif op == "dcn_path_exchange":
+                    if not n_d[b]:
+                        continue
+                    if q_dcn:
+                        with jax.named_scope("mn_stripe_dequant_psum_ici"):
+                            # decode every DCN group's (q, scale) pair,
+                            # then finish the reduction across ICI in
+                            # f32 — the lossless fast hop, same
+                            # contract as the hierarchical exchange
+                            qg, sg = b_chunk[b]
+                            s = dequantize_sum(qg, sg)
+                            b_full[b] = lax.psum(s, ici) / size
+                    else:
+                        with jax.named_scope("mn_stripe_allreduce_ici"):
+                            # the DCN-path chunk's cross-fabric
+                            # allreduce rides the LOSSLESS fast hop:
+                            # upcast to f32 before accumulating
+                            c = lax.psum(
+                                b_chunk[b].astype(jnp.float32), ici)
+                            b_chunk[b] = c / size
+                elif op == "ici_path_exchange":
+                    if not n_i[b]:
+                        continue
+                    c = a_chunk[b]
+                    wire = c.dtype
+                    if q_dcn:
+                        with jax.named_scope("mn_stripe_quantized_chunk"):
+                            n = c.shape[0]
+                            r = None
+                            if residual is not None:
+                                r = residual[off_a[b]:off_a[b] + n]
+                            q, scale, nr = quantize_with_feedback(
+                                c, r, dcn_dtype)
+                            if nr is not None:
+                                new_res[(b, "a")] = nr
+                            qg = lax.all_gather(q, dcn)
+                            sg = lax.all_gather(scale, dcn)
+                            a_chunk[b] = (dequantize_sum(qg, sg)
+                                          / size).astype(wire)
+                    else:
+                        with jax.named_scope("mn_stripe_allreduce_dcn"):
+                            if dcn_dtype is not None:
+                                c = c.astype(dcn_dtype)
+                            c = lax.psum(c, dcn)
+                            a_chunk[b] = c.astype(wire) / size
+                elif op == "dcn_path_gather":
+                    if not n_d[b] or q_dcn:
+                        continue  # quantized path is already full
+                    with jax.named_scope("mn_stripe_ag_dcn"):
+                        c = b_chunk[b]
+                        if dcn_dtype is not None:
+                            c = c.astype(dcn_dtype)
+                        full = lax.all_gather(c, dcn, tiled=True)
+                        b_full[b] = full[:n_d[b]].astype(jnp.float32)
+                else:  # ici_path_gather: rebuild + assemble the bucket
+                    spec, wire = specs[b]
+                    parts = []
+                    if n_i[b]:
+                        with jax.named_scope("mn_stripe_ag_ici"):
+                            full = lax.all_gather(a_chunk[b], ici,
+                                                  tiled=True)
+                        parts.append(full[:n_i[b]].astype(wire))
+                    if n_d[b]:
+                        fresh = b_full[b].astype(wire)
+                        if stale_dcn is not None:
+                            fresh_b[b] = fresh.astype(jnp.float32)
+                            applied = stale_dcn[
+                                off_s[b]:off_s[b] + n_d[b]].astype(wire)
+                        else:
+                            applied = fresh
+                        parts.append(applied)
+                    flat = parts[0] if len(parts) == 1 \
+                        else jnp.concatenate(parts)
+                    for i, g in zip(idx, tree_unpack(flat, spec)):
+                        out[i] = g
+            leaves = [g.astype(d) for g, d in zip(out, orig_dtypes)]
+            grads = jax.tree.unflatten(treedef, leaves)
+            ret = [grads]
+            if residual is not None:
+                res_parts = []
+                for b in range(len(buckets)):
+                    if (b, "b") in new_res:
+                        res_parts.append(new_res[(b, "b")])
+                    if (b, "a") in new_res:
+                        res_parts.append(new_res[(b, "a")])
+                ret.append(jnp.concatenate(res_parts) if res_parts
+                           else residual)
+            if stale_dcn is not None:
+                ret.append(jnp.concatenate(
+                    [fresh_b[b] for b in range(len(buckets))
+                     if b in fresh_b]) if fresh_b else stale_dcn)
+            return ret[0] if len(ret) == 1 else tuple(ret)
+
+        return transform
+
     # -- SPMD launcher ----------------------------------------------------------------
     def run_spmd(self, fn, *args, in_specs=None, out_specs=None,
                  static_out=False):
@@ -1225,6 +1542,8 @@ class MeshCommunicator(CommunicatorBase):
     def __repr__(self):
         topo = (f" hierarchy={self.dcn_size}x{self.ici_size}"
                 if self.hierarchy is not None else "")
+        if self.striped:
+            topo += f" stripe_ratio={self.stripe_ratio}"
         return (f"<{type(self).__name__} name={self.name!r} size={self.size} "
                 f"axis={self.axis_name!r}{topo} "
                 f"grad_dtype={self.allreduce_grad_dtype}>")

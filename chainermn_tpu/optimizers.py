@@ -141,18 +141,54 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
             f"exchange must be 'allreduce' or 'reduce_scatter', got "
             f"{exchange!r} (per_leaf/flat/bucketed are communicator "
             f"batch_collectives flavors of the allreduce exchange)")
+    if (exchange == "reduce_scatter" or zero_sharding) \
+            and getattr(communicator, "striped", False) \
+            and getattr(communicator, "quantized_wire_dtype", None) \
+            is not None:
+        # covers BOTH sharded-update routes (zero_sharding and the
+        # plain-DP reduce-scatter exchange share _make_zero_update): a
+        # quantized dtype reaching the striped chains would raw-cast
+        # gradients to int8 with no scale or residual — silent
+        # corruption, never acceptable
+        raise ValueError(
+            "a quantized (int8/fp8) wire does not compose with the "
+            "STRIPED sharded update (zero_sharding or "
+            "exchange='reduce_scatter') yet: the slow-hop-major "
+            "chain has no quantized psum_scatter shape.  Use the "
+            "allreduce striped exchange (which quantizes both slices' "
+            "DCN crossings) or the non-striped hierarchical_rs path")
     if zero_sharding and exchange == "reduce_scatter":
         raise ValueError(
             "zero_sharding already exchanges gradients via reduce-scatter; "
             "exchange='reduce_scatter' on top of it is a redundancy error "
             "(pick one: zero_sharding=True for the ZeRO-1 contract, "
             "exchange='reduce_scatter' for the comm-optimal plain-DP step)")
+    if double_buffering not in (False, True, "dcn"):
+        raise ValueError(
+            f"double_buffering must be False, True (full one-step-stale "
+            f"semantics) or 'dcn' (the striped exchange's DCN-slice-only "
+            f"stale variant, ISSUE 11); got {double_buffering!r}")
     if double_buffering:
         if zero_sharding:
             raise ValueError(
                 "zero_sharding is incompatible with double buffering "
                 "(a one-step-stale FULL gradient buffer would defeat "
                 "the sharded-state memory contract)")
+        if double_buffering == "dcn":
+            if not getattr(communicator, "striped", False):
+                raise ValueError(
+                    "double_buffering='dcn' is the striped exchange's "
+                    "DCN-slice-only stale variant: it needs a "
+                    "communicator with stripe_ratio > 0 "
+                    "(create_communicator('hierarchical', "
+                    "stripe_ratio=...))")
+            if exchange == "reduce_scatter":
+                raise ValueError(
+                    "double_buffering='dcn' rides the allreduce striped "
+                    "exchange (the DCN-path slice of grad_transform); "
+                    "with exchange='reduce_scatter' use "
+                    "double_buffering=True — the stale chunk is already "
+                    "1/n-sized")
         if communicator.name not in ("pure_nccl", "jax_ici", "hierarchical",
                                      "two_dimensional", "single_node", "flat",
                                      "dummy"):
@@ -161,7 +197,8 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
                 "double buffering requires a fused-bucket communicator "
                 f"(reference: pure_nccl); got {communicator.name!r}")
         return _DoubleBufferingOptimizer(actual_optimizer, communicator,
-                                         zero_fill, exchange=exchange)
+                                         zero_fill, exchange=exchange,
+                                         db_mode=double_buffering)
     return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill,
                                zero_sharding=zero_sharding,
                                exchange=exchange)
@@ -182,6 +219,15 @@ class _MultiNodeOptimizer:
         super().__setattr__("_residual", None)  # error-feedback slot
 
     _double_buffering = False
+    #: "dcn" on the striped DCN-slice-only stale variant (ISSUE 11) —
+    #: the update applies FRESH ICI-path gradients and one-step-stale
+    #: DCN-path gradients, so the slow path's latency hides entirely
+    #: behind compute while the fast path stays exact
+    _db_mode = False
+
+    @property
+    def _db_dcn(self):
+        return self._db_mode == "dcn"
 
     @property
     def _needs_residual(self):
@@ -307,6 +353,24 @@ class _MultiNodeOptimizer:
                 old_state = actual._opt_state
         else:
             old_state = None
+        if old_state is not None and (
+                (getattr(old, "striped", False),
+                 getattr(old, "stripe_ratio", 0.0))
+                != (getattr(communicator, "striped", False),
+                    getattr(communicator, "stripe_ratio", 0.0))):
+            # the striped pair layout's split point moves with the
+            # ratio and its leaves are keyed per path — a cross-
+            # topology in-memory re-commit would silently mis-slice;
+            # resume through the checkpointer's consensus load instead
+            if not via_checkpoint:
+                raise RuntimeError(
+                    "change_communicator across a striped-layout change "
+                    "(striped<->flat chunking or a different "
+                    "stripe_ratio) needs via_checkpoint=True: the "
+                    "sharded flat state cannot be re-sliced in memory "
+                    "across split layouts")
+            actual._opt_state = None
+            old_state = None
         super().__setattr__("communicator", communicator)
         super().__setattr__("_zero_layout", None)
         super().__setattr__("_stale_grads", None)  # re-seed zeros
@@ -322,7 +386,11 @@ class _MultiNodeOptimizer:
                 flat, spec = tree_pack(params)
                 n = flat.shape[0]
                 size = communicator.size
-                n_pad = -(-n // size) * size
+                if communicator.striped:
+                    _, n_pa, n_pb = self._striped_split(n)
+                    n_pad = n_pa + n_pb
+                else:
+                    n_pad = -(-n // size) * size
                 super().__setattr__("_zero_layout", (spec, n, n_pad))
                 actual._opt_state = \
                     self._commit_opt_state_to_mesh(old_state)
@@ -363,8 +431,8 @@ class _MultiNodeOptimizer:
         else:
             opt_state = actual._ensure_opt_state(params)
         key = actual._cache_key(lossfun, args, kwargs) \
-            + (self._double_buffering, self._sharded_update,
-               self._needs_residual)
+            + (self._double_buffering, self._db_mode,
+               self._sharded_update, self._needs_residual)
         step = self._mn_step_cache.get(key)
         if step is None:
             step = (self._make_zero_step(lossfun, args, kwargs)
@@ -373,12 +441,22 @@ class _MultiNodeOptimizer:
             self._mn_step_cache[key] = step
 
         if self._double_buffering and self._stale_grads is None:
-            if self._sharded_update:
+            if self._db_dcn:
+                # DCN-slice-only staleness (ISSUE 11): the buffer is the
+                # concatenated DCN-path slices of every bucket — a
+                # stripe_ratio fraction of a full stale tree; first
+                # update applies zeros on the DCN slices only
+                zeros = jnp.zeros(
+                    (self.communicator.grad_dcn_stale_len_for(
+                        actual.target),), jnp.float32)
+            elif self._sharded_update:
                 # the stale buffer is the reduce-scattered mean-gradient
                 # CHUNK (flat, padded, f32 — 1/n of a full stale tree on
                 # each rank); first update applies zeros, same contract
                 _, _, n_pad = self._zero_layout
-                zeros = jnp.zeros((n_pad,), jnp.float32)
+                zeros = self._striped_chunk_template() \
+                    if self.communicator.striped \
+                    else jnp.zeros((n_pad,), jnp.float32)
             else:
                 zeros = jax.tree.map(jnp.zeros_like, params)
             super().__setattr__("_stale_grads", zeros)
@@ -396,8 +474,15 @@ class _MultiNodeOptimizer:
             raise
         if self._double_buffering:
             # the donated stale buffer is rebound to this step's fresh
-            # mean gradient — through the wrapper, never a raw alias
-            super().__setattr__("_stale_grads", grads)
+            # mean gradient — through the wrapper, never a raw alias.
+            # Under the DCN-slice variant the step returns (applied
+            # gradient tree, fresh DCN-slice vector): only the latter
+            # becomes the next stale buffer
+            if self._db_dcn:
+                grads, fresh_dcn = grads
+                super().__setattr__("_stale_grads", fresh_dcn)
+            else:
+                super().__setattr__("_stale_grads", grads)
         if self._needs_residual:
             # same contract for the donated error-feedback buffer: this
             # step's quantization error becomes next step's correction
@@ -422,6 +507,42 @@ class _MultiNodeOptimizer:
         return self.actual_optimizer._transform(
             sharded_axis=self.communicator.axis_name)
 
+    # -- striped sharded update (ISSUE 11) ---------------------------------
+    def _striped_split(self, n):
+        """``(n_i, n_pad_ici, n_pad_dcn)`` of the striped flat layout:
+        the parameter vector splits at ``stripe_plan(n, ratio)`` and
+        each slice pads to its own multiple of ``size`` (both chains
+        scatter over all ``ici × dcn`` devices — only the chunk ORDER
+        differs between the fast- and slow-hop-major layouts)."""
+        from .communicators._memory_utility import stripe_plan
+        size = self.communicator.size
+        n_i, n_d = stripe_plan(n, self.communicator.stripe_ratio)
+        return n_i, -(-n_i // size) * size, -(-n_d // size) * size
+
+    def _flat_param_len(self):
+        if self._zero_layout is not None:
+            return self._zero_layout[1]
+        from .communicators._memory_utility import tree_pack
+        params = extract_state(self.actual_optimizer.target)["params"]
+        return tree_pack(params)[0].shape[0]
+
+    def _striped_chunk_template(self):
+        """Zero-seeded pair of flat global vectors in the striped ZeRO
+        layout — the stale-chunk template (and the restore template the
+        serializer builds)."""
+        n_i, n_pa, n_pb = self._striped_split(self._flat_param_len())
+        return {"ici": jnp.zeros((n_pa,), jnp.float32),
+                "dcn": jnp.zeros((n_pb,), jnp.float32)}
+
+    def _stale_chunk_spec(self):
+        """Sharding spec of the reduce-scatter stale buffer: the flat
+        chunk layout, or the per-path pair on striped communicators."""
+        comm = self.communicator
+        if comm.striped:
+            fast, slow = comm.striped_chunk_specs()
+            return {"ici": fast, "dcn": slow}
+        return comm.flat_chunk_spec()
+
     def _ensure_zero_opt_state(self, params):
         """Optimizer state over the PADDED FLAT parameter vector.
 
@@ -429,6 +550,13 @@ class _MultiNodeOptimizer:
         it with an in_spec of ``P(axis)`` — each rank then holds (and
         updates) exactly its 1/n chunk; the returned state stays sharded
         across steps.
+
+        On a STRIPED communicator (ISSUE 11) the flat vector splits
+        into the ICI-path / DCN-path pair ``{"ici": ..., "dcn": ...}``
+        — each slice padded to its own multiple of ``size`` and sharded
+        by its own chunk layout (fast- vs slow-hop-major,
+        ``striped_chunk_specs``); the optax transform inits over the
+        pair tree, so state leaves mirror the two-slice structure.
         """
         actual = self.actual_optimizer
         if actual._opt_state is None:
@@ -436,6 +564,15 @@ class _MultiNodeOptimizer:
             flat, spec = tree_pack(params)
             n = flat.shape[0]
             size = self.communicator.size
+            if self.communicator.striped:
+                n_i, n_pa, n_pb = self._striped_split(n)
+                super().__setattr__("_zero_layout",
+                                    (spec, n, n_pa + n_pb))
+                pair = {"ici": jnp.pad(flat[:n_i], (0, n_pa - n_i)),
+                        "dcn": jnp.pad(flat[n_i:],
+                                       (0, n_pb - (n - n_i)))}
+                actual._opt_state = self._zero_transform().init(pair)
+                return actual._opt_state
             n_pad = -(-n // size) * size
             flat = jnp.pad(flat, (0, n_pad - n))
             super().__setattr__("_zero_layout", (spec, n, n_pad))
@@ -447,8 +584,29 @@ class _MultiNodeOptimizer:
         (e.g. Adam's step count).  The chunk layout is the
         communicator's (``flat_chunk_spec``): one axis on flat
         communicators, fast-hop-major over (ici, dcn) on hierarchical
-        ones — the layout the chained reduce-scatter produces."""
+        ones — the layout the chained reduce-scatter produces.  On
+        striped communicators each slice of the pair layout gets its
+        own spec, resolved by the leaf's position under the
+        ``"ici"``/``"dcn"`` dict keys (the leaf LENGTHS can coincide,
+        so the tree path — not the shape — is the disambiguator)."""
         _, n, n_pad = self._zero_layout
+        if self.communicator.striped:
+            from jax.tree_util import DictKey, tree_map_with_path
+            n_i, n_pa, n_pb = self._striped_split(n)
+            fast, slow = self.communicator.striped_chunk_specs()
+
+            def spec_for(path, leaf):
+                if getattr(leaf, "ndim", 0) != 1:
+                    return P()
+                keys = [k.key for k in path if isinstance(k, DictKey)
+                        and k.key in ("ici", "dcn")]
+                if keys and keys[-1] == "ici" and leaf.shape[0] == n_pa:
+                    return fast
+                if keys and keys[-1] == "dcn" and leaf.shape[0] == n_pb:
+                    return slow
+                return P()
+
+            return tree_map_with_path(spec_for, opt_state)
         chunk_spec = self.communicator.flat_chunk_spec()
         return jax.tree.map(
             lambda leaf: chunk_spec if getattr(leaf, "ndim", 0) == 1
@@ -498,6 +656,8 @@ class _MultiNodeOptimizer:
             tree_pack, tree_unpack)
         from .core.optimizer import apply_transform_update
         comm = self.communicator
+        if comm.striped:
+            return self._make_striped_zero_update()
         tx = self._zero_transform()
         size = comm.size
         spec, n, n_pad = self._zero_layout
@@ -561,6 +721,108 @@ class _MultiNodeOptimizer:
 
         return zero_update
 
+    def _make_striped_zero_update(self):
+        """The STRIPED two-slice sharded update (ISSUE 11): the flat
+        gradient/parameter vector splits at ``stripe_plan(n, ratio)``;
+        the ICI-path slice runs the fast-hop-major chained
+        reduce-scatter (``psum_scatter`` over ICI on the full slice,
+        then over DCN on the 1/ici chunk — the PR 6 chain), the
+        DCN-path slice runs the TRANSPOSED chain (``psum_scatter`` over
+        DCN on the full slice — the bulk rides the slow wire — then
+        over ICI), both paths' scatters emitted before either path's
+        chunk update so the two fabrics drain concurrently.  The chunk
+        update runs on the ``{"ici", "dcn"}`` pair tree (optax is
+        tree-generic), and the params rebuild all-gathers each slice
+        along its chain in reverse — DCN carries the full DCN-path
+        slice plus 1/ici of the ICI-path slice, in both directions.
+
+        Per-hop dtype: ``dcn_grad_dtype`` compresses exactly the DCN
+        crossings (the ICI-path chunk's DCN scatter AND the DCN-path
+        slice's bulk scatter); the fast hop accumulates in f32
+        (lossless by design — the DCN-path chunk upcasts before its ICI
+        scatter).  Quantized wires are rejected at construction.
+        ``stale_chunk`` (double buffering) is the one-step-stale pair
+        of mean-gradient chunks — the PR 5 contract on both paths at
+        the striped layout."""
+        from .communicators._memory_utility import tree_pack, tree_unpack
+        from .core.optimizer import apply_transform_update
+        comm = self.communicator
+        tx = self._zero_transform()
+        size = comm.size
+        spec, n, _ = self._zero_layout
+        n_i, n_pa, n_pb = self._striped_split(n)
+        n_d = n - n_i
+        chunk_a = n_pa // size
+        chunk_b = n_pb // size
+        ici, dcn = comm.ici_axis, comm.dcn_axis
+        intra, inter = comm.ici_size, comm.dcn_size
+        grad_dtype = comm.allreduce_grad_dtype
+        dcn_dtype = getattr(comm, "dcn_grad_dtype", None)
+
+        def zero_update(params, grads, opt_state, hyper, stale_chunk=None,
+                        residual=None):
+            with jax.named_scope("striped_zero_rs_grad"):
+                gflat, _ = tree_pack(grads)
+                if grad_dtype is not None:
+                    gflat = gflat.astype(grad_dtype)
+                ga = jnp.pad(gflat[:n_i], (0, n_pa - n_i))
+                gb = jnp.pad(gflat[n_i:n], (0, n_pb - n_d))
+                # slow-path-first emission (hop_schedule's striped
+                # contract): the DCN-path bulk scatter is issued first,
+                # then the ICI-path bulk, then the two chunk scatters
+                if dcn_dtype is not None:
+                    gb = gb.astype(dcn_dtype)
+                if n_d:
+                    gb = lax.psum_scatter(gb, dcn, scatter_dimension=0,
+                                          tiled=True)
+                if n_i:
+                    ga = lax.psum_scatter(ga, ici, scatter_dimension=0,
+                                          tiled=True)
+                if n_d:
+                    # lossless fast hop: upcast before accumulating
+                    gb = lax.psum_scatter(gb.astype(jnp.float32), ici,
+                                          scatter_dimension=0, tiled=True)
+                if n_i:
+                    if dcn_dtype is not None:
+                        ga = ga.astype(dcn_dtype)
+                    ga = lax.psum_scatter(ga, dcn, scatter_dimension=0,
+                                          tiled=True)
+                gchunk = {"ici": ga.astype(jnp.float32) / size,
+                          "dcn": gb.astype(jnp.float32) / size}
+            with jax.named_scope("striped_zero_shard_update"):
+                pflat, _ = tree_pack(params)
+                pa = jnp.pad(pflat[:n_i], (0, n_pa - n_i))
+                pb = jnp.pad(pflat[n_i:n], (0, n_pb - n_d))
+                idx_a = lax.axis_index(ici) * inter + lax.axis_index(dcn)
+                idx_b = lax.axis_index(dcn) * intra + lax.axis_index(ici)
+                # a degenerate ratio (0/1) leaves one slice EMPTY: its
+                # chunk is the (0,) vector itself — zero-length
+                # dynamic_slices and all_gathers do not lower
+                pchunk = {"ici": lax.dynamic_slice_in_dim(
+                              pa, idx_a * chunk_a, chunk_a)
+                          if n_i else pa,
+                          "dcn": lax.dynamic_slice_in_dim(
+                              pb, idx_b * chunk_b, chunk_b)
+                          if n_d else pb}
+                new_pchunk, new_opt_state = apply_transform_update(
+                    tx, gchunk if stale_chunk is None else stale_chunk,
+                    opt_state, pchunk, hyper["lr"],
+                    hyper.get("decoupled_wd", 0.0))
+            with jax.named_scope("striped_zero_all_gather_params"):
+                fa = new_pchunk["ici"]
+                if n_i:
+                    for a in (dcn, ici):  # reverse of the (ici, dcn) chain
+                        fa = lax.all_gather(fa, a, tiled=True)
+                fb = new_pchunk["dcn"]
+                if n_d:
+                    for a in (ici, dcn):  # reverse of the (dcn, ici) chain
+                        fb = lax.all_gather(fb, a, tiled=True)
+                new_params = tree_unpack(
+                    jnp.concatenate([fa[:n_i], fb[:n_d]]), spec)
+            return new_params, new_opt_state, gchunk, None
+
+        return zero_update
+
     def _make_zero_step(self, lossfun, ex_args, ex_kwargs):
         from chainermn_tpu.utils.compat import shard_map
         from .core.optimizer import make_loss_and_grad
@@ -600,14 +862,19 @@ class _MultiNodeOptimizer:
         kwargs_specs = jax.tree.map(
             lambda leaf: self._batch_spec(leaf, axis, size), ex_kwargs)
         opt_specs = self._zero_state_spec(actual._opt_state)
-        # the stale chunk is sharded like the opt state's flat leaves;
-        # the error-feedback residual shares the layout (per-device
+        # the stale chunk is sharded like the opt state's flat leaves
+        # (the per-path pair on striped communicators); the
+        # error-feedback residual shares the flat layout (per-device
         # slice of a flat vector)
-        stale_spec = comm.flat_chunk_spec() if double_buffering else P()
+        stale_spec = self._stale_chunk_spec() if double_buffering else P()
         residual_spec = comm.flat_chunk_spec() if needs_residual else P()
+        # the stale operand is tuple-wrapped; a dict-shaped striped
+        # spec cannot prefix a tuple, so wrap the IN spec to match the
+        # operand structure (the OUT slot is the bare fresh chunk)
+        stale_in_spec = (stale_spec,) if double_buffering else P()
         mapped = shard_map(
             rank_step, mesh=comm.mesh,
-            in_specs=(P(), P(), opt_specs, P(), P(), stale_spec,
+            in_specs=(P(), P(), opt_specs, P(), P(), stale_in_spec,
                       residual_spec, args_specs, kwargs_specs),
             out_specs=(P(), P(), opt_specs, P(), stale_spec,
                        residual_spec, P()),
@@ -661,6 +928,7 @@ class _MultiNodeOptimizer:
         axis = comm.axis_name
         size = comm.size
         double_buffering = self._double_buffering
+        db_dcn = self._db_dcn
         needs_residual = self._needs_residual
         loss_and_grad = make_loss_and_grad(actual.target, lossfun)
 
@@ -675,15 +943,30 @@ class _MultiNodeOptimizer:
             # the reference's allreduce_grad: mean over ranks, optional
             # dtype compression, optional flat bucket — all in-program;
             # quantized wires additionally thread the error-feedback
-            # residual through the transform (ISSUE 8)
+            # residual through the transform (ISSUE 8); the striped
+            # DCN-slice stale variant (ISSUE 11) threads the previous
+            # step's DCN-path results and receives the fresh ones back
             with jax.named_scope("mn_allreduce_grad"):
-                if needs_residual:
+                if db_dcn:
+                    out = grad_transform(
+                        grads, residual[0] if needs_residual else None,
+                        stale_dcn=stale[0])
+                    if needs_residual:
+                        grads, new_residual, fresh_dcn = out
+                        res_out = (new_residual,)
+                    else:
+                        grads, fresh_dcn = out
+                        res_out = ()
+                elif needs_residual:
                     grads, new_residual = grad_transform(grads, residual[0])
                     res_out = (new_residual,)
                 else:
                     grads = grad_transform(grads)
                     res_out = ()
-            apply_grads = stale[0] if double_buffering else grads
+            # db_dcn applies the transform's output directly — the stale
+            # DCN slices are already assembled INSIDE it, per path
+            apply_grads = stale[0] \
+                if double_buffering and not db_dcn else grads
             with jax.named_scope("mn_optimizer_update"):
                 new_params, new_opt_state = apply_transform_update(
                     tx, apply_grads, opt_state, params, hyper["lr"],
@@ -692,7 +975,8 @@ class _MultiNodeOptimizer:
             loss = lax.pmean(loss, axis)
             obs = jax.tree.map(lambda o: lax.pmean(o, axis), obs)
             new_pstate = jax.tree.map(lambda s: lax.pmean(s, axis), new_pstate)
-            return new_params, new_pstate, new_opt_state, loss, grads, \
+            out_grads = (grads, fresh_dcn) if db_dcn else grads
+            return new_params, new_pstate, new_opt_state, loss, out_grads, \
                 res_out, obs
 
         args_specs = jax.tree.map(
@@ -1015,6 +1299,8 @@ class _MultiNodeOptimizer:
         the true parameter length ``n`` and re-padded to this mesh's
         ``n_pad`` first — the host-gathered snapshots are full vectors,
         so size-changed resume is well-defined."""
+        if self.communicator.striped:
+            return self._commit_striped_state_to_mesh(opt_state)
         chunk_spec = self.communicator.flat_chunk_spec()
         mesh = self.communicator.mesh
         _, n, n_pad = self._zero_layout
@@ -1037,6 +1323,47 @@ class _MultiNodeOptimizer:
                 host.shape, sharding, lambda idx: host[idx])
 
         return jax.tree.map(commit, opt_state)
+
+    def _commit_striped_state_to_mesh(self, tree):
+        """Striped variant of :meth:`_commit_opt_state_to_mesh`: each
+        flat leaf of the ``{"ici", "dcn"}`` pair layout commits to ITS
+        path's chunk spec (fast- vs slow-hop-major), resolved by the
+        leaf's dict-key path — the two padded lengths may coincide, so
+        the tree position, not the shape, is the disambiguator.  A leaf
+        saved under a different communicator SIZE re-pads from its
+        path's true (size-independent) slice length; a different
+        STRIPE RATIO moves the split point itself, which the ef-
+        residual-style re-seed contract does not cover — resume striped
+        state with the ratio it was saved under."""
+        from jax.tree_util import DictKey, tree_map_with_path
+        comm = self.communicator
+        mesh = comm.mesh
+        _, n, _ = self._zero_layout
+        n_i, n_pa, n_pb = self._striped_split(n)
+        fast, slow = comm.striped_chunk_specs()
+        target = {"ici": (n_i, n_pa, fast), "dcn": (n - n_i, n_pb, slow)}
+
+        def commit(path, leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return leaf
+            if getattr(leaf, "ndim", 0) != 1:
+                return leaf
+            keys = [k.key for k in path if isinstance(k, DictKey)
+                    and k.key in target]
+            if not keys:
+                return leaf
+            true_n, n_pad, cspec = target[keys[-1]]
+            if leaf.shape[0] != n_pad:
+                if leaf.shape[0] < true_n:
+                    return leaf  # not a flat slice vector
+                leaf = jnp.pad(jnp.asarray(leaf)[:true_n],
+                               (0, n_pad - true_n))
+            host = np.asarray(leaf)
+            sharding = jax.sharding.NamedSharding(mesh, cspec)
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+
+        return tree_map_with_path(commit, tree)
 
     def serialize(self, serializer):
         actual = self.actual_optimizer
@@ -1119,7 +1446,14 @@ class _MultiNodeOptimizer:
             if not params or any(v is None for v in params.values()):
                 super().__setattr__("_stale_grads", None)
                 return
-            if self._sharded_update:
+            if self._db_dcn:
+                # DCN-slice-only stale variant (ISSUE 11): a flat
+                # replicated vector of the buckets' DCN-path slices —
+                # length derivable from params + the committed ratio
+                template = jnp.zeros(
+                    (self.communicator.grad_dcn_stale_len_for(
+                        actual.target),), jnp.float32)
+            elif self._sharded_update:
                 # reduce-scatter double buffering: the stale buffer is
                 # the flat padded mean-gradient vector, not a per-param
                 # tree.  Its length is derivable from params alone, so
@@ -1132,10 +1466,20 @@ class _MultiNodeOptimizer:
                     n = tree_pack(params)[0].shape[0]
                     size = self.communicator.size
                     n_pad = -(-n // size) * size
-                template = jnp.zeros((n_pad,), jnp.float32)
+                template = jnp.zeros((n_pad,), jnp.float32) \
+                    if not self.communicator.striped \
+                    else self._striped_chunk_template()
             else:
                 template = jax.tree.map(jnp.zeros_like, params)
             restored = deserialize_flat_tree(sub, template, "n", "g")
+            if self._sharded_update and self.communicator.striped \
+                    and restored is not None:
+                # striped pair layout: commit each path's slice to its
+                # own chunk spec (size-changed re-pad included)
+                super().__setattr__(
+                    "_stale_grads",
+                    self._commit_striped_state_to_mesh(restored))
+                return
             if self._sharded_update and restored is not None and not (
                     isinstance(restored, jax.Array)
                     and not restored.is_fully_addressable):
@@ -1236,6 +1580,20 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
     one step old.  Here both live in the same compiled program and XLA's
     async dispatch provides the overlap; the observable contract (first
     update applies zeros, update ``t`` applies grads of ``t-1``) matches.
+
+    ``db_mode="dcn"`` (ISSUE 11, striped communicators only): staleness
+    applies PER PATH — the ICI-path slice of every bucket is applied
+    fresh, only the DCN-path slice is one step old (first update applies
+    zeros on the DCN slices).  The stale buffer shrinks to the
+    ``stripe_ratio`` fraction of a full stale tree, and the slow
+    fabric's latency is hidden without giving up freshness on the fast
+    path.
     """
 
     _double_buffering = True
+
+    def __init__(self, actual_optimizer, communicator, zero_fill=True,
+                 exchange="allreduce", db_mode=True):
+        super().__init__(actual_optimizer, communicator, zero_fill,
+                         exchange=exchange)
+        super().__setattr__("_db_mode", db_mode)

@@ -535,7 +535,8 @@ def test_exchange_knobs_vocabulary():
         ("hierarchical", True, "reduce_scatter")
     assert set(EXCHANGES) == {"per_leaf", "flat", "bucketed",
                               "reduce_scatter", "hierarchical",
-                              "hierarchical_rs"}
+                              "hierarchical_rs", "striped",
+                              "striped_rs"}
     with pytest.raises(ValueError, match="unknown exchange"):
         exchange_knobs("chunky")
 

@@ -32,10 +32,18 @@ STEPS = 3
 TINY_BUCKET_MB = 2000 / 2 ** 20
 
 _BC = {"per_leaf": False, "flat": True, "bucketed": "bucketed",
-       "hierarchical_bucketed": "bucketed"}
+       "hierarchical_bucketed": "bucketed",
+       "striped_bucketed": "bucketed"}
 #: exchange names that run on the two-level communicator (simulated
-#: 2-host split); *_rs routes through the sharded-update step
-_HIER = ("hierarchical", "hierarchical_bucketed", "hierarchical_rs")
+#: 2-host split); *_rs routes through the sharded-update step; the
+#: striped names (ISSUE 11) run the multi-path exchange at ratio 0.5 —
+#: both fabrics carry half of every bucket, the most adversarial split
+#: for the equality grid
+_HIER = ("hierarchical", "hierarchical_bucketed", "hierarchical_rs",
+         "striped", "striped_bucketed", "striped_rs")
+_STRIPED = ("striped", "striped_bucketed", "striped_rs")
+STRIPE_RATIO = 0.5
+_RS = ("reduce_scatter", "hierarchical_rs", "striped_rs")
 
 
 def _data(seed=0, n=32, d=8, k=4):
@@ -63,6 +71,7 @@ def _run(exchange, double_buffering=False, donate=True, grad_dtype=None,
         inter_size=2 if exchange in _HIER else None,
         batch_collectives=_BC.get(exchange, True),
         bucket_mb=TINY_BUCKET_MB if "bucketed" in exchange else None,
+        stripe_ratio=STRIPE_RATIO if exchange in _STRIPED else None,
         allreduce_grad_dtype=grad_dtype)
     model = _model()
     comm.bcast_data(model)
@@ -70,8 +79,7 @@ def _run(exchange, double_buffering=False, donate=True, grad_dtype=None,
     inner.donate_params = donate
     opt = ct.create_multi_node_optimizer(
         inner, comm, double_buffering=double_buffering,
-        exchange="reduce_scatter"
-        if exchange in ("reduce_scatter", "hierarchical_rs")
+        exchange="reduce_scatter" if exchange in _RS
         else "allreduce").setup(model)
     x, t = _data()
     losses = [float(opt.update(model, x, t)) for _ in range(steps)]
@@ -97,11 +105,13 @@ def golden():
 @pytest.mark.parametrize("exchange",
                          ["per_leaf", "flat", "bucketed",
                           "reduce_scatter", "hierarchical",
-                          "hierarchical_bucketed", "hierarchical_rs"])
+                          "hierarchical_bucketed", "hierarchical_rs",
+                          "striped", "striped_bucketed", "striped_rs"])
 def test_exchange_matches_single_device_golden(exchange, golden):
     """Acceptance bar: all exchange variants — including the two-level
-    hierarchical ones on the simulated 2-host mesh — golden-equal to
-    the single-device trajectory on the CPU mesh."""
+    hierarchical AND multi-path striped ones on the simulated 2-host
+    mesh — golden-equal to the single-device trajectory on the CPU
+    mesh."""
     glosses, gparams = golden
     losses, params, _ = _run(exchange)
     np.testing.assert_allclose(losses, glosses, rtol=1e-5, atol=1e-7,
@@ -133,7 +143,7 @@ def test_double_buffering_grid_equal():
     # stale application is observable: step 2's loss equals step 1's
     assert ref[0][0] == ref[0][1]
     for exchange in ("bucketed", "reduce_scatter", "hierarchical",
-                     "hierarchical_rs"):
+                     "hierarchical_rs", "striped", "striped_rs"):
         losses, params, _ = _run(exchange, double_buffering=True, steps=4)
         np.testing.assert_allclose(losses, ref[0], rtol=1e-5, atol=1e-7,
                                    err_msg=f"db×{exchange} diverged")
@@ -141,7 +151,8 @@ def test_double_buffering_grid_equal():
             np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("exchange", ["reduce_scatter", "hierarchical"])
+@pytest.mark.parametrize("exchange", ["reduce_scatter", "hierarchical",
+                                      "striped", "striped_rs"])
 def test_donation_off_matches_donation_on(exchange):
     """The donation axis of the grid, on the sharded-update and
     two-level steps: buffer aliasing must not change the trajectory."""
@@ -186,10 +197,118 @@ def test_per_hop_dtype_stays_close_to_lossless():
     assert dcn[0][-1] < dcn[0][0]
 
 
+def test_striped_bf16_composes():
+    """Compressed-dtype axes × striping: a scalar bf16 dtype (both
+    hops, both paths) stays within bf16 rounding of the flat bf16
+    trajectory and learns; the per-hop {'dcn': bf16} variant (only the
+    DCN-fabric crossings of BOTH paths compressed) stays within bf16
+    rounding of the lossless striped run."""
+    flat = _run("flat", grad_dtype="bfloat16", steps=5)
+    s_bf16 = _run("striped", grad_dtype="bfloat16", steps=5)
+    np.testing.assert_allclose(s_bf16[0], flat[0], rtol=5e-3,
+                               err_msg="striped×bf16 far from flat×bf16")
+    assert np.isfinite(s_bf16[0]).all() and s_bf16[0][-1] < s_bf16[0][0]
+    f32 = _run("striped", steps=5)
+    dcn = _run("striped", grad_dtype={"dcn": "bfloat16"}, steps=5)
+    np.testing.assert_allclose(dcn[0], f32[0], rtol=5e-3,
+                               err_msg="striped dcn-bf16 far from lossless")
+    assert dcn[0][-1] < dcn[0][0]
+
+
+def test_striped_dcn_only_stale_degenerates():
+    """The DCN-slice-only double-buffering variant (ISSUE 11,
+    ``double_buffering="dcn"``): per-path staleness interpolates
+    between the fresh and fully-stale trajectories, pinned at the
+    degenerate ratios — ratio 1 (everything on the DCN path) equals
+    FULL double buffering bitwise-close, and the mid-ratio run is a
+    genuine third trajectory that still learns."""
+    def run_ratio(ratio, db, steps=4):
+        comm = ct.create_communicator("hierarchical", inter_size=2,
+                                      batch_collectives=True,
+                                      stripe_ratio=ratio)
+        model = _model()
+        comm.bcast_data(model)
+        opt = ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1, momentum=0.9), comm,
+            double_buffering=db).setup(model)
+        x, t = _data()
+        return [float(opt.update(model, x, t)) for _ in range(steps)], opt
+
+    full, _ = run_ratio(1.0, True)
+    dcn_only, _ = run_ratio(1.0, "dcn")
+    np.testing.assert_allclose(dcn_only, full, rtol=1e-6, atol=1e-7,
+                               err_msg="ratio-1 dcn-stale != full stale")
+    mid, opt = run_ratio(0.5, "dcn")
+    fresh, _ = run_ratio(0.5, False)
+    assert np.isfinite(mid).all() and mid[-1] < mid[0]
+    # genuinely between the two: not the fresh trajectory, not the full
+    # one-step-stale one (the ICI half is fresh, the DCN half stale)
+    assert mid != fresh
+    assert mid != full
+    # footprint claim: the stale buffer is the DCN slices only
+    assert opt._stale_grads.shape[0] == \
+        opt.communicator.grad_dcn_stale_len_for(opt.target)
+
+
+def test_striped_dcn_only_stale_resume_bit_exact(tmp_path):
+    """The DCN-slice stale buffer is OBSERVABLE state like every other
+    stale buffer: same-size serialize → restore → continue is
+    bit-exact."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, _, opt = _run("striped", double_buffering="dcn", steps=2)
+    assert opt._stale_grads is not None
+    save_npz(path, opt)
+    cont_ref = [float(opt.update(opt.target, x, t)) for _ in range(2)]
+
+    _, _, fresh = _run("striped", double_buffering="dcn", steps=1)
+    load_npz(path, fresh)
+    cont = [float(fresh.update(fresh.target, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_ref, rtol=0, atol=0)
+
+
+def test_double_buffered_striped_rs_resume_bit_exact(tmp_path):
+    """The striped sharded update's stale PAIR (fast- and slow-hop-
+    major chunks) round-trips through the flat-vector serialization
+    bit-exactly, like the single-layout chunk does."""
+    from chainermn_tpu.serializers import load_npz, save_npz
+    path = str(tmp_path / "snap.npz")
+    x, t = _data()
+
+    _, _, opt = _run("striped_rs", double_buffering=True, steps=2)
+    save_npz(path, opt)
+    cont_ref = [float(opt.update(opt.target, x, t)) for _ in range(2)]
+
+    _, _, fresh = _run("striped_rs", double_buffering=True, steps=1)
+    load_npz(path, fresh)
+    cont = [float(fresh.update(fresh.target, x, t)) for _ in range(2)]
+    np.testing.assert_allclose(cont, cont_ref, rtol=0, atol=0)
+
+
+def test_striped_rs_quantized_wire_rejected():
+    """The slow-hop-major chain has no quantized psum_scatter shape:
+    int8 × striped × reduce_scatter is a LOUD construction error, not
+    a silently lossless run."""
+    comm = ct.create_communicator("hierarchical", inter_size=2,
+                                  stripe_ratio=0.5,
+                                  allreduce_grad_dtype={"dcn": "int8"})
+    with pytest.raises(ValueError, match="striped"):
+        ct.create_multi_node_optimizer(
+            MomentumSGD(lr=0.1), comm, exchange="reduce_scatter")
+
+
 def test_hierarchical_rs_grad_not_populated():
     """The sharded-update contract holds on the two-level step too:
     the full mean gradient never materializes."""
     _, _, opt = _run("hierarchical_rs")
+    assert all(p.grad is None for p in opt.target.params())
+
+
+def test_striped_rs_grad_not_populated():
+    """Same contract on the striped pair-layout step."""
+    _, _, opt = _run("striped_rs")
     assert all(p.grad is None for p in opt.target.params())
 
 
@@ -198,7 +317,8 @@ def test_hierarchical_update_scan_continues_trajectory():
     SAME trajectory as the golden run's steps 4-5 (both the allreduce
     and the sharded-update hierarchical steps drive the scan maker)."""
     glosses, _ = _golden(steps=5)
-    for exchange in ("hierarchical", "hierarchical_rs"):
+    for exchange in ("hierarchical", "hierarchical_rs", "striped",
+                     "striped_rs"):
         losses, _, opt = _run(exchange, steps=3)
         x, t = _data()
         scan_losses = np.asarray(opt.update_scan(
@@ -250,7 +370,9 @@ def test_reduce_scatter_update_scan_continues_trajectory(golden):
 
 @pytest.mark.parametrize("exchange,db", [("hierarchical", False),
                                          ("hierarchical_rs", False),
-                                         ("hierarchical_rs", True)])
+                                         ("hierarchical_rs", True),
+                                         ("striped", False),
+                                         ("striped", "dcn")])
 def test_quantized_residual_resume_bit_exact(tmp_path, exchange, db):
     """The error-feedback residual is OBSERVABLE state (ISSUE 8): a
     same-size serialize → restore → continue is bit-exact — the

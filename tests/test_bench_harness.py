@@ -1340,3 +1340,15 @@ def test_cacheable_rejects_exchange_variants(cache_path, monkeypatch):
     assert bench._cacheable(dict(flagship, exchange="flat"))
     # legacy rows without the key were flat by construction
     assert bench._cacheable(flagship)
+    # ISSUE 11: the striped ratio-sweep legs — same fences, both gates
+    monkeypatch.setenv("BENCH_EXCHANGE", "striped")
+    monkeypatch.setenv("BENCH_STRIPE_RATIO", "0.5")
+    assert not bench._cacheable(dict(flagship, exchange="striped",
+                                     stripe_ratio=0.5))
+    monkeypatch.delenv("BENCH_EXCHANGE", raising=False)
+    # a stray ratio knob ALONE (exchange unset → flat, which ignores
+    # it) still flips the fingerprint: the row is a measurement
+    assert not bench._cacheable(dict(flagship, exchange="flat"))
+    monkeypatch.delenv("BENCH_STRIPE_RATIO", raising=False)
+    # payload gate on a planted striped row
+    assert not bench._cacheable(dict(flagship, exchange="striped"))

@@ -282,6 +282,102 @@ def test_quantized_wire_halves_dcn_bytes_vs_bf16(live):
     assert int8 * 2 == bf16
 
 
+def test_striped_both_fabrics_carry_bulk(live):
+    """The ISSUE 11 tentpole, machine-checked: the striped exchange
+    puts a bulk reduce_scatter AND a bulk all_gather on BOTH fabrics
+    in one step — the ICI path's rs/ag over ici with its chunk psum
+    over dcn, and the transposed DCN path's rs/ag over dcn with its
+    chunk psum over ici.  The strict hierarchy's idle-slow-fabric
+    window is structurally gone."""
+    row = live["striped"]
+    assert row["topology"] == "striped"
+    assert row["stripe_ratio"] == comm_census.STRIPE_RATIO
+    for hop in ("ici", "dcn"):
+        assert row["per_hop"][hop]["collectives"] == \
+            {"reduce_scatter": 1, "psum": 1, "all_gather": 1}, hop
+    assert set(row["per_hop"]) == {"ici", "dcn"}
+
+
+def test_striped_byte_conservation_identity(budgets, live):
+    """Acceptance bar: ici_path + dcn_path bytes of a striped bucket ==
+    the flat allreduce bytes of the same payload — striping relocates
+    bytes across fabrics, it adds NONE.  Pinned EXACT: the committed
+    ratio splits the vertical into slices that divide both rings, so
+    no pad slack hides a regression."""
+    flat = live["flat"]["exchanged_gradient_bytes_per_replica"]
+    for name in ("striped", "striped_bucketed"):
+        per_path = live[name]["per_path_bytes"]
+        assert set(per_path) == {"ici", "dcn"}, name
+        assert per_path["ici"] + per_path["dcn"] == flat, name
+        assert budgets["structure"][name]["per_path_bytes"] == per_path
+
+
+def test_striped_dcn_share_is_committed_ratio(live):
+    """Acceptance bar: the DCN path's byte share IS the committed split
+    ratio, exactly — per-path totals are proportional to slice sizes
+    under the ring identity, so the wire division the schedule promises
+    falls out of the traced operand sizes."""
+    for name in ("striped", "striped_bucketed"):
+        row = live[name]
+        per_path = row["per_path_bytes"]
+        total = per_path["ici"] + per_path["dcn"]
+        assert per_path["dcn"] / total == row["stripe_ratio"], name
+
+
+def test_striped_buckets_compose_with_striping(live):
+    """PR 5's bucket planner composes with the multi-path schedule: K
+    buckets → K collectives per (path, op) — same K as the flat-
+    topology bucketed config — with the per-path byte identities
+    holding across the whole plan."""
+    k = live["bucketed"]["grad_collectives"]["psum"]
+    row = live["striped_bucketed"]
+    for hop in ("ici", "dcn"):
+        assert row["per_hop"][hop]["collectives"] == \
+            {"reduce_scatter": k, "psum": k, "all_gather": k}
+
+
+def test_striped_concurrent_eligible_order(live):
+    """The generalized hop_ordered gate (ISSUE 11 satellite): every
+    scatter/crossing op of BOTH paths precedes every rebuild
+    all_gather — the striped configs are budget-gated, not exempted,
+    and the old single-path slow-hop-first property still holds for
+    the hierarchical configs under the same generalized check."""
+    for name, row in live.items():
+        if row.get("topology") in ("hierarchical", "striped"):
+            assert row["hop_ordered"], name
+
+
+def test_striped_dcn_bf16_compresses_only_dcn_fabric(live):
+    """Per-hop dtype × striping: the DCN FABRIC's crossings (the ICI
+    path's chunk psum, the DCN path's bulk rs + ag) halve; the ICI
+    fabric is byte-identical — the DCN path's chunk upcasts to f32
+    before its fast-hop allreduce, so lossless-over-ICI survives the
+    transposed schedule."""
+    f32 = live["striped"]["per_hop"]
+    bf16 = live["striped_dcn_bf16"]["per_hop"]
+    assert bf16["ici"]["exchanged_grad_bytes"] == \
+        f32["ici"]["exchanged_grad_bytes"]
+    assert bf16["dcn"]["exchanged_grad_bytes"] * 2 == \
+        f32["dcn"]["exchanged_grad_bytes"]
+
+
+def test_striped_rs_shards_both_paths(live):
+    """exchange='reduce_scatter' × striped: each path's slice chains
+    psum_scatter over BOTH axes (2 rs per hop) and the params rebuild
+    all-gathers both chains in reverse (2 ag per hop); gradient bytes
+    equal the flat reduce-scatter exchange (half the allreduce — the
+    conservation identity's rs form) and the params rebuild matches
+    it byte for byte."""
+    row = live["striped_rs"]
+    for hop in ("ici", "dcn"):
+        assert row["per_hop"][hop]["collectives"] == \
+            {"reduce_scatter": 2, "all_gather": 2}, hop
+    assert row["exchanged_gradient_bytes_per_replica"] == \
+        live["reduce_scatter"]["exchanged_gradient_bytes_per_replica"]
+    assert row["exchanged_param_bytes_per_replica"] == \
+        live["reduce_scatter"]["exchanged_param_bytes_per_replica"]
+
+
 def test_unknown_collective_prim_is_hard_census_error():
     """A collective the pricing does not understand must raise, never
     silently skip or misprice (the satellite's contract)."""

@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-pr${BENCH_PREEMPT_RANK:-d}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-pr${BENCH_PREEMPT_RANK:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,45 +78,50 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 23 bench steps recorded, each once, in queue order
+    # all 24 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # prewarm
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # flagship
-        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # donation
-        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # headroom
-        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # input
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # prewarm
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # flagship
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # donation
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # headroom
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # input
         # ISSUE 5: bucket-MB sweep + reduce-scatter A/B legs
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-gdd-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-srd-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-srd-gdd-ef1-sqd-std-prd",
         # ISSUE 6: hierarchical two-level exchange, forced 2x4 split
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdd-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-prd",
         # ISSUE 8: DCN wire-dtype A/B + error-feedback ablation
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdnone-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef1-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-gdint8-ef0-sqd-std-prd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-gdint8-ef1-sqd-std-prd",
-        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",  # flash
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdnone-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef0-sqd-std-prd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-srd-gdint8-ef1-sqd-std-prd",
+        # ISSUE 11: striped multi-path exchange, 2x4 split at r=0.25
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exstriped-bkd-is2-sr0.25-gdd-ef1-sqd-std-prd",
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",  # flash
         # ISSUE 9: serving engine rows (flagship qps16x4 + saturation)
-        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sqd-std-prd",
-        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-gdd-ef1-sq64-st8-prd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sq64-st8-prd",
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
-    # exposed-comm A/B (ISSUE 5 + 6 + 10): four gloo exchange curves
-    # plus the elastic preempt-and-rejoin A/B (its last CLI arg is the
-    # preempted rank — the BENCH_PREEMPT_RANK-class knob pinned above),
-    # folded in their own section after the main fold
+    # exposed-comm A/B (ISSUE 5 + 6 + 10 + 11): four gloo exchange
+    # curves, the striped split-ratio sweep (its last CLI arg is the
+    # ratio — one invocation per sweep point), and the elastic
+    # preempt-and-rejoin A/B (its last CLI arg is the preempted rank —
+    # the BENCH_PREEMPT_RANK-class knob pinned above), folded in their
+    # own section after the main fold
     assert [ln for ln in notes_text.splitlines() if '"gloo"' in ln] == [
         '{"gloo": "flat"}', '{"gloo": "bucketed"}',
         '{"gloo": "reduce_scatter"}', '{"gloo": "hierarchical"}',
+        '{"gloo": "0.25"}', '{"gloo": "0.5"}', '{"gloo": "0.75"}',
         '{"gloo": "1"}']
     assert notes_text.index("On-chip results") \
         < notes_text.index("Exposed-comm A/B rows")
@@ -159,7 +164,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the twenty-three bench rows
+    the queue must still complete (|| true), the twenty-four bench rows
     must already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
@@ -183,5 +188,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 23
+                if '"final"' in ln]) == 24
     assert "Flash-vs-XLA" not in notes_text

@@ -91,6 +91,13 @@ VERTICAL = dict(n_vocab=8192, d_model=256, n_heads=4, n_layers=2,
 #: dcn 2 × ici 4); the DCN payload ratio below is pinned to 1/ici
 HIER_INTER_SIZE = 2
 
+#: committed DCN share of the striped configs (ISSUE 11).  0.25 splits
+#: the vertical's 5,790,720-element gradient into slices that divide
+#: BOTH rings cleanly (dcn slice 1,447,680 % 2 == 0, ici slice
+#: 4,343,040 % 4 == 0), so the byte-conservation identity is pinned
+#: EXACT — no pad slack muddies the gate
+STRIPE_RATIO = 0.25
+
 CONFIGS = {
     "per_leaf": dict(batch_collectives=False, grad_dtype=None,
                      exchange="allreduce"),
@@ -133,6 +140,28 @@ CONFIGS = {
                                  exchange="reduce_scatter",
                                  comm="hierarchical",
                                  inter_size=HIER_INTER_SIZE),
+    # ISSUE 11: the striped multi-path configs — each bucket's payload
+    # splits by STRIPE_RATIO; the DCN-path slice runs the transposed
+    # slow-hop-major exchange concurrently with the fast-hop-major
+    # remainder, so both fabrics carry bulk traffic at once
+    "striped": dict(batch_collectives=True, grad_dtype=None,
+                    exchange="allreduce", comm="hierarchical",
+                    inter_size=HIER_INTER_SIZE,
+                    stripe_ratio=STRIPE_RATIO),
+    "striped_bucketed": dict(batch_collectives="bucketed",
+                             grad_dtype=None, exchange="allreduce",
+                             comm="hierarchical",
+                             inter_size=HIER_INTER_SIZE,
+                             stripe_ratio=STRIPE_RATIO),
+    "striped_dcn_bf16": dict(batch_collectives=True,
+                             grad_dtype={"dcn": "bfloat16"},
+                             exchange="allreduce", comm="hierarchical",
+                             inter_size=HIER_INTER_SIZE,
+                             stripe_ratio=STRIPE_RATIO),
+    "striped_rs": dict(batch_collectives=True, grad_dtype=None,
+                       exchange="reduce_scatter", comm="hierarchical",
+                       inter_size=HIER_INTER_SIZE,
+                       stripe_ratio=STRIPE_RATIO),
 }
 
 
@@ -206,6 +235,67 @@ def row_hop(row, comm):
     return "+".join(row["axes"])
 
 
+#: (prim, hop) → path table of the striped ALLREDUCE exchange: the
+#: ICI path's ops are rs/ag over ici + its chunk psum over dcn; the
+#: DCN path's are the transpose.  Unambiguous because the allreduce
+#: exchange never emits the same primitive on the same axis for both
+#: paths (the striped_rs exchange DOES — both paths chain psum_scatter
+#: over both axes — so its census commits per-hop structure only).
+_STRIPED_ALLREDUCE_PATHS = {
+    ("reduce_scatter", "ici"): "ici", ("all_gather", "ici"): "ici",
+    ("psum", "dcn"): "ici",
+    ("reduce_scatter", "dcn"): "dcn", ("all_gather", "dcn"): "dcn",
+    ("psum", "ici"): "dcn",
+}
+
+
+def row_path(row, comm):
+    """PATH label of a census row (ISSUE 11): which slice's exchange
+    the collective belongs to.  ``world`` on flat communicators,
+    ``hier`` on the single-path hierarchical exchange; on the striped
+    allreduce exchange ``ici``/``dcn`` resolved from the (primitive,
+    hop) pair.  A pair the table cannot place (e.g. the striped_rs
+    chains, where both paths scatter over both axes) surfaces as a
+    joined ``prim@hop`` label the per-path gates reject."""
+    if comm.hierarchy is None:
+        return "world"
+    if not getattr(comm, "striped", False):
+        return "hier"
+    hop = row_hop(row, comm)
+    return _STRIPED_ALLREDUCE_PATHS.get(
+        (row["prim"], hop), f"{row['prim']}@{hop}")
+
+
+def row_phase(row):
+    """Schedule phase of a census row: ``epilogue`` for rebuild
+    all_gathers, ``exchange`` for every scatter/crossing op.  An
+    all_gather whose operand rides a QUANTIZED wire dtype is a
+    codeword CROSSING (the gather-based quantized hop), not a rebuild
+    — the distinction the generalized ``hop_ordered`` gate needs."""
+    from chainermn_tpu.communicators._memory_utility import \
+        is_quantized_dtype
+    if row["prim"] == "all_gather" and not is_quantized_dtype(row["dtype"]):
+        return "epilogue"
+    return "exchange"
+
+
+def hop_ordered(grad_rows):
+    """The generalized per-path ordering gate (ISSUE 11 satellite —
+    the old check hard-assumed every DCN op precedes every ICI
+    all_gather, which only holds for single-path schedules): every
+    scatter/crossing op of EVERY path precedes every rebuild
+    all_gather of ANY path in program order.  For the hierarchical
+    exchange this degenerates to the old slow-hop-first property
+    (rs + dcn crossing before the ici rebuild); for striped schedules
+    it is exactly "both paths eligible before any bucket's epilogue"
+    — the concurrency window the striped hop_schedule promises."""
+    ex_idx = [i for i, r in enumerate(grad_rows)
+              if row_phase(r) == "exchange"]
+    ep_idx = [i for i, r in enumerate(grad_rows)
+              if row_phase(r) == "epilogue"]
+    return not ex_idx or not ep_idx or max(ex_idx) < min(ep_idx)
+
+
 def row_ring(row, comm):
     """Ring size of a census row's collective: the product of its mesh
     axis sizes."""
@@ -277,7 +367,7 @@ class _Vertical:
 
 def trace_step(exchange="allreduce", batch_collectives=True,
                grad_dtype=None, bucket_mb=None, comm_name="jax_ici",
-               inter_size=None):
+               inter_size=None, stripe_ratio=None):
     """Jaxpr of the REAL compiled multi-node train step for one config
     — the exact step makers ``update()`` dispatches, traced instead of
     executed (no XLA compile; CPU-safe)."""
@@ -289,7 +379,7 @@ def trace_step(exchange="allreduce", batch_collectives=True,
     comm = ct.create_communicator(
         comm_name, batch_collectives=batch_collectives,
         allreduce_grad_dtype=grad_dtype, bucket_mb=bucket_mb,
-        inter_size=inter_size)
+        inter_size=inter_size, stripe_ratio=stripe_ratio)
     comm.bcast_data(vert.model)
     from chainermn_tpu.core.optimizer import MomentumSGD
     inner = MomentumSGD(lr=0.1, momentum=0.9)
@@ -331,7 +421,8 @@ def config_row(name):
                              grad_dtype=cfg["grad_dtype"],
                              bucket_mb=bucket_mb,
                              comm_name=cfg.get("comm", "jax_ici"),
-                             inter_size=cfg.get("inter_size"))
+                             inter_size=cfg.get("inter_size"),
+                             stripe_ratio=cfg.get("stripe_ratio"))
     census = collective_census(jaxpr)
     grad = [r for r in census if r["elems"] >= GRAD_ELEMS_FLOOR]
     counts = {}
@@ -404,16 +495,30 @@ def config_row(name):
             for r in dcn_grad_rows)
         row["dcn_payload_bytes_ratio"] = \
             dcn_payload_bytes / (vert.n_params * 4)
-        # slow-hop-first emission (hop_schedule): every DCN collective
-        # (psum, quantized all_gather/all_to_all, the rs params rebuild)
-        # precedes every fast-hop all_gather in program order
-        ag_idx = [i for i, r in enumerate(grad)
-                  if r["prim"] == "all_gather"
-                  and row_hop(r, comm) == "ici"]
-        dcn_idx = [i for i, r in enumerate(grad)
-                   if row_hop(r, comm) == "dcn"]
-        row["hop_ordered"] = (not ag_idx or not dcn_idx
-                              or max(dcn_idx) < min(ag_idx))
+        # per-path ordering (generalized, ISSUE 11 satellite): every
+        # scatter/crossing op — psum, reduce_scatter, all_to_all, and
+        # quantized-codeword all_gathers, on EITHER path — precedes
+        # every rebuild all_gather in program order, so the striped
+        # configs are budget-gated instead of exempted and the old
+        # every-DCN-op-before-every-ICI-rebuild property falls out as
+        # the single-path special case
+        row["hop_ordered"] = hop_ordered(grad)
+        if comm.striped:
+            row["stripe_ratio"] = comm.stripe_ratio
+            if cfg["exchange"] == "allreduce":
+                # per-PATH byte accounting (the ISSUE 11 satellite):
+                # each collective priced at its wire dtype and charged
+                # to the slice whose exchange it implements — the
+                # conservation identity (path totals sum to the flat
+                # allreduce figure) and the committed-share identity
+                # (dcn path total / grand total == stripe_ratio) are
+                # gated from these, straight off the trace
+                per_path = {}
+                for r in grad:
+                    p = row_path(r, comm)
+                    per_path[p] = per_path.get(p, 0) \
+                        + int(row_wire_bytes(r, comm))
+                row["per_path_bytes"] = per_path
     return row
 
 

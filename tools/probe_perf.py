@@ -637,11 +637,11 @@ def probe_comm():
                 if k not in ("probe", "config")}
         row["within_structure"] = live == committed
         print(json.dumps(row), flush=True)
-    # per-hop table of the hierarchical configs (ISSUE 6): one row per
-    # (config, hop, collective) with the wire bytes and dtype — read
-    # straight off the traced eqns via the SAME row_hop/row_wire_bytes
-    # helpers config_row prices the committed budgets with (one copy;
-    # the two surfaces cannot drift)
+    # per-hop table of the hierarchical/striped configs (ISSUE 6 + 11):
+    # one row per (config, path, hop, collective) with the wire bytes
+    # and dtype — read straight off the traced eqns via the SAME
+    # row_hop/row_path/row_wire_bytes helpers config_row prices the
+    # committed budgets with (one copy; the two surfaces cannot drift)
     for name, cfg in comm_census.CONFIGS.items():
         if cfg.get("comm") != "hierarchical":
             continue
@@ -649,24 +649,32 @@ def probe_comm():
             exchange=cfg["exchange"],
             batch_collectives=cfg["batch_collectives"],
             grad_dtype=cfg["grad_dtype"],
-            comm_name=cfg["comm"], inter_size=cfg.get("inter_size"))
+            comm_name=cfg["comm"], inter_size=cfg.get("inter_size"),
+            stripe_ratio=cfg.get("stripe_ratio"))
         rows = [r for r in comm_census.collective_census(jaxpr)
                 if r["elems"] >= comm_census.GRAD_ELEMS_FLOOR]
         groups = {}
         for r in rows:
-            key = (comm_census.row_hop(r, comm), r["prim"], r["dtype"])
+            # path (ISSUE 11 satellite column): which slice's exchange
+            # the collective implements — "hier" on single-path
+            # configs, "ici"/"dcn" on the striped allreduce ones (the
+            # striped_rs chains are path-ambiguous by (prim, hop) and
+            # label as prim@hop)
+            key = (comm_census.row_path(r, comm),
+                   comm_census.row_hop(r, comm), r["prim"], r["dtype"])
             g = groups.setdefault(key, {"count": 0, "elems": 0,
                                         "bytes": 0})
             g["count"] += 1
             g["elems"] += r["elems"]
             g["bytes"] += int(comm_census.row_wire_bytes(r, comm))
-        for (hop, prim, dtype), g in groups.items():
+        for (path, hop, prim, dtype), g in groups.items():
             # wire_dtype: the dtype actually on the wire (== the
             # operand dtype the census priced); compression_ratio: its
             # itemsize over f32 — 0.25 for the int8/fp8 crossings, 0.5
             # for bf16, 1.0 lossless (ISSUE 8 satellite column)
             print(json.dumps({"probe": "comm_hop_table", "config": name,
-                              "hop": hop, "collective": prim,
+                              "path": path, "hop": hop,
+                              "collective": prim,
                               "dtype": dtype, "wire_dtype": dtype,
                               "compression_ratio":
                                   jnp.dtype(dtype).itemsize / 4.0,

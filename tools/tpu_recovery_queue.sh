@@ -27,9 +27,17 @@
 #     `targets` (status -> measured) to arm tests/test_serving_budget.py's
 #     numeric half.
 #
+#  5. striped split-ratio sweep (ISSUE 11): the three gloo
+#     --gloo-exchange striped --stripe-ratio {0.25,0.5,0.75} curves below;
+#     commit the winning ratio as DEFAULT_STRIPE_RATIO
+#     (communicators/_memory_utility.py) + regenerate comm_budgets
+#     (tools/comm_census.py --write-budgets) so the per-path structure
+#     gates track the committed split.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
-# split A/B, int8/bf16/lossless DCN wire A/B + EF-off ablation, the gloo
-# exposed-comm curves, and the seq-8192 remat rows.
+# split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
+# EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
+# rows.
 # ============================================================================
 #
 # QUEUE_REPO/QUEUE_LOG/QUEUE_NOTES env overrides exist for the bitrot
@@ -145,6 +153,15 @@ run_one "resnet bs64 hierarchical 2x4 int8 DCN no-EF (ablation)" \
 run_one "resnet bs64 hierarchical_rs 2x4 int8 DCN (wire-dtype A/B)" \
   BENCH_EXCHANGE=hierarchical_rs BENCH_INTER_SIZE=2 \
   BENCH_GRAD_DTYPE=int8 BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+# ISSUE 11: the striped multi-path exchange on the 2x4 on-host split —
+# both fabrics carry bulk concurrently instead of hierarchically.
+# Delta vs the hierarchical 2x4 row = the multi-path schedule's on-host
+# cost (the real bandwidth payoff needs the >=2-host ratio sweep below,
+# where DCN is a genuine slow hop).  BENCH_STRIPE_RATIO is
+# fingerprint-excluded from the flagship cache like every exchange knob.
+run_one "resnet bs64 striped exchange 2x4 r=0.25 (multi-path A/B)" \
+  BENCH_EXCHANGE=striped BENCH_INTER_SIZE=2 BENCH_STRIPE_RATIO=0.25 \
+  BENCH_DEADLINE_S=600 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
 # seq-8192 remat rows LAST among the benches, with compile headroom:
@@ -220,6 +237,23 @@ stepf=$STEPDIR/step_commab.log
   # cost across a genuine slow hop
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
     --gloo-exchange hierarchical
+  # ISSUE 11: the >=2-host STRIPED ratio sweep — the committed
+  # per-topology split ratio (DEFAULT_STRIPE_RATIO=0.25 is the
+  # pre-measurement seed) is decided by THIS measurement: the ratio
+  # whose curve wins is what a pod should commit, the way bucket_mb's
+  # winner came from the bucket sweep.  At one device per process the
+  # whole payload crosses the process boundary either way, so the gloo
+  # stand-in A/Bs the collective SHAPES (bulk rs+ag vs chunk
+  # allreduce); rerun on a pod with real ici>1 for the bandwidth split.
+  CHAINERMN_TPU_STRIPE_RATIO=0.25 \
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange striped --stripe-ratio 0.25
+  CHAINERMN_TPU_STRIPE_RATIO=0.5 \
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange striped --stripe-ratio 0.5
+  CHAINERMN_TPU_STRIPE_RATIO=0.75 \
+  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
+    --gloo-exchange striped --stripe-ratio 0.75
   # ISSUE 10: the >=2-host ELASTIC A/B — rank 1 hard-preempted a third
   # of the way in, survivors shrink and keep training, the rank
   # re-joins and the world grows back; the summary line (wall delta vs
