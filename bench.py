@@ -56,7 +56,8 @@ compression — the TPU translation of the reference's flagship
 Env knobs (defaults = the flagship config; any deviation makes the run
 a variant that is excluded from the last-good cache):
 
-  measurement   BENCH_MODEL (resnet50|transformer|longcontext|serving),
+  measurement   BENCH_MODEL (resnet50|transformer|longcontext|serving|
+                moe),
                 BENCH_BS, BENCH_SIZE, BENCH_LAYOUT (NHWC|NCHW),
                 BENCH_SCAN, BENCH_REMAT, BENCH_INPUT_PIPELINE — resnet;
                 BENCH_SEQ, BENCH_D_MODEL, BENCH_LAYERS, BENCH_VOCAB,
@@ -75,6 +76,15 @@ a variant that is excluded from the last-good cache):
                 open-loop Poisson load: tokens/sec + p50/p99 per-token
                 latency + page-pool occupancy; CPU runs clamp to a
                 labeled cpu_smoke row; never cached as flagship data);
+                BENCH_MOE_EXPERTS (chip count), BENCH_MOE_TOPK (1),
+                BENCH_MOE_CAPACITY (1.25), BENCH_MOE_TWO_STAGE
+                (''=auto|0|1) — moe (Switch-FFN expert-parallel
+                vertical: tokens/sec/chip + exchanged dispatch bytes
+                per fabric + moe_dropped_frac; the hierarchical
+                BENCH_EXCHANGE legs run the two-stage ici×dcn dispatch
+                and BENCH_GRAD_DTYPE=int8 quantizes its DCN crossing;
+                CPU runs clamp to a labeled cpu_smoke row; never
+                cached as flagship data);
                 BENCH_STEPS (steps/trial), BENCH_TRIALS,
                 BENCH_PEAK_TFLOPS (MFU denominator override)
                 BENCH_DONATE=0 (A/B leg: disable params/opt-state
@@ -819,17 +829,13 @@ def _exchange_config():
     return exchange, (float(bucket_mb) if bucket_mb else None)
 
 
-def _make_dp_optimizer(inner, model, exchange, bucket_mb):
-    """Communicator + multi-node wrapper for the requested gradient
-    exchange (flagship bf16 gradient compression on every flavor;
-    BENCH_GRAD_DTYPE overrides — ``none`` for lossless, ``int8`` /
-    ``float8_e4m3`` / ``float8_e5m2`` for the quantized-wire A/B, where
-    a scalar quantized dtype compresses the DCN hop only, per the
-    communicator's own rule; BENCH_ERROR_FEEDBACK=0 is the ablation
-    leg).  The hierarchical legs honor BENCH_INTER_SIZE (force a
-    dcn × ici split of the local chips — the on-host structural A/B the
-    queue runs as 2×4; default: infer from the controller topology,
-    i.e. a real multi-host run gets one dcn group per host)."""
+def _make_bench_communicator(exchange, bucket_mb):
+    """Communicator for the requested gradient exchange, from the same
+    env knobs every bench mode reads (BENCH_GRAD_DTYPE /
+    BENCH_INTER_SIZE / BENCH_STRIPE_RATIO / BENCH_ERROR_FEEDBACK).
+    Split out of `_make_dp_optimizer` because the MoE vertical needs
+    the communicator BEFORE the model exists (the expert bank shards
+    over it).  Returns ``(comm, opt_exchange)``."""
     import chainermn_tpu as ct
     comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
     inter_size = _env_int("BENCH_INTER_SIZE", 0) or None
@@ -853,6 +859,27 @@ def _make_dp_optimizer(inner, model, exchange, bucket_mb):
                                   stripe_ratio=stripe_ratio,
                                   error_feedback=os.environ.get(
                                       "BENCH_ERROR_FEEDBACK", "1") == "1")
+    return comm, opt_exchange
+
+
+def _make_dp_optimizer(inner, model, exchange, bucket_mb, comm=None,
+                       opt_exchange=None):
+    """Communicator + multi-node wrapper for the requested gradient
+    exchange (flagship bf16 gradient compression on every flavor;
+    BENCH_GRAD_DTYPE overrides — ``none`` for lossless, ``int8`` /
+    ``float8_e4m3`` / ``float8_e5m2`` for the quantized-wire A/B, where
+    a scalar quantized dtype compresses the DCN hop only, per the
+    communicator's own rule; BENCH_ERROR_FEEDBACK=0 is the ablation
+    leg).  The hierarchical legs honor BENCH_INTER_SIZE (force a
+    dcn × ici split of the local chips — the on-host structural A/B the
+    queue runs as 2×4; default: infer from the controller topology,
+    i.e. a real multi-host run gets one dcn group per host).  Pass a
+    prebuilt ``comm`` (+ its ``opt_exchange``) when the model already
+    holds it — the MoE vertical's expert-parallel axis IS the
+    data-parallel communicator."""
+    import chainermn_tpu as ct
+    if comm is None:
+        comm, opt_exchange = _make_bench_communicator(exchange, bucket_mb)
     comm.bcast_data(model)
     opt = ct.create_multi_node_optimizer(inner, comm,
                                          exchange=opt_exchange)
@@ -1268,6 +1295,200 @@ def _run_bench_transformer():
     if tokens_per_sec is None:
         raise last_err
     return mk_result(tokens_per_sec, compile_s, used_bs, hbm)
+
+
+def _run_bench_moe():
+    """BENCH_MODEL=moe: the Switch-FFN MoE transformer vertical (ISSUE
+    12) — expert-parallel feed-forward blocks over the SAME communicator
+    the data-parallel gradient exchange rides, so a hierarchical
+    BENCH_EXCHANGE gives BOTH the two-level gradient sync and the
+    two-stage (ici → dcn) token dispatch, and BENCH_GRAD_DTYPE's dcn
+    entry compresses both slow-hop crossings.  Reports tokens/sec/chip
+    plus the exchanged DISPATCH bytes per fabric per step (the
+    activation-scaled wire bill the gradient rows cannot see), the
+    committed off_host_dispatch_ratio, and the routing-honesty column
+    moe_dropped_frac (capacity-cut fraction, from the model's own
+    reported observation).
+
+    Knobs: BENCH_MOE_EXPERTS (default = chip count; experts are
+    rank-sharded one per device, so any other value on this mesh is a
+    loud error — the knob exists for pods), BENCH_MOE_TOPK (1 = Switch
+    top-1 routing, >1 = the GShard top-k mixture),
+    BENCH_MOE_CAPACITY (capacity factor, default 1.25),
+    BENCH_MOE_TWO_STAGE (''=topology-aware auto, 0 = the explicit
+    flat-dispatch escape on a hierarchical comm — the structural A/B).
+    MoE rows are metric-fenced out of the flagship last-good cache by
+    construction (the metric is not in _METRIC_TO_MODEL — the serving/
+    longcontext discipline); a successful on-chip run stamps its own
+    prewarm sentinel.  CPU runs clamp to a labeled cpu_smoke row."""
+    import jax
+    _enable_compile_cache(jax)
+    import jax.numpy as jnp
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.core import reporter
+    from chainermn_tpu.core.optimizer import Adam
+    from chainermn_tpu.models import MoETransformerLM
+
+    devices = jax.devices()
+    n_devices = len(devices)
+    platform = devices[0].platform
+    cpu_smoke = jax.default_backend() == "cpu"
+
+    per_chip_bs = _env_int("BENCH_BS", 8)
+    seq_len = _env_int("BENCH_SEQ", 512)
+    d_model = _env_int("BENCH_D_MODEL", 512)
+    n_layers = _env_int("BENCH_LAYERS", 6)
+    n_vocab = _env_int("BENCH_VOCAB", DEFAULT_TF_VOCAB)
+    n_steps, short_steps = _effective_steps(DEFAULT_TF_STEPS)
+    topk = _env_int("BENCH_MOE_TOPK", 1)
+    capacity_factor = _env_float("BENCH_MOE_CAPACITY", 1.25)
+    experts = _env_int("BENCH_MOE_EXPERTS", n_devices)
+    ts_env = os.environ.get("BENCH_MOE_TWO_STAGE", "")
+    two_stage = None if ts_env == "" else ts_env == "1"
+    donate = os.environ.get("BENCH_DONATE", "1") == "1"
+    if cpu_smoke:
+        # clamp: the CPU smoke must finish in seconds — labeled, and
+        # never readable as an MoE measurement
+        per_chip_bs = min(per_chip_bs, 2)
+        seq_len = min(seq_len, 32)
+        d_model = min(d_model, 64)
+        n_layers = min(n_layers, 2)
+        n_vocab = min(n_vocab, 512)
+        n_steps = min(n_steps, 3)
+    if experts != n_devices:
+        raise ValueError(
+            f"BENCH_MOE_EXPERTS={experts}: experts are rank-sharded one "
+            f"per device and this mesh has {n_devices} — the knob exists "
+            f"for larger pods, it cannot invent experts here")
+    n_heads = _env_int("BENCH_HEADS", 0) or max(1, d_model // 64)
+    exchange, bucket_mb = _exchange_config()
+
+    comm, opt_exchange = _make_bench_communicator(exchange, bucket_mb)
+    model = MoETransformerLM(
+        n_vocab=n_vocab, ep_comm=comm, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, max_len=seq_len, seed=0,
+        capacity_factor=capacity_factor, topk=topk, two_stage=two_stage,
+        compute_dtype=jnp.bfloat16)
+    inner = Adam(alpha=3e-4)
+    inner.donate_params = donate
+    comm, opt = _make_dp_optimizer(inner, model, exchange, bucket_mb,
+                                   comm=comm, opt_exchange=opt_exchange)
+    exchange_info = {"exchange": exchange, "bucket_mb": bucket_mb}
+    exchange_info.update(_exchange_row_fields(model, comm, exchange))
+
+    # dispatch wire bill (the activation-scaled bytes this vertical
+    # exists to measure): tokens route per rank per layer through an
+    # [E, C, D] capacity buffer at the bf16 compute dtype; priced by
+    # the ONE surface the census identities are pinned against
+    from chainermn_tpu.communicators._memory_utility import \
+        moe_dispatch_exchanged_bytes
+    from chainermn_tpu.parallel.moe import _resolve_two_stage, moe_capacity
+    # the resolution rule and capacity formula the dispatch itself
+    # applies — so the priced byte columns can never describe a
+    # different exchange than the model runs (and an impossible
+    # request fails here, before any compile, with the dispatch's own
+    # error)
+    resolved_two_stage = _resolve_two_stage(comm, two_stage)
+    tokens_local = per_chip_bs * seq_len
+    capacity = moe_capacity(tokens_local, experts, capacity_factor,
+                            k=max(topk, 1))
+    disp_elems = experts * capacity * d_model
+    wire_itemsize = 2  # bf16 compute dtype
+    dcn_wire = comm.dcn_grad_dtype
+    hops = moe_dispatch_exchanged_bytes(
+        disp_elems * wire_itemsize, comm.ici_size, comm.dcn_size,
+        two_stage=resolved_two_stage,
+        dcn_n_bytes=disp_elems * dcn_wire.itemsize
+        if (resolved_two_stage and dcn_wire is not None) else None)
+    moe_info = {
+        "moe_experts": experts, "moe_topk": topk,
+        "capacity_factor": capacity_factor,
+        "moe_capacity": capacity,
+        "two_stage": resolved_two_stage,
+        "off_host_dispatch_ratio":
+            (comm.dcn_size - 1) / comm.dcn_size
+            if comm.hierarchy is not None else None,
+        # per step = per layer bill × layers (dispatch + combine round
+        # trip each); flat single-axis rows carry the joint figure
+        "dispatch_bytes_ici": hops.get("ici", 0) * n_layers,
+        "dispatch_bytes_dcn": hops.get("dcn", 0) * n_layers,
+        "dispatch_bytes_world": hops.get("world", 0) * n_layers,
+    }
+
+    global_bs = per_chip_bs * n_devices
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, n_vocab, (global_bs, seq_len))
+                    .astype(np.int32))
+    t = jnp.asarray(np.roll(np.asarray(x), -1, axis=1))
+
+    def mk_result(tokens_per_sec, compile_s, dropped, hbm=None):
+        per_chip = tokens_per_sec / n_devices
+        result = {
+            "metric": "moe_lm_train_throughput",
+            "value": round(per_chip, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,  # greenfield: the reference had no MoE
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", platform),
+            "n_devices": n_devices,
+            "per_chip_batch": per_chip_bs,
+            "seq_len": seq_len, "d_model": d_model,
+            "n_layers": n_layers, "n_vocab": n_vocab,
+            "n_steps": n_steps, "donated": donate,
+            "moe_dropped_frac": dropped,
+            "compile_s": round(compile_s, 1),
+        }
+        result.update(exchange_info)
+        result.update(moe_info)
+        if short_steps:
+            result["short_steps"] = True
+        if cpu_smoke:
+            result["cpu_smoke"] = True
+        if hbm is not None:
+            result["peak_hbm_bytes"] = hbm["peak_hbm_bytes"]
+            result["hbm"] = hbm
+        return result
+
+    # capture the model's own routing-honesty observation (reported
+    # through the reporter on every update) alongside the timings —
+    # observers must be registered on the scoped reporter or the
+    # in-step report raises at trace time.  The value is READ (a
+    # device->host sync) only outside the timed loop: a per-step
+    # float() inside do_steps would serialize dispatches and deflate
+    # tokens/sec relative to every other bench vertical.
+    rep = reporter.Reporter()
+    rep.add_observer("main", model)
+    rep.add_observers("main", model.namedlinks(skipself=True))
+    obs = {}
+
+    def do_steps():
+        with rep.scope(obs):
+            return opt.update(model, x, t)
+
+    def dropped():
+        for key, value in obs.items():
+            if key.endswith("moe_dropped"):
+                return round(float(value), 4)
+        return None
+
+    def on_first(elapsed, compile_s):
+        tps = n_steps * global_bs * seq_len / elapsed
+        _emit(mk_result(tps, compile_s, dropped()))
+
+    best, compile_s = _timed_steps(do_steps, n_steps, on_first=on_first)
+    result = mk_result(n_steps * global_bs * seq_len / best, compile_s,
+                       dropped(), _step_hbm_stats(opt))
+    if not cpu_smoke and result["value"] is not None:
+        # a real on-chip MoE run warms this model family's sentinel
+        # (the metric is not in _METRIC_TO_MODEL — MoE rows are never
+        # flagship-cacheable — so _emit won't stamp it)
+        try:
+            with open(_prewarm_sentinel("moe"), "w") as f:
+                f.write(f"{os.environ['BENCH_RUN_ID']} {time.time()}\n")
+        except Exception:
+            pass
+    return result
 
 
 def _run_bench_longcontext():
@@ -1859,6 +2080,8 @@ def _err_metric():
         return ("longcontext_flash_feasibility", "tokens_context")
     if model == "serving":
         return ("serving_engine_throughput", "tokens/sec")
+    if model == "moe":
+        return ("moe_lm_train_throughput", "tokens/sec/chip")
     return ("resnet50_imagenet_train_throughput", "images/sec/chip")
 
 
@@ -1978,6 +2201,8 @@ def _child_main():
             result = _run_bench_longcontext()
         elif bench_model == "serving":
             result = _run_bench_serving()
+        elif bench_model == "moe":
+            result = _run_bench_moe()
         else:
             result = _run_bench()
         _emit(result)  # final (possibly improved over the early emit)

@@ -141,6 +141,12 @@ def create_communicator(communicator_name="jax_ici", devices=None,
     convergence is parity-gated, not bit-exact).
     ``CHAINERMN_TPU_COMPRESS=off`` is the factory-level escape hatch:
     quantized wires fall back to lossless (bf16 casts untouched).
+    ISSUE 12: on hierarchical flavors the ``dcn`` entry ALSO
+    compresses the MoE token dispatch's slow crossing
+    (``parallel.moe`` two-stage exchange: bf16 cast, or int8/fp8
+    codewords with per-segment scales) — one knob, every slow-hop
+    traffic class; the ICI stage of the dispatch is lossless by
+    design like every fast hop.
     ``devices``:
     subset of ``jax.devices()`` (default all).  ``batch_collectives``:
     ``False`` (per-leaf collectives), ``True`` (one flat bucket — the
@@ -279,12 +285,18 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                 # degradation): the caller asked for multi-path wire
                 # use and gets the flat single-path exchange instead
                 _warn_hierarchy_flat_stripe_dropped(eff_stripe)
-            return MeshCommunicator(
+            comm = MeshCommunicator(
                 devices=devices, axis_name=axis_name,
                 allreduce_grad_dtype=allreduce_grad_dtype,
                 batch_collectives=batch_collectives,
                 bucket_mb=bucket_mb, name="jax_ici",
                 error_feedback=error_feedback)
+            # the hatch DEGRADED a requested hierarchy to one axis:
+            # record it, so downstream topology-aware consumers (the
+            # MoE two-stage dispatch) can warn precisely — a comm that
+            # was never hierarchical must not trigger hatch warnings
+            comm._hierarchy_flattened_by_env = True
+            return comm
     return MeshCommunicator(devices=devices, axis_name=axis_name,
                             allreduce_grad_dtype=allreduce_grad_dtype,
                             batch_collectives=batch_collectives,
@@ -300,6 +312,33 @@ _WARNED_FLAT_DICTS = set()
 
 #: stripe ratios already warned about under the flat escape hatch
 _WARNED_FLAT_STRIPES = set()
+
+#: one-time latch for the MoE two-stage drop under the flat hatch
+#: (ISSUE 12 satellite — same not-silent pattern as striping: the
+#: caller asked for multi-fabric wire use and gets the single-axis
+#: exchange instead)
+_WARNED_FLAT_TWO_STAGE = set()
+
+
+def _warn_hierarchy_flat_two_stage_dropped():
+    """CHAINERMN_TPU_HIERARCHY=flat is active and an MoE dispatch that
+    would have run the two-stage (ici → dcn) token exchange is running
+    the flat single-axis ``all_to_all`` instead.  Warn once per process
+    (``parallel.moe`` calls this at dispatch resolution time — the
+    factory cannot know at construction that a communicator will carry
+    MoE traffic)."""
+    import warnings
+    if _WARNED_FLAT_TWO_STAGE:
+        return
+    _WARNED_FLAT_TWO_STAGE.add(True)
+    warnings.warn(
+        "CHAINERMN_TPU_HIERARCHY=flat drops two-stage MoE routing: the "
+        "flat one-axis alias has a single fabric, so token dispatch "
+        "runs the flat single-axis all_to_all (on-host tokens ride the "
+        "same collective as off-host ones and the DCN crossing cannot "
+        "be compressed separately).  Unset CHAINERMN_TPU_HIERARCHY to "
+        "restore the two-stage ici × dcn dispatch.",
+        UserWarning, stacklevel=4)
 
 
 def _warn_hierarchy_flat_stripe_dropped(stripe_ratio):

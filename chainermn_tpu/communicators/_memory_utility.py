@@ -29,9 +29,10 @@ import jax.numpy as jnp
 __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
            "plan_buckets", "bucket_table", "hop_schedule", "stripe_plan",
            "exchanged_bytes", "hierarchical_exchanged_bytes",
-           "striped_exchanged_bytes",
+           "striped_exchanged_bytes", "moe_dispatch_exchanged_bytes",
            "pad_to_multiple", "QUANTIZED_DTYPES", "resolve_grad_dtype",
            "is_quantized_dtype", "quantize_symmetric",
+           "quantize_symmetric_segments",
            "dequantize_symmetric", "quantization_residual",
            "quantized_hop_bytes"]
 
@@ -153,12 +154,35 @@ def hop_schedule(n_buckets, mode="hierarchical"):
       traffic is still draining on either fabric.  This is the
       per-path ordering the generalized census ``hop_ordered`` gate
       validates.
+
+    ``mode="moe"`` (ISSUE 12, the two-stage expert-parallel token
+    exchange) — one "bucket" is one MoE layer's dispatch buffer.  Ops
+    per bucket: ``ici_dispatch`` (fast hop: tokens regroup by
+    destination SLOT within the host, so tokens whose expert lives
+    on-host finish here) → ``dcn_dispatch`` (slow hop: only the
+    off-host remainder crosses — issued immediately after its fast
+    stage, as early as dataflow allows), then the combine epilogue
+    ``dcn_combine`` → ``ici_combine`` — the TRANSPOSED reverse, slow
+    hop first again so the combine's DCN crossing starts the moment
+    the expert compute closes.  The two stages commute as index
+    permutations (they act on disjoint buffer dims), so this order is
+    a schedule CHOICE with the same result content — pinned here as a
+    pure function the dispatch follows literally, like every other
+    exchange.
     """
     if n_buckets < 0:
         raise ValueError(f"n_buckets must be >= 0, got {n_buckets}")
-    if mode not in ("hierarchical", "striped"):
+    if mode not in ("hierarchical", "striped", "moe"):
         raise ValueError(f"unknown hop_schedule mode {mode!r}")
     schedule = []
+    if mode == "moe":
+        for b in range(n_buckets):
+            schedule.append(("ici_dispatch", b))
+            schedule.append(("dcn_dispatch", b))
+        for b in range(n_buckets):
+            schedule.append(("dcn_combine", b))
+            schedule.append(("ici_combine", b))
+        return schedule
     if mode == "striped":
         for b in range(n_buckets):
             schedule.append(("dcn_path_scatter", b))
@@ -309,6 +333,19 @@ def quantize_symmetric(v, wire_dtype):
 def dequantize_symmetric(q, scale):
     """Inverse of :func:`quantize_symmetric`: ``q·scale`` in f32."""
     return q.astype(jnp.float32) * scale
+
+
+def quantize_symmetric_segments(v, wire_dtype):
+    """Per-SEGMENT symmetric quantization along the leading axis: one
+    ``(q, scale)`` pair per segment, via :func:`quantize_symmetric`
+    vmapped over ``v[0]`` — the MoE dispatch's slow-crossing codebook
+    (ISSUE 12).  Each destination group's block quantizes with its OWN
+    scale (one absmax per segment, so a hot expert's activations cannot
+    flatten a quiet one's codewords), and the ``[segments]`` scale
+    vector ships alongside the codewords on its own tiny collective.
+    Inherits quantize_symmetric's determinism/zero/non-finite
+    contracts per segment.  Returns ``(q [S, ...], scales [S])``."""
+    return jax.vmap(lambda seg: quantize_symmetric(seg, wire_dtype))(v)
 
 
 def quantization_residual(v, q, scale):
@@ -536,6 +573,41 @@ def striped_exchanged_bytes(n_bytes, intra_size, inter_size, ratio,
     for p in (ici_path, dcn_path):
         p["total"] = p["ici"] + p["dcn"]
     return {"ici_path": ici_path, "dcn_path": dcn_path}
+
+
+def moe_dispatch_exchanged_bytes(n_bytes, intra_size, inter_size,
+                                 two_stage=True, dcn_n_bytes=None):
+    """Per-replica wire bytes of ONE MoE layer's token exchange — the
+    dispatch + combine round trip on an ``n_bytes`` capacity buffer
+    (``[E, C, D]`` at the compute wire dtype) — split by fabric
+    (ISSUE 12):
+
+    * ``two_stage=True``: an ``all_to_all`` over ICI each way
+      (``n·(intra−1)/intra``) plus an ``all_to_all`` over DCN each way
+      carrying only the off-host remainder (``n·(inter−1)/inter`` —
+      the ring keeps the own-host segment local, so the slow-fabric
+      bill IS the ``off_host_dispatch_ratio`` share of the buffer).
+      ``dcn_n_bytes`` overrides the slow crossing's buffer bytes for
+      the compressed variants (bf16 halves it, int8/fp8 quarter it;
+      the per-segment scale vectors are O(inter) — excluded, like the
+      gradient census's scale gathers).  Returns ``{"ici", "dcn"}``.
+    * ``two_stage=False``: the flat single collective — one
+      ``all_to_all`` each way over the JOINT ``intra·inter`` ring
+      (``n·(E−1)/E``), one fabric label, unsplittable and
+      uncompressible per hop.  Returns ``{"world": ...}``.
+
+    This is the ONE pricing surface bench.py's MoE rows and the
+    committed MoE census identities share.
+    """
+    if two_stage:
+        ici = exchanged_bytes(n_bytes, intra_size, "all_to_all")
+        dcn = exchanged_bytes(
+            n_bytes if dcn_n_bytes is None else dcn_n_bytes,
+            inter_size, "all_to_all")
+        return {"ici": 2 * ici, "dcn": 2 * dcn}
+    world = exchanged_bytes(n_bytes, intra_size * inter_size,
+                            "all_to_all")
+    return {"world": 2 * world}
 
 
 def pack_params(params, attr="grad", dtype=None):

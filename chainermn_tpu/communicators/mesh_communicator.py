@@ -1450,6 +1450,18 @@ class MeshCommunicator(CommunicatorBase):
             return mapped(*args)
         return jax.jit(mapped)(*args)
 
+    def axis_in_scope(self):
+        """Public form of the axis-environment query: True when EVERY
+        mesh axis this communicator's collectives address is bound by
+        an enclosing ``shard_map`` of the current trace.  The dispatch
+        guard model code uses (``models.transformer._axis_bound``,
+        ``parallel.moe``) — a hierarchical communicator binds TWO axes
+        and a bare ``axis_exists(self.axis_name)`` probe is False for
+        the tuple, which is exactly how the MoE layer used to fall
+        back to DENSE routing on a two-level mesh without a word
+        (ISSUE 12 guard rail)."""
+        return self._axis_in_scope()
+
     def _axis_in_scope(self):
         """True when this communicator's mesh axis is bound by an
         enclosing shard_map of the current trace — an explicit

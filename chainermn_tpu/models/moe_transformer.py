@@ -6,9 +6,14 @@ expert bank shards one-expert-per-rank over an ``ep`` mesh axis
 ``models.transformer``.  Inside a compiled step each rank slices its
 expert from the replicated bank (``functions.psum_gradient`` keeps the
 bank's gradients exact under the replicated-loss convention) and tokens
-are exchanged with one ``all_to_all`` round trip per layer.  Outside any
-mesh axis the layer degrades to dense top-1 routing — same math, no
-collectives — so the same weights run single-device and expert-parallel.
+are exchanged with one ``all_to_all`` round trip per layer — TWO-STAGE
+over the ici × dcn hierarchy when ``ep_comm`` is hierarchical (ISSUE 12:
+on-host tokens never touch the slow fabric, the DCN crossing compresses
+under the communicator's per-hop dtype; ``two_stage=False`` is the
+explicit single-axis escape).  ``topk > 1`` switches the router to the
+GShard-style top-k mixture.  Outside any mesh axis the layer degrades to
+dense routing — same math, no collectives — so the same weights run
+single-device and expert-parallel.
 """
 
 from __future__ import annotations
@@ -30,10 +35,12 @@ __all__ = ["MoEFeedForward", "MoETransformerBlock", "MoETransformerLM"]
 
 class MoEFeedForward(Chain):
     def __init__(self, d_model, d_ff, ep_comm, capacity_factor=1.25,
-                 seed=0):
+                 seed=0, topk=1, two_stage=None):
         super().__init__()
         self.ep_comm = ep_comm
         self.capacity_factor = capacity_factor
+        self.topk = int(topk)
+        self.two_stage = two_stage
         E = ep_comm.size
         rng = np.random.RandomState(seed)
         with self.init_scope():
@@ -51,7 +58,8 @@ class MoEFeedForward(Chain):
         tokens = x.reshape(B * T, D)
         comm = self.ep_comm
         if _axis_bound(comm):
-            from ..parallel.moe import moe_dispatch_combine
+            from ..parallel.moe import (moe_dispatch_combine,
+                                        moe_dispatch_combine_topk)
             # slice this rank's expert from the (replicated) bank;
             # psum_gradient reassembles the bank's gradient exactly
             idx = jax.lax.axis_index(comm.axis_name)
@@ -68,33 +76,56 @@ class MoEFeedForward(Chain):
             def expert_fn(h):
                 return F.gelu(h @ w_in + b_in) @ w_out + b_out
 
-            out, aux = moe_dispatch_combine(
-                comm, tokens, gate_logits, expert_fn,
-                capacity_factor=self.capacity_factor)
+            if self.topk > 1:
+                out, aux = moe_dispatch_combine_topk(
+                    comm, tokens, gate_logits, expert_fn, k=self.topk,
+                    capacity_factor=self.capacity_factor,
+                    two_stage=self.two_stage)
+            else:
+                out, aux = moe_dispatch_combine(
+                    comm, tokens, gate_logits, expert_fn,
+                    capacity_factor=self.capacity_factor,
+                    two_stage=self.two_stage)
             if aux_sink is not None:
-                aux_sink.append(aux["aux_loss"])
+                aux_sink.append({"aux_loss": aux["aux_loss"],
+                                 "dropped_frac": aux["dropped_frac"]})
             return out.reshape(B, T, D)
-        # dense top-1 fallback (no mesh axis): every expert computed,
-        # argmax-selected per token — identical routing math
+        # dense fallback (no mesh axis): every expert computed, top-1
+        # argmax-selected (or the top-k mixture) per token — identical
+        # routing math, no capacity cut (dense drops nothing)
         probs = jax.nn.softmax(tokens @ self.router.array, axis=-1)
-        eidx = jnp.argmax(probs, axis=-1)
-        gate = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+        E = comm.size
         h = jnp.einsum("td,edh->teh", tokens, self.w_in.array) \
             + self.b_in.array[None]
         y = jnp.einsum("teh,ehd->ted", F.gelu(h), self.w_out.array) \
             + self.b_out.array[None]
-        out = jnp.take_along_axis(
-            y, eidx[:, None, None].repeat(D, axis=2), 1)[:, 0]
-        if aux_sink is not None:
-            E = comm.size
+        if self.topk > 1:
+            gates, experts = jax.lax.top_k(probs, self.topk)   # [T, k]
+            gates = gates / jnp.maximum(
+                gates.sum(axis=1, keepdims=True), 1e-9)
+            picked = jnp.take_along_axis(
+                y, experts[:, :, None].repeat(D, axis=2), 1)   # [T, k, D]
+            out = jnp.sum(picked * gates[:, :, None], axis=1)
+            frac = jnp.mean(
+                jax.nn.one_hot(experts, E).max(axis=1), axis=0)
+        else:
+            eidx = jnp.argmax(probs, axis=-1)
+            gate = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+            out = jnp.take_along_axis(
+                y, eidx[:, None, None].repeat(D, axis=2), 1)[:, 0]
+            out = out * gate[:, None]
             frac = jnp.mean(jax.nn.one_hot(eidx, E), axis=0)
-            aux_sink.append(E * jnp.sum(frac * jnp.mean(probs, axis=0)))
-        return (out * gate[:, None]).reshape(B, T, D)
+        if aux_sink is not None:
+            aux_sink.append({
+                "aux_loss": E * jnp.sum(frac * jnp.mean(probs, axis=0)),
+                "dropped_frac": jnp.float32(0.0)})
+        return out.reshape(B, T, D)
 
 
 class MoETransformerBlock(Chain):
     def __init__(self, d_model, n_heads, d_ff, ep_comm, seed=0,
-                 sp_comm=None, sp_mode="ring", capacity_factor=1.25):
+                 sp_comm=None, sp_mode="ring", capacity_factor=1.25,
+                 topk=1, two_stage=None):
         super().__init__()
         with self.init_scope():
             self.ln1 = L.LayerNormalization(d_model)
@@ -102,7 +133,8 @@ class MoETransformerBlock(Chain):
                                            sp_comm=sp_comm, sp_mode=sp_mode)
             self.ln2 = L.LayerNormalization(d_model)
             self.moe = MoEFeedForward(d_model, d_ff, ep_comm,
-                                      capacity_factor, seed=seed + 50)
+                                      capacity_factor, seed=seed + 50,
+                                      topk=topk, two_stage=two_stage)
 
     def forward(self, x, aux_sink=None, causal=True):
         h = x + self.attn(self.ln1(x), causal=causal)
@@ -111,12 +143,17 @@ class MoETransformerBlock(Chain):
 
 class MoETransformerLM(Chain):
     """Causal LM with MoE feed-forwards; ``aux_weight`` scales the Switch
-    load-balancing loss added to the LM loss."""
+    load-balancing loss added to the LM loss.  ``topk``/``two_stage``
+    thread through to every block's dispatch (ISSUE 12); the reported
+    observations carry ``moe_aux`` (mean load-balancing loss) and
+    ``moe_dropped`` (mean capacity-cut fraction — the honesty column
+    the bench rows read)."""
 
     def __init__(self, n_vocab, ep_comm, d_model=128, n_heads=4,
                  n_layers=2, d_ff=None, max_len=2048, seed=0,
                  aux_weight=0.01, capacity_factor=1.25,
-                 compute_dtype=None, remat=False):
+                 compute_dtype=None, remat=False, topk=1,
+                 two_stage=None):
         super().__init__()
         d_ff = d_ff or 4 * d_model
         self.aux_weight = aux_weight
@@ -135,7 +172,8 @@ class MoETransformerLM(Chain):
             self.blocks = ChainList(*[
                 MoETransformerBlock(d_model, n_heads, d_ff, ep_comm,
                                     seed=seed + 100 * (i + 1),
-                                    capacity_factor=capacity_factor)
+                                    capacity_factor=capacity_factor,
+                                    topk=topk, two_stage=two_stage)
                 for i in range(n_layers)])
             self.ln_f = L.LayerNormalization(d_model)
             self.head = L.Linear(d_model, n_vocab, nobias=True,
@@ -170,6 +208,9 @@ class MoETransformerLM(Chain):
         logits = self.head(h.reshape(B * T, -1))
         loss = F.softmax_cross_entropy(logits, t.reshape(-1),
                                        ignore_label=-1)
-        aux = sum(aux_sink) / max(len(aux_sink), 1)
-        reporter.report({"loss": loss, "moe_aux": aux}, self)
+        n = max(len(aux_sink), 1)
+        aux = sum(a["aux_loss"] for a in aux_sink) / n
+        dropped = sum(a["dropped_frac"] for a in aux_sink) / n
+        reporter.report({"loss": loss, "moe_aux": aux,
+                         "moe_dropped": dropped}, self)
         return loss + self.aux_weight * aux

@@ -26,10 +26,20 @@ __all__ = ["MultiHeadAttention", "TransformerBlock", "TransformerLM"]
 
 
 def _axis_bound(comm):
+    # a hierarchical communicator's axis_name is a (dcn, ici) TUPLE and
+    # ALL of its axes must be bound — a bare axis_exists(tuple) probe is
+    # False, which used to silently drop parallel layers (the MoE block
+    # fell back to dense routing on a two-level mesh; ISSUE 12 guard
+    # rail).  Communicators own the multi-axis form of this query.
     if comm is None or comm.axis_name is None:
         return False
+    check = getattr(comm, "axis_in_scope", None)
+    if check is not None:
+        return check()
     from jax._src.core import get_axis_env
-    return get_axis_env().axis_exists(comm.axis_name)
+    names = comm.axis_name if isinstance(comm.axis_name, (tuple, list)) \
+        else (comm.axis_name,)
+    return all(get_axis_env().axis_exists(n) for n in names)
 
 
 class MultiHeadAttention(Chain):

@@ -2,16 +2,56 @@
 
 Reference status: **absent** in ChainerMN (SURVEY.md §2.6 EP row: "not
 required for parity; all_to_all primitive should still be first-class").
-This module is the beyond-parity realization: experts are sharded one (or
-more) per rank along the communicator axis; tokens are routed top-1
-(Switch Transformer) with fixed per-expert capacity, exchanged with one
-``all_to_all``, transformed by the local expert's fused GEMMs, and
-returned by the reverse ``all_to_all`` — two collectives per MoE layer,
-the canonical EP pattern.
+This module is the beyond-parity realization: experts are sharded one
+per rank along the communicator axis; tokens are routed top-1 (Switch
+Transformer) or top-k (GShard) with fixed per-expert capacity, exchanged
+by ``all_to_all``, transformed by the local expert's fused GEMMs, and
+returned by the reverse exchange.
+
+Topology-aware dispatch (ISSUE 12): on a HIERARCHICAL communicator the
+token exchange is TWO-STAGE — an ``all_to_all`` over the ICI axis first
+(tokens regroup by destination slot within the host, so tokens whose
+expert lives on-host never touch the slow fabric), then an
+``all_to_all`` over DCN carrying only the off-host remainder, with the
+combine path running the transposed reverse (DCN first, then ICI — the
+slow wire starts the moment expert compute closes).  Emission follows
+``_memory_utility.hop_schedule(mode="moe")`` literally.  The two stages
+compose to EXACTLY the flat single-axis ``all_to_all`` (they permute
+disjoint buffer dims), so the lossless two-stage dispatch is golden —
+bit-for-bit — equal to the flat reference
+(tests/core_tests/test_exchange_equivalence.py).
+
+The DCN crossing compresses via the PR 7 per-hop machinery: with
+``allreduce_grad_dtype={"dcn": "bfloat16"}`` the off-host blocks cross
+as bf16; with an int8/fp8 dcn dtype they cross as codewords with
+PER-SEGMENT symmetric scales (``quantize_symmetric_segments`` — one
+scale per destination host block, shipped as a q+scale pair alongside
+the codewords; the backward cotangents ride the same compressed
+transposed crossing, straight-through).  ICI stays lossless BY DESIGN,
+and the own-host block of a compressed crossing is restored from the
+pre-quantization values — it never left the device, so it never pays
+the codebook (the behavioral form of "on-host tokens never touch the
+slow fabric", pinned by tests/parallel_tests/test_moe.py).  The
+quantized path is NOT bit-exact and gates on convergence parity (the
+5% final-loss band, like error feedback), while the lossless two-stage
+path gates on bit-parity with the flat reference.
+
+Escape hatches: ``two_stage=False`` is the EXPLICIT single-axis choice
+on a multi-axis communicator (a hierarchical comm defaults to
+two-stage — silent flat routing on a two-level mesh is the failure
+mode this knob closes); ``CHAINERMN_TPU_HIERARCHY=flat`` drops
+two-stage routing with a one-time warning (the PR 11 striping
+pattern); ``CHAINERMN_TPU_COMPRESS=off`` already nulls the quantized
+dcn dtype at communicator construction, so the dispatch crossing falls
+back to lossless with no code change.
 
 Static shapes throughout (capacity-bounded dispatch with drop/pad), so
 XLA compiles one program regardless of routing decisions; gradients flow
 through the combine weights (straight-through on the router probability).
+Capacity honesty: the aux dict reports ``dropped_frac`` (the fraction of
+routed token copies zeroed by the capacity cut) next to the ``frac`` /
+``mean_prob`` load-balancing statistics, so benches and parity tests can
+assert capacity is sized honestly instead of silently zeroing overflow.
 """
 
 from __future__ import annotations
@@ -20,7 +60,158 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["switch_moe", "moe_dispatch_combine", "moe_dispatch_combine_topk"]
+__all__ = ["switch_moe", "moe_dispatch_combine", "moe_dispatch_combine_topk",
+           "moe_capacity"]
+
+
+def moe_capacity(n_tokens, n_experts, capacity_factor, k=1):
+    """Per-expert slot count of the dispatch capacity buffer:
+    ``max(1, int(capacity_factor · k · n_tokens / n_experts))`` over the
+    RANK-LOCAL token count.  The ONE formula the dispatchers, bench.py's
+    dispatch-byte columns, and the comm census share — a rounding tweak
+    here re-prices every committed row together instead of letting the
+    surfaces drift apart."""
+    return max(1, int(capacity_factor * k * n_tokens / n_experts))
+
+
+def _resolve_two_stage(comm, two_stage):
+    """Resolve the ``two_stage`` knob against the communicator's
+    topology (ISSUE 12 guard rail): ``None`` means topology-aware —
+    two-stage on a hierarchical communicator, flat on a one-axis one —
+    so single-axis use of a multi-axis comm is an EXPLICIT
+    ``two_stage=False`` choice, never a silent default.  Requesting
+    ``two_stage=True`` on a flat communicator is an error — except
+    when the factory's ``CHAINERMN_TPU_HIERARCHY=flat`` hatch is what
+    flattened a REQUESTED hierarchy (the communicator carries the
+    ``_hierarchy_flattened_by_env`` mark), in which case two-stage
+    routing is dropped with the one-time warning PR 11 established
+    for striping.  A communicator that was never hierarchical never
+    triggers the hatch warning, whatever the environment says."""
+    hier = getattr(comm, "hierarchy", None) is not None
+    hatch_degraded = getattr(comm, "_hierarchy_flattened_by_env", False)
+    if two_stage is None:
+        if hier:
+            return True
+        if hatch_degraded:
+            from ..communicators import _warn_hierarchy_flat_two_stage_dropped
+            _warn_hierarchy_flat_two_stage_dropped()
+        return False
+    two_stage = bool(two_stage)
+    if two_stage and not hier:
+        if hatch_degraded:
+            from ..communicators import _warn_hierarchy_flat_two_stage_dropped
+            _warn_hierarchy_flat_two_stage_dropped()
+            return False
+        raise ValueError(
+            "two_stage=True needs a hierarchical communicator "
+            "(name='hierarchical'/'two_dimensional' or an intra_size/"
+            "inter_size split): a flat mesh has one fabric, there is "
+            "no second hop to stage the dispatch across")
+    return two_stage
+
+
+def _dcn_crossing_fn(comm):
+    """The slow-fabric ``all_to_all`` of the two-stage exchange, on a
+    ``[inter, ...]`` buffer (leading axis = destination/source host
+    block), honoring the communicator's per-hop dcn dtype:
+
+    * lossless (``dcn_grad_dtype is None``): the native all_to_all
+      (exact autodiff).
+    * cast (bf16/fp16): cast → all_to_all → cast back; the transposed
+      cotangent crossing rides the same cast wire for free.
+    * quantized (int8/fp8): per-segment symmetric quantization — one
+      scale per destination host block — q and the ``[inter]`` scale
+      vector each cross on their own all_to_all, and each received
+      block decodes with ITS sender's scale.  ``jax.custom_vjp``
+      makes the backward the same compressed transposed crossing
+      (straight-through: the codebook's round has no useful gradient,
+      and a lossless f32 backward would silently give back the byte
+      win the forward bought).
+
+    In every compressed flavor the OWN-host block is restored from the
+    pre-crossing values: an all_to_all keeps the own segment local, so
+    on-host tokens never cross the slow fabric and must not pay its
+    codebook.
+    """
+    from ..communicators._memory_utility import (
+        dequantize_symmetric, is_quantized_dtype,
+        quantize_symmetric_segments)
+    dcn = comm.dcn_axis
+    inter = comm.dcn_size
+    wire = comm.dcn_grad_dtype
+
+    if wire is None:
+        return lambda v: lax.all_to_all(v, dcn, split_axis=0,
+                                        concat_axis=0, tiled=False)
+
+    def _own_restored(v, crossed):
+        own = lax.axis_index(dcn)
+        mask = lax.broadcasted_iota(
+            jnp.int32, (inter,) + (1,) * (v.ndim - 1), 0) == own
+        return jnp.where(mask, v, crossed)
+
+    if not is_quantized_dtype(wire):
+        def cast_crossing(v):
+            out = lax.all_to_all(v.astype(wire), dcn, split_axis=0,
+                                 concat_axis=0, tiled=False)
+            return _own_restored(v, out.astype(v.dtype))
+        return cast_crossing
+
+    def quantized(v):
+        q, scales = quantize_symmetric_segments(v, wire)
+        qr = lax.all_to_all(q, dcn, split_axis=0, concat_axis=0,
+                            tiled=False)
+        sr = lax.all_to_all(scales, dcn, split_axis=0, concat_axis=0,
+                            tiled=False)
+        deq = dequantize_symmetric(
+            qr, sr.reshape((inter,) + (1,) * (v.ndim - 1)))
+        return _own_restored(v, deq.astype(v.dtype))
+
+    @jax.custom_vjp
+    def crossing(v):
+        return quantized(v)
+
+    def fwd(v):
+        return quantized(v), None
+
+    def bwd(_, ct):
+        # the transposed crossing of the cotangents — same codebook,
+        # own-block cotangent lossless (all_to_all with square blocks
+        # is its own transpose on this indexing)
+        return (quantized(ct),)
+
+    crossing.defvjp(fwd, bwd)
+    return crossing
+
+
+def _exchange(comm, buf, two_stage, combine=False):
+    """Move a ``[E, C, ...]`` capacity buffer between source ranks and
+    expert ranks (``combine=False``: slot ``e`` of every rank converges
+    on rank ``e``; ``combine=True``: the exact inverse).  Flat: ONE
+    ``all_to_all`` over the communicator axis (the joint two-level axis
+    on a hierarchical comm with ``two_stage=False`` — the explicit
+    single-axis escape).  Two-stage: the buffer reshapes to
+    ``[inter, intra, C, ...]`` and the ICI/DCN stages run in the order
+    ``hop_schedule(mode="moe")`` pins — dispatch fast-hop-first (the
+    slow crossing issued immediately after), combine transposed
+    (slow-hop-first)."""
+    if not two_stage:
+        return lax.all_to_all(buf, comm.axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+    from ..communicators._memory_utility import hop_schedule
+    inter, intra = comm.dcn_size, comm.ici_size
+    crossing = _dcn_crossing_fn(comm)
+    s = buf.reshape((inter, intra) + buf.shape[1:])
+    phase = "combine" if combine else "dispatch"
+    for op, _ in hop_schedule(1, mode="moe"):
+        if op == f"ici_{phase}":
+            with jax.named_scope(f"moe_ici_{phase}"):
+                s = lax.all_to_all(s, comm.ici_axis, split_axis=1,
+                                   concat_axis=1, tiled=False)
+        elif op == f"dcn_{phase}":
+            with jax.named_scope(f"moe_dcn_{phase}"):
+                s = crossing(s)
+    return s.reshape(buf.shape)
 
 
 def _one_hot_capacity(expert_idx, n_experts, capacity):
@@ -47,18 +238,24 @@ def _one_hot_capacity(expert_idx, n_experts, capacity):
 
 
 def moe_dispatch_combine(comm, x, gate_logits, expert_fn,
-                         capacity_factor=1.25):
+                         capacity_factor=1.25, two_stage=None):
     """Route rank-local tokens through rank-sharded experts.
 
     ``x``: [T_local, D] tokens on this rank; ``gate_logits``: [T_local, E]
     with E == comm.size (one expert per rank); ``expert_fn(h)`` applies
-    this rank's expert to [E*C', D]... returns same shape.  Returns
-    ([T_local, D] combined output, aux dict with load-balancing stats).
+    this rank's expert to [E*C', D]... returns same shape.
+    ``two_stage``: ``None`` = topology-aware (two-stage on a
+    hierarchical communicator), ``False`` = the explicit single-axis
+    escape, ``True`` = require the two-stage exchange (error on a flat
+    comm).  Returns ([T_local, D] combined output, aux dict with
+    load-balancing stats: ``aux_loss``, ``frac`` [E], ``mean_prob``
+    [E], ``dropped_frac`` (capacity-cut fraction of routed tokens),
+    ``capacity``).
     """
-    axis = comm.axis_name
+    two_stage = _resolve_two_stage(comm, two_stage)
     E = comm.size
     T, D = x.shape
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = moe_capacity(T, E, capacity_factor)
 
     probs = jax.nn.softmax(gate_logits, axis=-1)            # [T, E]
     expert_idx = jnp.argmax(probs, axis=-1)                  # [T]
@@ -68,14 +265,11 @@ def moe_dispatch_combine(comm, x, gate_logits, expert_fn,
 
     # [E, C, D] buffer of tokens headed to each expert
     send = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
-    # exchange: slot e of every rank converges on rank e
-    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                          tiled=False)                      # [E, C, D]
+    recv = _exchange(comm, send, two_stage)                 # [E, C, D]
     # local expert processes all ranks' contributions
     h = expert_fn(recv.reshape(E * capacity, D)).reshape(E, capacity, D)
-    # return trip
-    back = lax.all_to_all(h, axis, split_axis=0, concat_axis=0,
-                          tiled=False)                      # [E, C, D]
+    # return trip (two-stage: the transposed reverse, DCN first)
+    back = _exchange(comm, h, two_stage, combine=True)      # [E, C, D]
     combined = jnp.einsum("tec,ecd->td", dispatch.astype(x.dtype), back)
     combined = combined * gate[:, None]
 
@@ -84,12 +278,16 @@ def moe_dispatch_combine(comm, x, gate_logits, expert_fn,
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(frac * mean_prob)
     return combined, {"aux_loss": aux_loss,
-                      "dropped": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+                      "frac": frac,
+                      "mean_prob": mean_prob,
+                      "dropped_frac":
+                          1.0 - jnp.mean(keep.astype(jnp.float32)),
                       "capacity": capacity}
 
 
 def switch_moe(comm, x, router_w, w_in, b_in, w_out, b_out,
-               capacity_factor=1.25, activation=jax.nn.gelu):
+               capacity_factor=1.25, activation=jax.nn.gelu,
+               two_stage=None):
     """Complete Switch-MoE layer: router + rank-local expert MLP.
 
     ``x``: [T_local, D].  ``router_w``: [D, E] (replicated).  ``w_in``:
@@ -103,7 +301,8 @@ def switch_moe(comm, x, router_w, w_in, b_in, w_out, b_out,
         return activation(h @ w_in + b_in) @ w_out + b_out
 
     return moe_dispatch_combine(comm, x, gate_logits, expert_fn,
-                                capacity_factor=capacity_factor)
+                                capacity_factor=capacity_factor,
+                                two_stage=two_stage)
 
 
 def _topk_dispatch(probs, k, capacity):
@@ -130,17 +329,20 @@ def _topk_dispatch(probs, k, capacity):
 
 
 def moe_dispatch_combine_topk(comm, x, gate_logits, expert_fn, k=2,
-                              capacity_factor=1.25, normalize_gates=True):
+                              capacity_factor=1.25, normalize_gates=True,
+                              two_stage=None):
     """Top-k routing variant of :func:`moe_dispatch_combine`.
 
     Each token is processed by its ``k`` highest-probability experts and
     the outputs are combined with (optionally renormalized) gate weights —
-    the GShard-style generalization of Switch routing.
+    the GShard-style generalization of Switch routing.  Shares the
+    topology-aware two-stage exchange (and its compression) with the
+    top-1 path; ``dropped_frac`` counts over the T·k routed copies.
     """
-    axis = comm.axis_name
+    two_stage = _resolve_two_stage(comm, two_stage)
     E = comm.size
     T, D = x.shape
-    capacity = max(1, int(capacity_factor * k * T / E))
+    capacity = moe_capacity(T, E, capacity_factor, k=k)
 
     probs = jax.nn.softmax(gate_logits, axis=-1)
     dispatch, gates, keep = _topk_dispatch(probs, k, capacity)
@@ -150,11 +352,9 @@ def moe_dispatch_combine_topk(comm, x, gate_logits, expert_fn, k=2,
     gates = gates * keep.astype(gates.dtype)
 
     send = jnp.einsum("tkec,td->ecd", dispatch.astype(x.dtype), x)
-    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                          tiled=False)
+    recv = _exchange(comm, send, two_stage)
     h = expert_fn(recv.reshape(E * capacity, D)).reshape(E, capacity, D)
-    back = lax.all_to_all(h, axis, split_axis=0, concat_axis=0,
-                          tiled=False)
+    back = _exchange(comm, h, two_stage, combine=True)
     combined = jnp.einsum("tkec,tk,ecd->td", dispatch.astype(x.dtype),
                           gates, back)
 
@@ -162,5 +362,8 @@ def moe_dispatch_combine_topk(comm, x, gate_logits, expert_fn, k=2,
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(frac * mean_prob)
     return combined, {"aux_loss": aux_loss,
-                      "dropped": 1.0 - jnp.mean(keep.astype(jnp.float32)),
+                      "frac": frac,
+                      "mean_prob": mean_prob,
+                      "dropped_frac":
+                          1.0 - jnp.mean(keep.astype(jnp.float32)),
                       "capacity": capacity}

@@ -1352,3 +1352,66 @@ def test_cacheable_rejects_exchange_variants(cache_path, monkeypatch):
     monkeypatch.delenv("BENCH_STRIPE_RATIO", raising=False)
     # payload gate on a planted striped row
     assert not bench._cacheable(dict(flagship, exchange="striped"))
+
+
+# -- MoE rows are fenced out of the flagship cache (ISSUE 12) ----------------
+
+MOE_ROW = {
+    "metric": "moe_lm_train_throughput",
+    "value": 21000.0, "unit": "tokens/sec/chip", "vs_baseline": None,
+    "platform": "axon", "device_kind": "TPU v5 lite", "n_devices": 8,
+    "per_chip_batch": 8, "seq_len": 512, "d_model": 512, "n_layers": 6,
+    "exchange": "hierarchical", "two_stage": True, "moe_experts": 8,
+    "moe_topk": 1, "dispatch_bytes_dcn": 100, "n_steps": 20,
+}
+
+
+def test_moe_rows_are_never_flagship_cacheable(cache_path, capsys):
+    """Even a pristine on-chip MoE row must not enter either cache
+    slot: its metric is outside the flagship map (the serving/
+    longcontext discipline), so `_cacheable` and the cross-slot
+    screens refuse it on every path."""
+    assert bench._cacheable(MOE_ROW) is False
+    bench._emit(MOE_ROW)                  # persist path
+    capsys.readouterr()
+    assert not os.path.exists(cache_path)
+    assert not os.path.exists(bench._REPO_CACHE_PATH)
+
+
+def test_planted_moe_entry_is_not_promoted(cache_path, capsys,
+                                           monkeypatch):
+    """A planted /tmp MoE entry must not be promoted into the committed
+    repo slot by a later flagship persist, and the stale re-serve path
+    finds nothing to serve under the MoE metric."""
+    with open(cache_path, "w") as f:
+        json.dump({"entries": {"moe_lm_train_throughput": {
+            "run_id": "planted", "saved_at": 9e9,
+            "result": MOE_ROW}}}, f)
+    for k in ("BENCH_BS", "BENCH_SIZE", "BENCH_STEPS", "BENCH_MODEL",
+              "BENCH_EXCHANGE", "BENCH_DONATE"):
+        monkeypatch.delenv(k, raising=False)
+    bench._emit(dict(TPU_RESULT, per_chip_batch=64, n_steps=40))
+    capsys.readouterr()
+    with open(bench._REPO_CACHE_PATH) as f:
+        entries = json.load(f)["entries"]
+    assert "moe_lm_train_throughput" not in entries
+    monkeypatch.setenv("BENCH_MODEL", "moe")
+    run_id, cached, fp = bench._load_cache("moe_lm_train_throughput")
+    assert cached is None
+
+
+def test_moe_err_metric_and_first_contact_refusal(cache_path, capsys,
+                                                  monkeypatch):
+    """BENCH_MODEL=moe wires the error path to the MoE metric, and
+    first contact (no moe sentinel) refuses any stale re-serve — an
+    honest null, the longcontext discipline."""
+    monkeypatch.setenv("BENCH_MODEL", "moe")
+    assert bench._err_metric() == ("moe_lm_train_throughput",
+                                   "tokens/sec/chip")
+    assert bench._first_contact("moe")
+    bench._emit_stale_or_error("relay wedged")
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["metric"] == "moe_lm_train_throughput"
+    assert row["value"] is None
+    assert row["first_contact"] is True
+    assert "stale" not in row

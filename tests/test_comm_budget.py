@@ -57,6 +57,11 @@ def test_budget_schema(budgets):
     v = budgets["vertical"]
     assert {k: v[k] for k in comm_census.VERTICAL} == comm_census.VERTICAL
     assert budgets["sweep"]["status"] in ("pending_on_chip", "measured")
+    # ISSUE 12: the MoE dispatch census is a sibling section
+    assert set(budgets["moe"]["structure"]) == set(comm_census.MOE_CONFIGS)
+    mv = budgets["moe"]["vertical"]
+    assert {k: mv[k] for k in comm_census.MOE_VERTICAL} == \
+        comm_census.MOE_VERTICAL
 
 
 def test_structure_census_matches_committed(budgets, live):
@@ -387,6 +392,116 @@ def test_unknown_collective_prim_is_hard_census_error():
         comm_census.row_wire_bytes(
             {"prim": "ppermute", "elems": 1024, "dtype": "float32",
              "axes": ["mn_world"]}, comm)
+
+
+# -- MoE dispatch census (ISSUE 12) ------------------------------------------
+
+@pytest.fixture(scope="module")
+def moe_live():
+    """The live MoE dispatch census of every committed config."""
+    return {name: comm_census.moe_config_row(name)
+            for name in comm_census.MOE_CONFIGS}
+
+
+def test_moe_structure_census_matches_committed(budgets, moe_live):
+    """The machine check for the MoE section: what `parallel.moe`
+    traces today is what tools/comm_budgets.json commits, config by
+    config — a PR that changes the dispatch shape must regenerate the
+    budgets and own the diff."""
+    for name, row in moe_live.items():
+        committed = dict(budgets["moe"]["structure"][name])
+        committed.pop("config", None)
+        assert row == committed, (
+            f"{name}: MoE dispatch structure drifted.\n traced    {row}\n"
+            f" committed {committed}\nRegenerate tools/comm_budgets.json "
+            "via `python tools/comm_census.py --write-budgets` if the "
+            "change is intentional.")
+
+
+def test_moe_two_stage_per_hop_structure(moe_live):
+    """The ISSUE 12 tentpole, machine-checked: the two-stage dispatch
+    is an all_to_all over ICI and an all_to_all over DCN (each hop
+    crossed once per direction — 2 with the combine return trip), hop
+    labels resolved from the eqns' own axis names; the flat reference
+    is ONE joint-axis collective each way; and no config emits any
+    other dispatch-sized collective."""
+    for name, row in moe_live.items():
+        assert row["intra_size"] == 4 and row["inter_size"] == 2, name
+        assert row["non_dispatch_collectives"] == 0, name
+    two = moe_live["moe_two_stage"]
+    assert set(two["per_hop"]) == {"ici", "dcn"}
+    for hop in ("ici", "dcn"):
+        assert two["per_hop"][hop]["collectives"] == {"all_to_all": 2}
+    flat = moe_live["moe_flat"]
+    assert set(flat["per_hop"]) == {"dcn+ici"}
+    assert flat["per_hop"]["dcn+ici"]["collectives"] == {"all_to_all": 2}
+
+
+def test_moe_off_host_dispatch_ratio_pinned(budgets, moe_live):
+    """Acceptance bar: `off_host_dispatch_ratio` is pinned EXACT per
+    committed config — (inter-1)/inter of the capacity buffer belongs
+    to off-host experts on the 2-host split — and the two-stage
+    configs' DCN dispatch bytes, pinned FROM THE TRACE at wire dtype,
+    carry exactly that share of the f32 round trip when lossless, half
+    under bf16, a quarter under int8."""
+    for name, row in moe_live.items():
+        assert row["off_host_dispatch_ratio"] == 0.5, name
+        assert budgets["moe"]["structure"][name][
+            "off_host_dispatch_ratio"] == 0.5, name
+    assert moe_live["moe_two_stage"]["dcn_dispatch_bytes_ratio"] == 0.5
+    assert moe_live["moe_two_stage_bf16"]["dcn_dispatch_bytes_ratio"] \
+        == 0.25
+    assert moe_live["moe_two_stage_int8"]["dcn_dispatch_bytes_ratio"] \
+        == 0.125
+
+
+def test_moe_dcn_crossing_at_wire_dtype(moe_live):
+    """The compressed DCN crossing rides the WIRE dtype (the packed
+    buffer that actually crosses — int8 codewords with the per-segment
+    scale all_to_all below the census floor), while ICI stays lossless
+    byte-for-byte across every two-stage config."""
+    lossless = moe_live["moe_two_stage"]["per_hop"]
+    for name, wire in (("moe_two_stage_bf16", "bfloat16"),
+                       ("moe_two_stage_int8", "int8")):
+        row = moe_live[name]
+        assert row["dcn_wire_dtype"] == wire, name
+        assert row["per_hop"]["dcn"]["wire_dtypes"] == [wire], name
+        assert row["per_hop"]["ici"] == lossless["ici"], name
+    f32 = lossless["dcn"]["exchanged_dispatch_bytes"]
+    bf16 = moe_live["moe_two_stage_bf16"]["per_hop"]["dcn"][
+        "exchanged_dispatch_bytes"]
+    int8 = moe_live["moe_two_stage_int8"]["per_hop"]["dcn"][
+        "exchanged_dispatch_bytes"]
+    assert bf16 * 2 == f32 and int8 * 4 == f32
+
+
+def test_moe_pricing_surface_matches_census(moe_live):
+    """`_memory_utility.moe_dispatch_exchanged_bytes` — the pricing
+    surface bench.py's MoE rows use — agrees with the traced census
+    byte-for-byte, so the bench columns and the committed budgets
+    cannot drift apart."""
+    from chainermn_tpu.communicators._memory_utility import \
+        moe_dispatch_exchanged_bytes
+    row = moe_live["moe_two_stage"]
+    n_bytes = row["dispatch_elems"] * 4
+    hops = moe_dispatch_exchanged_bytes(n_bytes, row["intra_size"],
+                                        row["inter_size"])
+    assert hops["ici"] == \
+        row["per_hop"]["ici"]["exchanged_dispatch_bytes"]
+    assert hops["dcn"] == \
+        row["per_hop"]["dcn"]["exchanged_dispatch_bytes"]
+    int8 = moe_live["moe_two_stage_int8"]
+    hops8 = moe_dispatch_exchanged_bytes(
+        n_bytes, row["intra_size"], row["inter_size"],
+        dcn_n_bytes=int8["dispatch_elems"])
+    assert hops8["dcn"] == \
+        int8["per_hop"]["dcn"]["exchanged_dispatch_bytes"]
+    flat = moe_live["moe_flat"]
+    world = moe_dispatch_exchanged_bytes(n_bytes, row["intra_size"],
+                                         row["inter_size"],
+                                         two_stage=False)
+    assert world["world"] == \
+        flat["per_hop"]["dcn+ici"]["exchanged_dispatch_bytes"]
 
 
 def test_measured_sweep_meets_tolerance_when_present(budgets):

@@ -49,6 +49,15 @@ collective's mesh AXES, so the hierarchical configs commit which hop
 each transfer rides — the per-hop structure the tentpole promises is
 machine-checked, not narrated.
 
+ISSUE 12 adds a sibling ``moe`` section: the MoE token-dispatch census
+(configs ``moe_flat`` / ``moe_two_stage`` / ``moe_two_stage_bf16`` /
+``moe_two_stage_int8`` on the same simulated 2×4 split) — per-hop
+``all_to_all`` counts and wire dtypes of the two-stage (ici → dcn)
+exchange, the ``off_host_dispatch_ratio`` of the committed split, and
+the trace-pinned ``dcn_dispatch_bytes_ratio`` showing the slow
+crossing carries exactly the off-host remainder at the wire dtype
+(lossless = the ratio, bf16 = half, int8 = a quarter).
+
 Unlike the flash/HBM budgets' measured halves, the structure section
 here may be (re)generated off-chip — it is a trace property —
 ``python tools/comm_census.py --write-budgets``.  The ``sweep`` section
@@ -162,6 +171,28 @@ CONFIGS = {
                        exchange="reduce_scatter", comm="hierarchical",
                        inter_size=HIER_INTER_SIZE,
                        stripe_ratio=STRIPE_RATIO),
+}
+
+#: the MoE dispatch vertical (ISSUE 12): tokens-per-rank/d_model sized
+#: so the [E, C, D] capacity buffer (8 experts × capacity 8 × 32 =
+#: 2048 elems) clears GRAD_ELEMS_FLOOR while the per-segment scale
+#: vectors ([inter] = 2 elems) stay below it, like the gradient
+#: census's scale gathers
+MOE_VERTICAL = dict(tokens_per_rank=64, d_model=32, capacity_factor=1.0)
+
+#: committed MoE dispatch configs (ISSUE 12), all traced on the
+#: simulated 2-host (dcn 2 × ici 4) split: the flat single-axis
+#: reference (the explicit ``two_stage=False`` escape on the SAME
+#: topology — its one all_to_all rides the joint axis pair), the
+#: lossless two-stage exchange, and the compressed DCN crossings
+#: (bf16 cast / int8 per-segment codewords)
+MOE_CONFIGS = {
+    "moe_flat": dict(two_stage=False, grad_dtype=None),
+    "moe_two_stage": dict(two_stage=True, grad_dtype=None),
+    "moe_two_stage_bf16": dict(two_stage=True,
+                               grad_dtype={"dcn": "bfloat16"}),
+    "moe_two_stage_int8": dict(two_stage=True,
+                               grad_dtype={"dcn": "int8"}),
 }
 
 
@@ -522,6 +553,128 @@ def config_row(name):
     return row
 
 
+def trace_moe(name):
+    """Jaxpr of one committed MoE dispatch+combine round trip (ISSUE
+    12) — the real ``parallel.moe`` exchange shard_mapped over the
+    simulated 2-host mesh, traced instead of executed (CPU-safe, no
+    compile).  The expert is a shape-preserving affine stand-in: the
+    census pins the EXCHANGE structure, and a real expert GEMM adds no
+    collectives."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.parallel.moe import moe_dispatch_combine
+    from chainermn_tpu.utils.compat import shard_map
+
+    cfg = MOE_CONFIGS[name]
+    v = MOE_VERTICAL
+    comm = ct.create_communicator("hierarchical",
+                                  inter_size=HIER_INTER_SIZE,
+                                  allreduce_grad_dtype=cfg["grad_dtype"])
+    E = comm.size
+    T, D = v["tokens_per_rank"], v["d_model"]
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.normal(0, 1, (E * T, D)).astype(np.float32))
+    router = jnp.asarray(rng.normal(0, 1, (D, E)).astype(np.float32))
+
+    def body(x, router):
+        out, _ = moe_dispatch_combine(
+            comm, x, x @ router, lambda h: h * 2.0 + 1.0,
+            capacity_factor=v["capacity_factor"],
+            two_stage=cfg["two_stage"])
+        return out
+
+    axes = comm.axis_name
+    mapped = shard_map(body, mesh=comm.mesh,
+                       in_specs=(P(axes), P()), out_specs=P(axes),
+                       check_vma=False)
+    return jax.make_jaxpr(mapped)(x, router), comm
+
+
+def moe_capacity(comm):
+    from chainermn_tpu.parallel.moe import moe_capacity as _cap
+    v = MOE_VERTICAL
+    return _cap(v["tokens_per_rank"], comm.size, v["capacity_factor"])
+
+
+def moe_config_row(name, traced=None):
+    """Computed census row for one committed MoE dispatch config: the
+    per-hop ``all_to_all`` structure (counts, wire dtypes, wire bytes —
+    each crossing priced at its OWN operand dtype via the shared
+    ``row_hop``/``row_wire_bytes`` helpers), the analytic
+    ``off_host_dispatch_ratio`` of the 2-host split (the fraction of
+    the capacity buffer whose expert lives off-host — what the slow
+    fabric is allowed to carry), and for the two-stage configs the
+    TRACE-pinned ``dcn_dispatch_bytes_ratio``: DCN dispatch wire bytes
+    over the f32 round trip — equal to the off-host ratio when
+    lossless, half of it under bf16, a quarter under int8 (the
+    quantized fraction falls out of the trace, never out of
+    metadata).  ``traced`` takes a prebuilt ``(jaxpr, comm)`` pair so
+    callers that also want the raw census rows (PROBE=comm's hop
+    table) trace each config once, not twice."""
+    import jax.numpy as jnp
+    cfg = MOE_CONFIGS[name]
+    jaxpr, comm = traced if traced is not None else trace_moe(name)
+    census = collective_census(jaxpr)
+    grad = [r for r in census if r["elems"] >= GRAD_ELEMS_FLOOR]
+    a2a = [r for r in grad if r["prim"] == "all_to_all"]
+    capacity = moe_capacity(comm)
+    dispatch_elems = comm.size * capacity * MOE_VERTICAL["d_model"]
+    per_hop = {}
+    for r in a2a:
+        hop = per_hop.setdefault(row_hop(r, comm), {
+            "collectives": {}, "exchanged_dispatch_bytes": 0,
+            "wire_dtypes": []})
+        hop["collectives"][r["prim"]] = \
+            hop["collectives"].get(r["prim"], 0) + 1
+        if r["dtype"] not in hop["wire_dtypes"]:
+            hop["wire_dtypes"] = sorted(hop["wire_dtypes"] + [r["dtype"]])
+        hop["exchanged_dispatch_bytes"] += int(row_wire_bytes(r, comm))
+    row = {
+        "two_stage": cfg["two_stage"],
+        "grad_dtype": cfg["grad_dtype"],
+        "topology": comm.topology,
+        "intra_size": comm.ici_size,
+        "inter_size": comm.dcn_size,
+        "dcn_wire_dtype": str(comm.dcn_grad_dtype)
+        if comm.dcn_grad_dtype is not None else None,
+        "capacity": capacity,
+        "dispatch_elems": dispatch_elems,
+        "per_hop": per_hop,
+        # a non-all_to_all gradient-sized collective in the dispatch
+        # program would be structure drift — pinned at zero
+        "non_dispatch_collectives":
+            sum(1 for r in grad if r["prim"] != "all_to_all"),
+        # the routing fact of the committed split: (inter-1)/inter of
+        # the capacity buffer's slots belong to off-host experts
+        "off_host_dispatch_ratio":
+            (comm.dcn_size - 1) / comm.dcn_size,
+    }
+    if cfg["two_stage"]:
+        dcn_bytes = per_hop.get("dcn", {}) \
+            .get("exchanged_dispatch_bytes", 0)
+        row["dcn_dispatch_bytes_ratio"] = \
+            dcn_bytes / (2 * dispatch_elems * 4)
+    return row
+
+
+def build_moe_structure():
+    import chainermn_tpu as ct
+    comm = ct.create_communicator("hierarchical",
+                                  inter_size=HIER_INTER_SIZE)
+    capacity = moe_capacity(comm)
+    return {
+        "vertical": dict(MOE_VERTICAL, n_devices=_n_devices(),
+                         experts=comm.size, capacity=capacity,
+                         dispatch_elems=comm.size * capacity
+                         * MOE_VERTICAL["d_model"]),
+        "structure": {name: moe_config_row(name)
+                      for name in MOE_CONFIGS},
+    }
+
+
 def build_structure():
     vert = _Vertical.get()
     structure = {name: config_row(name) for name in CONFIGS}
@@ -531,6 +684,7 @@ def build_structure():
                          param_bytes=vert.param_bytes),
         "grad_elems_floor": GRAD_ELEMS_FLOOR,
         "structure": structure,
+        "moe": build_moe_structure(),
     }
 
 
@@ -553,6 +707,8 @@ def main(argv):
         + os.environ.get("XLA_FLAGS", ""))
     built = build_structure()
     for name, row in built["structure"].items():
+        print(json.dumps(dict(row, config=name)), flush=True)
+    for name, row in built["moe"]["structure"].items():
         print(json.dumps(dict(row, config=name)), flush=True)
     if "--write-budgets" not in argv:
         return 0

@@ -679,6 +679,38 @@ def probe_comm():
                               "compression_ratio":
                                   jnp.dtype(dtype).itemsize / 4.0,
                               **g}), flush=True)
+    # MoE dispatch census (ISSUE 12): the committed moe section joined
+    # with a live trace — one row per config (two-stage structure,
+    # off_host_dispatch_ratio, structure verdict) and the all_to_all
+    # dispatch rows of the per-hop table, priced by the SAME
+    # row_hop/row_wire_bytes helpers as the gradient rows
+    moe_committed = budgets.get("moe", {}).get("structure", {})
+    for name in comm_census.MOE_CONFIGS:
+        jaxpr, comm = comm_census.trace_moe(name)
+        row = comm_census.moe_config_row(name, traced=(jaxpr, comm))
+        committed = dict(moe_committed.get(name, {}))
+        committed.pop("config", None)
+        print(json.dumps(dict(row, probe="comm_moe", config=name,
+                              within_structure=row == committed)),
+              flush=True)
+        rows = [r for r in comm_census.collective_census(jaxpr)
+                if r["elems"] >= comm_census.GRAD_ELEMS_FLOOR]
+        groups = {}
+        for r in rows:
+            key = (comm_census.row_hop(r, comm), r["prim"], r["dtype"])
+            g = groups.setdefault(key, {"count": 0, "elems": 0,
+                                        "bytes": 0})
+            g["count"] += 1
+            g["elems"] += r["elems"]
+            g["bytes"] += int(comm_census.row_wire_bytes(r, comm))
+        for (hop, prim, dtype), g in groups.items():
+            print(json.dumps({"probe": "comm_hop_table", "config": name,
+                              "path": "moe_dispatch", "hop": hop,
+                              "collective": prim,
+                              "dtype": dtype, "wire_dtype": dtype,
+                              "compression_ratio":
+                                  jnp.dtype(dtype).itemsize / 4.0,
+                              **g}), flush=True)
     # live per-bucket table at the default bound (and PROBE_BUCKET_MB
     # override), leaf by leaf.  grad_transform plans buckets over the
     # POST-compression leaves, so the plan depends on the grad dtype:

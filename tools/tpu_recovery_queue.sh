@@ -34,6 +34,15 @@
 #     (tools/comm_census.py --write-budgets) so the per-path structure
 #     gates track the committed split.
 #
+#  6. MoE dispatch A/B (ISSUE 12): the three BENCH_MODEL=moe rows below
+#     (flat single-axis dispatch vs two-stage ici×dcn vs two-stage with
+#     int8 DCN crossing); record the tokens/sec + dispatch_bytes_dcn
+#     deltas in BENCH_NOTES (no committed numeric gate yet — the
+#     structure gates in tests/test_comm_budget.py's moe section are
+#     already live, and on one host the dcn axis is carried by ICI, so
+#     this is the structural A/B; the real slow-fabric payoff needs a
+#     >=2-host pod).
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
@@ -197,6 +206,24 @@ run_one "serving engine open-loop qps16 x4 tenants (flagship serving)" \
 run_one "serving engine qps64 x8 tenants (saturation/preemption probe)" \
   BENCH_MODEL=serving BENCH_SERVE_QPS=64 BENCH_SERVE_TENANTS=8 \
   BENCH_DEADLINE_S=900
+# ISSUE 12: the MoE dispatch A/B — the Switch-FFN expert-parallel
+# vertical under the flat single-axis dispatch, the two-stage ici×dcn
+# dispatch on the forced 2x4 split, and the two-stage dispatch with
+# the int8 DCN crossing (BENCH_GRAD_DTYPE=int8 compresses both the
+# gradient DCN hop and the dispatch's slow crossing — the full
+# compressed configuration).  Deltas vs the flat row = the two-stage
+# schedule's on-host cost and the quantized wire's payoff; rows carry
+# dispatch_bytes_ici/dcn + moe_dropped_frac.  MoE rows are
+# metric-fenced out of the flagship last-good cache by construction.
+run_one "moe bs8 flat dispatch (MoE dispatch A/B baseline)" \
+  BENCH_MODEL=moe BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "moe bs8 two-stage dispatch 2x4 split (MoE dispatch A/B)" \
+  BENCH_MODEL=moe BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 \
+  BENCH_DEADLINE_S=900 BENCH_TRIALS=3
+run_one "moe bs8 two-stage int8 DCN dispatch (MoE dispatch A/B)" \
+  BENCH_MODEL=moe BENCH_EXCHANGE=hierarchical BENCH_INTER_SIZE=2 \
+  BENCH_GRAD_DTYPE=int8 BENCH_MOE_TOPK=1 BENCH_DEADLINE_S=900 \
+  BENCH_TRIALS=3
 
 # Fold THIS run's authoritative JSON lines into BENCH_NOTES so the round
 # records the on-chip numbers even if nobody is awake to do it manually.
