@@ -71,11 +71,18 @@ a variant that is excluded from the last-good cache):
                 BENCH_SERVE_QPS (default 16), BENCH_SERVE_TENANTS (4),
                 BENCH_SERVE_REQUESTS (64), BENCH_SERVE_MAX_NEW (32),
                 BENCH_SERVE_PROMPT (64), BENCH_SERVE_MAX_BATCH (8),
-                BENCH_SERVE_PAGE (16), BENCH_SERVE_PAGES (256) —
+                BENCH_SERVE_PAGE (16), BENCH_SERVE_PAGES (256),
+                BENCH_SERVE_PREFIX (16: per-tenant shared system-prompt
+                tokens in the chat-shaped load; 0 disables the prefix
+                cache — the A/B off leg), BENCH_SERVE_DISAGG (0|1:
+                disaggregated prefill/decode slices),
+                BENCH_SERVE_TP (1: tensor-parallel decode ways) —
                 serving (continuous-batching engine under a seeded
                 open-loop Poisson load: tokens/sec + p50/p99 per-token
-                latency + page-pool occupancy; CPU runs clamp to a
-                labeled cpu_smoke row; never cached as flagship data);
+                latency + page-pool occupancy + prefix_hit_rate /
+                effective_capacity_x / transferred_page_bytes / tp;
+                CPU runs clamp to a labeled cpu_smoke row; never
+                cached as flagship data);
                 BENCH_MOE_EXPERTS (chip count), BENCH_MOE_TOPK (1),
                 BENCH_MOE_CAPACITY (1.25), BENCH_MOE_TWO_STAGE
                 (''=auto|0|1) — moe (Switch-FFN expert-parallel
@@ -1667,6 +1674,15 @@ def _run_bench_serving():
     the previous token of the same request, includes preemption
     stalls), and page-pool occupancy (mean/max over decode steps).
 
+    Round 14: the load is CHAT-SHAPED — every tenant re-sends a fixed
+    ``BENCH_SERVE_PREFIX``-token system prompt ahead of a random tail —
+    and the row carries the measured prefix economics
+    (``prefix_hit_rate``, ``effective_capacity_x``, ``forks``), the
+    disaggregation ship's ``transferred_page_bytes``
+    (``BENCH_SERVE_DISAGG=1``) and the ``tp`` decode ways
+    (``BENCH_SERVE_TP``).  ``BENCH_SERVE_PREFIX=0`` is the sharing-off
+    A/B leg (engine prefix cache disabled).
+
     Two phases on ONE engine: a warmup pass first drives every prefill/
     decode bucket the load will touch (all jit compiles land here,
     under the compile heartbeat so the supervisor's clock pauses), then
@@ -1699,6 +1715,12 @@ def _run_bench_serving():
     max_batch = _env_int("BENCH_SERVE_MAX_BATCH", 8)
     page_size = _env_int("BENCH_SERVE_PAGE", 16)
     num_pages = _env_int("BENCH_SERVE_PAGES", 256)
+    # round-14 scale-out knobs: the chat-shaped load (per-tenant shared
+    # system prompt — what prefix sharing exists for), the
+    # disaggregated prefill/decode split, and tensor-parallel decode
+    prefix_len = _env_int("BENCH_SERVE_PREFIX", 16)
+    disagg = os.environ.get("BENCH_SERVE_DISAGG", "0") == "1"
+    tp = _env_int("BENCH_SERVE_TP", 1)
     d_model = _env_int("BENCH_D_MODEL", 256)
     n_layers = _env_int("BENCH_LAYERS", 4)
     n_vocab = _env_int("BENCH_VOCAB", 8192)
@@ -1714,6 +1736,8 @@ def _run_bench_serving():
         n_vocab = min(n_vocab, 512)
         n_heads = max(1, d_model // 32)
         num_pages = min(num_pages, 64)
+    # the shared prefix must leave room for a per-request tail
+    prefix_len = max(0, min(prefix_len, prompt_max - 8))
     max_context = 1
     while max_context < prompt_max + max_new:
         max_context *= 2
@@ -1725,19 +1749,30 @@ def _run_bench_serving():
     engine = ServingEngine(model, num_pages=num_pages,
                            page_size=page_size, max_batch=max_batch,
                            max_context=max_context,
-                           max_queue=n_requests + max_batch)
+                           max_queue=n_requests + max_batch,
+                           prefix_cache=prefix_len > 0, disagg=disagg,
+                           tp=tp)
 
     rng = np.random.RandomState(0)
+    # chat-shaped load: every tenant re-sends its own fixed system
+    # prompt (prefix_len tokens) ahead of a random tail — the traffic
+    # shape prefix sharing multiplies effective pool capacity on
+    sys_prompts = [rng.randint(0, n_vocab, prefix_len).astype(np.int32)
+                   for _ in range(tenants)]
 
     def synth_requests(n, t0):
         reqs, t = [], t0
         for _ in range(n):
             t += rng.exponential(1.0 / qps)
+            ten = rng.randint(tenants)
+            tail = rng.randint(
+                0, n_vocab,
+                rng.randint(4, prompt_max - prefix_len + 1)) \
+                .astype(np.int32)
             reqs.append(Request(
-                rng.randint(0, n_vocab, rng.randint(4, prompt_max + 1))
-                .astype(np.int32),
+                np.concatenate([sys_prompts[ten], tail]),
                 max_new_tokens=max_new,
-                tenant=f"tenant{rng.randint(tenants)}",
+                tenant=f"tenant{ten}",
                 arrival_time=t))
         return reqs
 
@@ -1756,7 +1791,7 @@ def _run_bench_serving():
     # -- measured open-loop window
     for req in synth_requests(n_requests, 0.0):
         engine.submit(req)
-    occ, steps = [], 0
+    occ, cap_x, steps = [], [], 0
     base = time.monotonic()
     while engine.running or engine.scheduler.pending():
         if _remaining() < 20:
@@ -1769,6 +1804,7 @@ def _run_bench_serving():
             time.sleep(0.002)
             continue
         occ.append(st["occupancy"])
+        cap_x.append(st["capacity_x"])
         steps += 1
     elapsed = time.monotonic() - base
 
@@ -1811,6 +1847,20 @@ def _run_bench_serving():
         "d_model": d_model, "n_layers": n_layers, "n_vocab": n_vocab,
         "attn_mode": engine.mode,
         "page_dtype": str(engine.kv.dtype),
+        # round-14 scale-out surface: the chat-shaped load's measured
+        # prefix economics, the disagg ship's wire bytes, and tp
+        "prefix_tokens": prefix_len,
+        "prefix_hit_rate": round(engine.prefix_hits
+                                 / max(1, engine.admissions), 3),
+        "prefix_matched_tokens": int(engine.prefix_tokens_matched),
+        "forks": engine.forks,
+        "effective_capacity_x": round(float(np.mean(cap_x)), 3)
+        if cap_x else 1.0,
+        "effective_capacity_x_max": round(float(np.max(cap_x)), 3)
+        if cap_x else 1.0,
+        "disagg": engine.disagg,
+        "transferred_page_bytes": int(engine.transferred_page_bytes),
+        "tp": engine.tp,
         "compile_s": round(compile_s, 1),
         # the never-retrace contract, measured: bucket programs compiled
         # in warmup, zero traces during the window

@@ -1,7 +1,9 @@
 """Pallas TPU kernels for hot ops (the rebuild's N2/N3 escape hatch)."""
 
 from .flash_attention import attention, flash_attention, xla_attention
-from .paged_attention import paged_attn_mode, paged_decode_attention
+from .paged_attention import (paged_attn_mode, paged_decode_attention,
+                              paged_prefill_attention)
 
 __all__ = ["attention", "flash_attention", "xla_attention",
-           "paged_decode_attention", "paged_attn_mode"]
+           "paged_decode_attention", "paged_prefill_attention",
+           "paged_attn_mode"]

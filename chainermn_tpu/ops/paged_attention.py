@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["paged_decode_attention", "paged_attn_mode"]
+__all__ = ["paged_decode_attention", "paged_prefill_attention",
+           "paged_attn_mode", "head_sharding"]
 
 
 def paged_attn_mode(mode=None):
@@ -59,6 +60,29 @@ def paged_attn_mode(mode=None):
         raise ValueError(
             f"CHAINERMN_TPU_PAGED_ATTN={mode!r} invalid (paged|dense)")
     return mode
+
+
+def head_sharding(mesh, ndim, head_dim, axis="tp"):
+    """``NamedSharding`` pinning the HEAD dimension of an ``ndim``-rank
+    array to the ``tp`` mesh axis (the tensor-parallel decode layout:
+    heads shard like the ulysses path, every other dim replicated).
+    Used by the serving engine to place the KV pools per shard and by
+    :func:`paged_decode_attention` to constrain the gathered pages."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = [None] * ndim
+    spec[head_dim] = axis
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _constrain_heads(x, head_dim, tp_mesh, tp_axis):
+    """Pin ``x``'s head dimension to the tp axis (no-op without a
+    mesh).  Keeps GSPMD from re-replicating the pool gathers — the
+    whole point of tp decode is that each shard reads only ITS heads'
+    cache bytes."""
+    if tp_mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, head_sharding(tp_mesh, x.ndim, head_dim, tp_axis))
 
 
 def _masked_softmax_stats(s, valid):
@@ -87,8 +111,44 @@ def _dense_decode(q, k, v, ctx_len, scale):
     return out.astype(q.dtype)
 
 
+def paged_prefill_attention(q, k_pool, v_pool, block_table_row, start,
+                            true_len, scale=None):
+    """Suffix attention for a PREFIX-SHARED prefill (round 14).
+
+    ``q``: ``[T, H, D]`` — the suffix's queries, query ``t`` sitting at
+    absolute position ``start + t`` (``start`` = matched prefix
+    length).  The suffix's own K/V must already be WRITTEN into the
+    pools (``write_prompt_kv_at`` runs first), so ONE gather per pool
+    through ``block_table_row`` covers the whole context — shared
+    prefix pages and fresh suffix pages alike — and **zero flash
+    kernels ever touch the shared pages** (the committed
+    ``prefix_prefill`` census config pins this).  One masked softmax:
+    query ``t`` sees positions ``<= start + t`` (causality subsumes the
+    written-context bound since ``t < true_len``).  Scores are
+    ``[H, T, N·S]`` — suffix-length by context, never ``[T_ctx,
+    T_ctx]``: the FLOP saving IS the prefix hit.  Returns ``[T, H, D]``
+    in ``q.dtype``.
+    """
+    T, H, D = q.shape
+    S = k_pool.shape[1]
+    N = block_table_row.shape[0]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    k = k_pool[block_table_row].reshape(N * S, H, D)
+    v = v_pool[block_table_row].reshape(N * S, H, D)
+    s = jnp.einsum("thd,khd->htk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    kpos = lax.broadcasted_iota(jnp.int32, (1, 1, N * S), 2)
+    qpos = start + lax.broadcasted_iota(jnp.int32, (1, T, 1), 1)
+    p, l = _masked_softmax_stats(s, kpos <= qpos)
+    p = p / jnp.maximum(l, 1e-30)
+    out = jnp.einsum("htk,khd->thd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def paged_decode_attention(q, k_pool, v_pool, block_table, ctx_len,
-                           scale=None, mode=None):
+                           scale=None, mode=None, tp_mesh=None,
+                           tp_axis="tp"):
     """One decode step of attention for a batch of cached sequences.
 
     q: ``[B, H, D]`` — ONE query token per sequence (the just-appended
@@ -100,22 +160,31 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, ctx_len,
     ``ctx_len``).  ``ctx_len``: ``[B]`` int32 valid context lengths
     (``0`` = idle lane, output is zeros).  Returns ``[B, H, D]`` in
     ``q.dtype``.
+
+    ``tp_mesh``/``tp_axis``: tensor-parallel decode — the pools arrive
+    sharded over heads (``head_sharding``), and the constraints below
+    keep the gathers and the attention output sharded the same way, so
+    each shard reads only its own heads' cache bytes; the head axis is
+    elementwise throughout, so no collective fires inside this op (the
+    projection that consumes the output pays the one psum).
     """
     B, H, D = q.shape
     P, S = k_pool.shape[0], k_pool.shape[1]
     N = block_table.shape[1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     mode = paged_attn_mode(mode)
+    q = _constrain_heads(q, 1, tp_mesh, tp_axis)
 
     # the gather: every cached byte of the batch's context, exactly once,
     # addressed through the block table (pages, not contiguous buffers)
-    k_pages = k_pool[block_table]          # [B, N, S, H, D]
-    v_pages = v_pool[block_table]
+    k_pages = _constrain_heads(k_pool[block_table], 3, tp_mesh, tp_axis)
+    v_pages = _constrain_heads(v_pool[block_table], 3, tp_mesh, tp_axis)
 
     if mode == "dense":
         k = k_pages.reshape(B, N * S, H, D)
         v = v_pages.reshape(B, N * S, H, D)
-        return _dense_decode(q, k, v, ctx_len, scale)
+        return _constrain_heads(_dense_decode(q, k, v, ctx_len, scale),
+                                1, tp_mesh, tp_axis)
 
     # page-blockwise online softmax: scan the page axis with the flash
     # recurrence — score width bounded at S, fp32 running (m, l, acc)
@@ -147,4 +216,4 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, ctx_len,
     (m, l, acc), _ = lax.scan(step, (m0, l0, acc0),
                               (ks, vs, jnp.arange(N)))
     out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+    return _constrain_heads(out.astype(q.dtype), 1, tp_mesh, tp_axis)

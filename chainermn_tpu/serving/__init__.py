@@ -1,38 +1,53 @@
 """Serving subsystem: continuous-batching inference over a paged KV cache.
 
 The first inference-side subsystem of the rebuild (ROADMAP item 4 —
-"millions of users" needs a serving path, not just training throughput).
-Pieces, each its own module:
+"millions of users" needs a serving path, not just training throughput),
+grown in round 14 into the production scale-out shape (ROADMAP item 2):
+copy-on-write prefix sharing, disaggregated prefill/decode, and
+tensor-parallel paged decode.  Pieces, each its own module:
 
-* :mod:`.page_allocator` — pure host-side block allocator (page ids,
-  per-sequence block tables, typed OOM);
+* :mod:`.page_allocator` — refcounted host-side block allocator (page
+  ids, per-sequence block tables, prefix-hash trie for copy-on-write
+  prompt sharing, typed OOM);
 * :mod:`.kv_cache` — the preallocated ``[L, P, S, H, D]`` device pools
-  (bf16 pages by default) + in-graph scatter writers;
+  (bf16 pages by default) + in-graph scatter writers, the fork-on-write
+  page copy, and the disaggregation transfer receiver;
 * :mod:`ops.paged_attention <chainermn_tpu.ops.paged_attention>` — the
   decode hot loop's gather-through-the-block-table attention step
-  (``CHAINERMN_TPU_PAGED_ATTN=dense`` escape hatch);
+  (``CHAINERMN_TPU_PAGED_ATTN=dense`` escape hatch), the suffix-prefill
+  attention for prefix hits, and the tensor-parallel head sharding;
 * :mod:`.scheduler` — open-loop admission, per-tenant round-robin
-  fairness, preemption-by-eviction, typed backpressure;
-* :mod:`.engine` — the prefill/decode split wired together as two
-  bucketed jit programs over the shared pools.
+  fairness, refcount-aware preemption-by-eviction (typed
+  ``EvictionStalledError`` livelock guard), typed backpressure;
+* :mod:`.engine` — the prefill/decode split wired together as bucketed
+  jit programs over the shared pools, with the prefix cache, the
+  disaggregated slices (``CHAINERMN_TPU_SERVE_DISAGG``), and the ``tp``
+  mesh axis.
 
 Measurement: ``BENCH_MODEL=serving python bench.py`` (tokens/sec,
-p50/p99 per-token latency, page-pool occupancy under a seeded open-loop
-load); structure committed in ``tools/serving_budgets.json`` and gated
-tier-1 by ``tests/test_serving_budget.py``; ``make probe-serving`` joins
-the two.  Design notes: ``docs/serving.md``.
+p50/p99 per-token latency, page-pool occupancy, ``prefix_hit_rate`` +
+effective-capacity multiplier, ``transferred_page_bytes``, ``tp`` under
+a seeded chat-shaped open-loop load); structure committed in
+``tools/serving_budgets.json`` and gated tier-1 by
+``tests/test_serving_budget.py``; ``make probe-serving`` joins the two.
+Design notes: ``docs/serving.md``.
 """
 
-from .engine import ServingEngine, decode_program, prefill_program
-from .errors import (PagePoolExhaustedError, QueueSaturatedError,
-                     ServingError)
-from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
+from .engine import (ServingEngine, decode_program, prefill_program,
+                     prefix_prefill_program, serve_disagg_mode)
+from .errors import (EvictionStalledError, PagePoolExhaustedError,
+                     QueueSaturatedError, ServingError)
+from .kv_cache import (PagedKVCache, copy_page, insert_pages,
+                       write_prompt_kv, write_prompt_kv_at, write_token_kv)
 from .page_allocator import BlockAllocator
 from .scheduler import Request, RequestScheduler
 
 __all__ = [
-    "ServingEngine", "prefill_program", "decode_program",
-    "PagedKVCache", "write_prompt_kv", "write_token_kv",
+    "ServingEngine", "prefill_program", "prefix_prefill_program",
+    "decode_program", "serve_disagg_mode",
+    "PagedKVCache", "write_prompt_kv", "write_prompt_kv_at",
+    "write_token_kv", "copy_page", "insert_pages",
     "BlockAllocator", "Request", "RequestScheduler",
     "ServingError", "PagePoolExhaustedError", "QueueSaturatedError",
+    "EvictionStalledError",
 ]

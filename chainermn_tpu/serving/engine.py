@@ -19,6 +19,34 @@ programs share one paged KV cache:
   the running batch NEVER retrace — the engine counts traces
   (``prefill_traces``/``decode_traces``) and the tests pin it.
 
+Round 14 (ISSUE 13) adds the production scale-out legs:
+
+* **copy-on-write prefix sharing** (``prefix_cache=True``): admission
+  matches the prompt against the allocator's prefix-hash trie; matched
+  pages are SHARED (refcount++) and only the unmatched suffix prefills
+  — through :func:`prefix_prefill_program`, which reads the shared
+  prefix via the same one-gather-per-pool shape as decode and runs
+  ZERO flash kernels over shared pages.  A match ending mid-page forks
+  that page first (in-graph copy, ``copy_page``) so the borrower's
+  writes never touch the provider's bytes; the decode trajectory of a
+  shared request is bit-identical to its unshared solo run.
+* **disaggregated prefill/decode** (``disagg=True`` /
+  ``CHAINERMN_TPU_SERVE_DISAGG``): full prefills run on a PREFILL
+  device against a scratch pool (prefill is FLOP-bound; decode is
+  HBM-bound — the PR 3/PR 4 rooflines want different hardware), and
+  finished pages ship slice-to-slice (an ICI copy on real pods) into
+  the decode pool, metered by ``transferred_page_bytes``.  Prefix-HIT
+  suffix prefills run against the decode pool directly (they must read
+  the shared pages, and their FLOPs are exactly what the hit already
+  saved).  ``CHAINERMN_TPU_SERVE_DISAGG=off`` is the single-mesh
+  escape hatch — trajectory-identical, pinned by test.
+* **tensor-parallel decode** (``tp=K``): the KV pools are laid out per
+  shard — sharded over the HEAD axis of a ``tp`` mesh (the ulysses
+  head-sharding layout) — and both programs compile under GSPMD with
+  each shard reading only its own heads' cache bytes
+  (``ops.paged_attention.head_sharding`` pins the gathers).  Logits
+  match the single-chip decode at fp32 tolerance (parity-gated).
+
 Host work per step is scheduling metadata only (block tables, positions,
 sampled tokens — a few int32s per sequence); KV bytes never leave the
 device, and on real accelerators the pools are DONATED through both
@@ -28,13 +56,17 @@ generates warnings).
 
 Scheduling (``serving.scheduler``): open-loop admission at decode-step
 granularity with per-tenant round-robin fairness; when the page pool
-runs dry the youngest running sequence is evicted (pages freed, request
-re-queued front-of-line with its generated tokens folded into the
-prompt — recompute on re-admit) and the step proceeds.
+runs dry the youngest running sequence OWNING at least one unique page
+is evicted (pages freed, request re-queued front-of-line with its
+generated tokens folded into the prompt — recompute on re-admit) and
+the step proceeds; if no victim would free anything the typed
+``EvictionStalledError`` fires instead of spinning (the prefix-sharing
+livelock guard).
 """
 
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -44,13 +76,32 @@ import numpy as np
 from ..core.link import bind_state, extract_state
 from ..nn import functions as F
 from ..ops import attention as flash_attention_op
-from ..ops.paged_attention import paged_attn_mode, paged_decode_attention
+from ..ops.paged_attention import (head_sharding, paged_attn_mode,
+                                   paged_decode_attention,
+                                   paged_prefill_attention)
 from .errors import PagePoolExhaustedError
-from .kv_cache import PagedKVCache, write_prompt_kv, write_token_kv
+from .kv_cache import (PagedKVCache, copy_page, insert_pages,
+                       write_prompt_kv, write_prompt_kv_at, write_token_kv)
 from .page_allocator import BlockAllocator
 from .scheduler import RequestScheduler
 
-__all__ = ["ServingEngine", "prefill_program", "decode_program"]
+__all__ = ["ServingEngine", "prefill_program", "prefix_prefill_program",
+           "decode_program", "serve_disagg_mode"]
+
+
+def serve_disagg_mode(disagg=None):
+    """Resolve the disaggregation knob: ``CHAINERMN_TPU_SERVE_DISAGG=off``
+    is the single-mesh escape hatch and wins over everything (the
+    disagg-on trajectory is pinned identical to it, so the hatch is
+    always safe); ``on``/``1`` enables when the constructor left the
+    argument ``None``; default off.  Resolved ONCE at engine
+    construction, like the paged-attention mode."""
+    env = os.environ.get("CHAINERMN_TPU_SERVE_DISAGG", "").lower()
+    if env == "off":
+        return False
+    if disagg is not None:
+        return bool(disagg)
+    return env in ("on", "1")
 
 
 def _embed_tokens(model, toks, positions):
@@ -100,14 +151,66 @@ def prefill_program(model, state, k_pool, v_pool, tokens, true_len,
         return k_pool, v_pool, logits.astype(jnp.float32)
 
 
+def prefix_prefill_program(model, state, k_pool, v_pool, tokens, true_len,
+                           start, bt_row):
+    """Pure SUFFIX prefill for a prefix-shared request (round 14).
+
+    ``tokens``: ``[1, Tb]`` int32 suffix tokens (positions ``>=
+    true_len`` padding); suffix index ``t`` sits at absolute position
+    ``start + t``, where ``start`` is the matched prefix length.
+    ``bt_row``: ``[N]`` block table covering the WHOLE context (shared
+    prefix pages + the request's fresh suffix pages).  Per layer the
+    suffix's K/V scatter through the offset writer FIRST, then one
+    gather per pool reads the whole context back and the suffix queries
+    run one masked softmax against it
+    (:func:`~chainermn_tpu.ops.paged_attention.paged_prefill_attention`)
+    — ZERO flash kernels touch the shared pages, and the score matrix
+    is suffix-by-context, never context-by-context: skipping the
+    matched prefix's O(L²) attention and O(L·d²) projections is the
+    FLOP saving the prefix hit buys.  Returns ``(k_pool, v_pool,
+    logits)`` with ``logits`` the fp32 ``[V]`` row at suffix position
+    ``true_len - 1`` (the match is capped at ``prompt - 1`` tokens, so
+    the first-generation logits always come from a live suffix
+    position).
+    """
+    with bind_state(model, state):
+        B, T = tokens.shape
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (B, T), 1)
+        h = _embed_tokens(model, tokens, pos)
+        scale = 1.0 / (model.blocks[0].attn.d_head ** 0.5)
+        for li, block in enumerate(model.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x.reshape(B * T, -1)).reshape(
+                B, T, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            k_pool = k_pool.at[li].set(write_prompt_kv_at(
+                k_pool[li], k[0], bt_row, start, true_len))
+            v_pool = v_pool.at[li].set(write_prompt_kv_at(
+                v_pool[li], v[0], bt_row, start, true_len))
+            att = paged_prefill_attention(q[0], k_pool[li], v_pool[li],
+                                          bt_row, start, true_len,
+                                          scale=scale)
+            h = h + block.attn.proj(att.reshape(B * T, -1)) \
+                .reshape(B, T, -1)
+            m = block.fc2(F.gelu(block.fc1(block.ln2(h).reshape(B * T,
+                                                                -1))))
+            h = h + m.reshape(B, T, -1)
+        h_last = jax.lax.dynamic_slice_in_dim(
+            h[0], jnp.maximum(true_len - 1, 0), 1, axis=0)
+        logits = model.head(model.ln_f(h_last))[0]
+        return k_pool, v_pool, logits.astype(jnp.float32)
+
+
 def decode_program(model, state, k_pool, v_pool, toks, pos, bts, *,
-                   mode):
+                   mode, tp_mesh=None):
     """Pure decode step: one token per batch lane.
 
     ``toks``/``pos``: ``[Bb]`` int32 (``pos < 0`` marks an idle padding
     lane: its K/V write drops and its attention context is empty).
     ``bts``: ``[Bb, N]`` block tables.  Writes each lane's K/V at
     ``pos`` then attends over ``[0, pos]`` through the block table.
+    ``tp_mesh``: the tensor-parallel mesh — pools arrive head-sharded
+    and the attention op constrains its gathers to stay that way.
     Returns ``(k_pool, v_pool, logits [Bb, V] fp32, next_tok [Bb])``.
     """
     with bind_state(model, state):
@@ -126,7 +229,8 @@ def decode_program(model, state, k_pool, v_pool, toks, pos, bts, *,
             v_pool = v_pool.at[li].set(
                 write_token_kv(v_pool[li], v, bts, pos))
             att = paged_decode_attention(q, k_pool[li], v_pool[li], bts,
-                                         ctx_len, scale=scale, mode=mode)
+                                         ctx_len, scale=scale, mode=mode,
+                                         tp_mesh=tp_mesh)
             h = h + block.attn.proj(att.reshape(Bb, -1))
             h = h + block.fc2(F.gelu(block.fc1(block.ln2(h))))
         logits = model.head(model.ln_f(h)).astype(jnp.float32)
@@ -159,12 +263,23 @@ class ServingEngine:
 
     Greedy sampling (the serving bench's configuration); the paged/dense
     attention lowering is resolved ONCE at construction
-    (``CHAINERMN_TPU_PAGED_ATTN``).
+    (``CHAINERMN_TPU_PAGED_ATTN``), as is the disaggregation mode
+    (``CHAINERMN_TPU_SERVE_DISAGG``).
+
+    ``prefix_cache``: copy-on-write prefix sharing (default on).
+    ``disagg``: run full prefills on a separate prefill device/slice
+    and ship finished pages into the decode pool (``None`` = the env
+    knob; the default prefill device is the next device after the
+    decode slice, degenerating to the same device on one-device hosts).
+    ``tp``: shard the KV pools (and both programs) over the head axis
+    of a ``tp``-way mesh.
     """
 
     def __init__(self, model, num_pages=256, page_size=16, max_batch=8,
                  max_context=256, page_dtype=None, max_queue=256,
-                 scheduler=None, mode=None, eos_id=None):
+                 scheduler=None, mode=None, eos_id=None,
+                 prefix_cache=True, disagg=None, tp=1,
+                 prefill_device=None, decode_device=None):
         blk = model.blocks[0].attn
         n_layers = len(list(model.blocks))
         max_len = model.pos_embed.W.shape[0]
@@ -184,33 +299,124 @@ class ServingEngine:
         self.n_block_entries = -(-self.max_context // page_size)
         self.mode = paged_attn_mode(mode)
         self.eos_id = eos_id
+        self.prefix_cache = bool(prefix_cache)
+        self.disagg = serve_disagg_mode(disagg)
+        self.tp = int(tp)
         self.prefill_buckets = _pow2_buckets(min(16, self.max_context),
                                              self.max_context)
         self.batch_buckets = _pow2_buckets(1, self.max_batch)
+        self.transfer_buckets = _pow2_buckets(1, self.n_block_entries)
         self.running = []       # admission order, oldest first
         self.completed = []
         self.prefill_traces = 0
+        self.prefix_prefill_traces = 0
         self.decode_traces = 0
+        self.fork_traces = 0
+        self.transfer_traces = 0
         self.evictions = 0
         self.decode_steps = 0
+        self.admissions = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_matched = 0
+        self.forks = 0
+        self.transfers = 0
+        self.transferred_page_bytes = 0
+
+        devices = jax.devices()
+
+        # -- tensor-parallel decode: pools laid out per shard (head axis
+        # of the tp mesh — the ulysses sharding), params replicated over
+        # the mesh; both programs then compile under GSPMD
+        if self.tp > 1:
+            if blk.n_heads % self.tp:
+                raise ValueError(f"tp={self.tp} must divide n_heads="
+                                 f"{blk.n_heads}")
+            if len(devices) < self.tp:
+                raise ValueError(f"tp={self.tp} needs {self.tp} devices, "
+                                 f"have {len(devices)}")
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+            self._tp_mesh = Mesh(np.array(devices[:self.tp]), ("tp",))
+            pool_sh = head_sharding(self._tp_mesh, 5, 3)
+            self.kv.k_pool = jax.device_put(self.kv.k_pool, pool_sh)
+            self.kv.v_pool = jax.device_put(self.kv.v_pool, pool_sh)
+            self.state = jax.device_put(
+                self.state, NamedSharding(self._tp_mesh, PartitionSpec()))
+            # transferred page blocks land head-sharded too
+            self._block_placement = head_sharding(self._tp_mesh, 5, 3)
+        else:
+            self._tp_mesh = None
+            self._block_placement = decode_device or devices[0]
+
+        # -- disaggregation: a scratch pool + weight copy on the prefill
+        # device; finished pages ship into the decode pool (device_put —
+        # an ICI copy between slices on real pods), metered below
+        if self.disagg:
+            self._prefill_device = prefill_device or \
+                devices[self.tp % len(devices)]
+            if self.tp == 1:
+                dd = decode_device or devices[0]
+                self.kv.k_pool = jax.device_put(self.kv.k_pool, dd)
+                self.kv.v_pool = jax.device_put(self.kv.v_pool, dd)
+                self.state = jax.device_put(self.state, dd)
+            self._kv_prefill = PagedKVCache(
+                n_layers, self.n_block_entries, page_size, blk.n_heads,
+                blk.d_head, dtype=page_dtype)
+            self._kv_prefill.k_pool = jax.device_put(
+                self._kv_prefill.k_pool, self._prefill_device)
+            self._kv_prefill.v_pool = jax.device_put(
+                self._kv_prefill.v_pool, self._prefill_device)
+            self._state_prefill = jax.device_put(self.state,
+                                                 self._prefill_device)
+            # the scratch pool's identity block table: prefill always
+            # writes pages 0..pages_for(L)-1 of the scratch pool
+            self._scratch_bt = jax.device_put(
+                jnp.arange(self.n_block_entries, dtype=jnp.int32),
+                self._prefill_device)
 
         # donate the pools on real accelerators only: XLA then updates
         # pages in place; on cpu donation is ignored and merely warns
-        donate = (1, 2) if jax.default_backend() in ("tpu", "axon") \
-            else ()
+        real = jax.default_backend() in ("tpu", "axon")
+        donate = (1, 2) if real else ()
+        donate01 = (0, 1) if real else ()
 
         def _prefill(state, k_pool, v_pool, tokens, true_len, bt_row):
             self.prefill_traces += 1   # trace-time side effect only
             return prefill_program(self.model, state, k_pool, v_pool,
                                    tokens, true_len, bt_row)
 
+        def _prefix_prefill(state, k_pool, v_pool, tokens, true_len,
+                            start, bt_row):
+            self.prefix_prefill_traces += 1
+            return prefix_prefill_program(self.model, state, k_pool,
+                                          v_pool, tokens, true_len,
+                                          start, bt_row)
+
         def _decode(state, k_pool, v_pool, toks, pos, bts):
             self.decode_traces += 1    # trace-time side effect only
             return decode_program(self.model, state, k_pool, v_pool,
-                                  toks, pos, bts, mode=self.mode)
+                                  toks, pos, bts, mode=self.mode,
+                                  tp_mesh=self._tp_mesh)
+
+        def _fork(k_pool, v_pool, src, dst):
+            self.fork_traces += 1
+            return copy_page(k_pool, v_pool, src, dst)
+
+        def _extract(k_pool, v_pool, nb):
+            self.transfer_traces += 1
+            return k_pool[:, :nb], v_pool[:, :nb]
+
+        def _insert(k_pool, v_pool, kb, vb, rows):
+            self.transfer_traces += 1
+            return (insert_pages(k_pool, kb, rows),
+                    insert_pages(v_pool, vb, rows))
 
         self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+        self._prefix_prefill_fn = jax.jit(_prefix_prefill,
+                                          donate_argnums=donate)
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        self._fork_fn = jax.jit(_fork, donate_argnums=donate01)
+        self._extract_fn = jax.jit(_extract, static_argnums=2)
+        self._insert_fn = jax.jit(_insert, donate_argnums=donate01)
 
     # -- ingress -------------------------------------------------------------
 
@@ -223,7 +429,10 @@ class ServingEngine:
         evict itself, fold its tokens into the prompt, and re-admit
         into the same wall forever (eviction can only free OTHER
         sequences' pages).  Conservative for eos-terminated requests by
-        design: admission cannot know where eos lands."""
+        design: admission cannot know where eos lands — and
+        conservative under prefix sharing too: the match is computed at
+        ADMISSION (sharing at submit would pin live pages for the whole
+        open-loop queue depth), so the fit check assumes zero hit."""
         total = request.prompt.size + request.max_new_tokens
         if total > self.max_context:
             raise ValueError(
@@ -262,26 +471,123 @@ class ServingEngine:
         self.completed.append(req)
 
     def _evict(self, req):
-        """Preemption: free pages, fold generated tokens into the
-        prompt, re-queue front-of-line (recompute on re-admit)."""
+        """Preemption: free pages (refcount-aware — shared pages stay
+        alive through their other holders), fold generated tokens into
+        the prompt, re-queue front-of-line (recompute on re-admit)."""
         self.allocator.free(req.request_id)
         self.running.remove(req)
         self.scheduler.requeue_front(req)
         self.evictions += 1
 
-    def _admit(self, req, clock):
-        """Pages + prefill + first token.  Raises PagePoolExhaustedError
-        (allocator untouched) when the pool cannot hold the prompt."""
-        L = int(req.prompt.size)
-        self.allocator.ensure(req.request_id, L + 1)  # +1: first decode
+    def _run_fork(self, src, dst):
+        """Copy-on-write page copy, in-graph (traced indices: every
+        fork reuses the one compiled program)."""
+        self.kv.k_pool, self.kv.v_pool = self._fork_fn(
+            self.kv.k_pool, self.kv.v_pool, jnp.int32(src),
+            jnp.int32(dst))
+        self.forks += 1
+
+    def _run_prefix_prefill(self, req, L, matched):
+        """Prefix HIT: prefill only the unmatched suffix, against the
+        decode pool (the shared pages live there — and on the disagg
+        split this is exactly the work the hit keeps OFF the prefill
+        slice)."""
+        Ts = L - matched
+        Tb = _bucket(Ts, self.prefill_buckets, "suffix length")
+        tokens = np.zeros((1, Tb), dtype=np.int32)
+        tokens[0, :Ts] = req.prompt[matched:]
+        k_pool, v_pool, logits = self._prefix_prefill_fn(
+            self.state, self.kv.k_pool, self.kv.v_pool,
+            jnp.asarray(tokens), np.int32(Ts), np.int32(matched),
+            jnp.asarray(self._bt_row(req.request_id)))
+        self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        return logits
+
+    def _run_disagg_prefill(self, req, L):
+        """Prefix MISS on the disagg split: the full flash prefill runs
+        on the PREFILL device against the scratch pool (identity block
+        table), then the finished pages ship into the decode pool —
+        bucketed page-count block, ``device_put`` across the slice
+        boundary (an ICI copy on real pods), drop-fenced scatter on
+        arrival — metered by ``transferred_page_bytes``."""
         Tb = _bucket(L, self.prefill_buckets, "prompt length")
         tokens = np.zeros((1, Tb), dtype=np.int32)
         tokens[0, :L] = req.prompt
-        k_pool, v_pool, logits = self._prefill_fn(
-            self.state, self.kv.k_pool, self.kv.v_pool,
-            jnp.asarray(tokens), np.int32(L),
-            jnp.asarray(self._bt_row(req.request_id)))
-        self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        k, v, logits = self._prefill_fn(
+            self._state_prefill, self._kv_prefill.k_pool,
+            self._kv_prefill.v_pool, jnp.asarray(tokens), np.int32(L),
+            self._scratch_bt)
+        self._kv_prefill.k_pool, self._kv_prefill.v_pool = k, v
+        n_pages = self.allocator.pages_for(L)
+        nb = _bucket(n_pages, self.transfer_buckets, "transfer pages")
+        kb, vb = self._extract_fn(k, v, nb)
+        kb = jax.device_put(kb, self._block_placement)
+        vb = jax.device_put(vb, self._block_placement)
+        rows = np.full(nb, self.kv.num_pages, dtype=np.int32)
+        rows[:n_pages] = self.allocator.block_table(
+            req.request_id)[:n_pages]
+        self.kv.k_pool, self.kv.v_pool = self._insert_fn(
+            self.kv.k_pool, self.kv.v_pool, kb, vb, jnp.asarray(rows))
+        self.transferred_page_bytes += \
+            nb * self.kv.n_layers * self.kv.page_bytes
+        self.transfers += 1
+        return logits
+
+    def _admit(self, req, clock):
+        """Pages + prefill + first token.  Raises PagePoolExhaustedError
+        (allocator untouched — a partial share is rolled back) when the
+        pool cannot hold the prompt.
+
+        Prefix sharing happens HERE, not at submit: only sequences live
+        at admission can provide pages, and sharing earlier would pin
+        pool pages for the whole queue depth.  The match is capped at
+        ``L - 1`` so prefill always has >= 1 suffix token to produce
+        the first-generation logits; a match ending mid-page forks that
+        page (copy-on-write) before the suffix's first write."""
+        L = int(req.prompt.size)
+        sid = req.request_id
+        matched = 0
+        prompt_t = tuple(int(t) for t in req.prompt) \
+            if self.prefix_cache else ()
+        if self.prefix_cache and L > 1:
+            pages, matched, n_full, partial = \
+                self.allocator.match_prefix(prompt_t, L - 1)
+            if matched:
+                # all HOST-side allocation first (each call atomic, the
+                # composite rolled back below), the device page copy
+                # only once the admission cannot fail — a rollback must
+                # not burn a copy or inflate the forks counter
+                self.allocator.share(sid, pages)
+                old = new = None
+                try:
+                    if partial:
+                        old, new = self.allocator.fork(sid, n_full)
+                    self.allocator.ensure(sid, L + 1)  # +1: first decode
+                except PagePoolExhaustedError:
+                    self.allocator.free(sid)   # roll the share back
+                    raise
+                if new is not None and old != new:
+                    self._run_fork(old, new)
+        if not matched:
+            self.allocator.ensure(sid, L + 1)
+        if matched:
+            logits = self._run_prefix_prefill(req, L, matched)
+            self.prefix_hits += 1
+            self.prefix_tokens_matched += matched
+        elif self.disagg:
+            logits = self._run_disagg_prefill(req, L)
+        else:
+            Tb = _bucket(L, self.prefill_buckets, "prompt length")
+            tokens = np.zeros((1, Tb), dtype=np.int32)
+            tokens[0, :L] = req.prompt
+            k_pool, v_pool, logits = self._prefill_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.asarray(tokens), np.int32(L),
+                jnp.asarray(self._bt_row(sid)))
+            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        self.admissions += 1
+        if self.prefix_cache:
+            self.allocator.register_prefix(sid, prompt_t)
         tok = int(np.asarray(jnp.argmax(logits)))
         req._ctx = L            # positions whose KV is written
         t = clock()
@@ -290,18 +596,62 @@ class ServingEngine:
         if self._finished(req):
             self._retire(req, t)
 
+    def capacity_multiplier(self):
+        """Effective-capacity multiplier prefix sharing is buying right
+        now: logical pages (what an unshared pool would hold for the
+        same residency) over distinct physical pages.  1.0 when nothing
+        is shared."""
+        used = self.allocator.used_pages
+        return self.allocator.logical_pages() / used if used else 1.0
+
     def warmup(self):
         """Compile EVERY bucketed program up front: one dummy prefill
-        per prompt bucket (``true_len=0`` — every page write drops) and
-        one dummy decode per batch bucket (all lanes idle).  Pool
-        contents are unchanged; afterwards joins/leaves never retrace
-        (the serving bench asserts ``window_retraces == 0``)."""
+        per prompt bucket (``true_len=0`` — every page write drops; on
+        the disagg split these run on the prefill device against the
+        scratch pool), one dummy suffix prefill per bucket plus the
+        fork-copy program (prefix sharing), one extract+insert pair per
+        transfer page bucket (disagg — padding rows, every scatter
+        drops), and one dummy decode per batch bucket (all lanes idle).
+        Pool contents are unchanged; afterwards joins/leaves/forks/
+        transfers never retrace (the serving bench asserts
+        ``window_retraces == 0``)."""
         for Tb in self.prefill_buckets:
-            k_pool, v_pool, _ = self._prefill_fn(
-                self.state, self.kv.k_pool, self.kv.v_pool,
-                jnp.zeros((1, Tb), jnp.int32), np.int32(0),
-                jnp.zeros(self.n_block_entries, jnp.int32))
-            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            if self.disagg:
+                k, v, _ = self._prefill_fn(
+                    self._state_prefill, self._kv_prefill.k_pool,
+                    self._kv_prefill.v_pool,
+                    jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                    self._scratch_bt)
+                self._kv_prefill.k_pool, self._kv_prefill.v_pool = k, v
+            else:
+                k_pool, v_pool, _ = self._prefill_fn(
+                    self.state, self.kv.k_pool, self.kv.v_pool,
+                    jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                    jnp.zeros(self.n_block_entries, jnp.int32))
+                self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        if self.disagg:
+            for nb in self.transfer_buckets:
+                kb, vb = self._extract_fn(self._kv_prefill.k_pool,
+                                          self._kv_prefill.v_pool, nb)
+                kb = jax.device_put(kb, self._block_placement)
+                vb = jax.device_put(vb, self._block_placement)
+                rows = jnp.full(nb, self.kv.num_pages, jnp.int32)
+                self.kv.k_pool, self.kv.v_pool = self._insert_fn(
+                    self.kv.k_pool, self.kv.v_pool, kb, vb, rows)
+        if self.prefix_cache:
+            for Tb in self.prefill_buckets:
+                k_pool, v_pool, _ = self._prefix_prefill_fn(
+                    self.state, self.kv.k_pool, self.kv.v_pool,
+                    jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                    np.int32(0),
+                    jnp.zeros(self.n_block_entries, jnp.int32))
+                self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            # the fork-copy program: src == dst == 0 is a self-copy
+            # (contents unchanged); indices are traced, so this one
+            # compile serves every fork
+            self.kv.k_pool, self.kv.v_pool = self._fork_fn(
+                self.kv.k_pool, self.kv.v_pool, jnp.int32(0),
+                jnp.int32(0))
         for Bb in self.batch_buckets:
             k_pool, v_pool, _, nxt = self._decode_fn(
                 self.state, self.kv.k_pool, self.kv.v_pool,
@@ -336,9 +686,13 @@ class ServingEngine:
                 self.allocator.ensure(req.request_id, req._ctx + 1)
                 i += 1
             except PagePoolExhaustedError:
-                victim = self.scheduler.pick_victim(self.running)
+                # refcount-aware victim choice: a victim must FREE
+                # something (EvictionStalledError otherwise — the
+                # prefix-sharing livelock guard)
+                victim = self.scheduler.pick_victim(self.running,
+                                                    self.allocator)
                 self._evict(victim)
-                # victim == req: the slot under scrutiny vanished —
+                # victim may be req: the slot under scrutiny vanished —
                 # re-check the same index (now the next request)
         # admission at decode-step granularity, into the pages left
         # over (its growth page is secured by _admit's ensure(L + 1))
@@ -359,6 +713,7 @@ class ServingEngine:
         stats["running"] = n
         stats["occupancy"] = (self.allocator.used_pages
                               / self.allocator.num_pages)
+        stats["capacity_x"] = self.capacity_multiplier()
         if n == 0:
             stats["decoded"] = 0
             return stats

@@ -26,7 +26,8 @@ host-channel catch-point.
 
 from __future__ import annotations
 
-__all__ = ["ServingError", "PagePoolExhaustedError", "QueueSaturatedError"]
+__all__ = ["ServingError", "PagePoolExhaustedError", "QueueSaturatedError",
+           "EvictionStalledError"]
 
 
 class ServingError(RuntimeError):
@@ -62,3 +63,20 @@ class QueueSaturatedError(ServingError):
         super().__init__(
             f"tenant {tenant!r} queue saturated ({self.depth}/{self.bound})"
             " — shed load or retry later")
+
+
+class EvictionStalledError(ServingError):
+    """Eviction cannot free a single page: every running sequence's
+    pages are all SHARED (refcount > 1), so no victim's ``free`` would
+    return anything to the pool and the pool-dry loop would spin
+    forever (the round-14 prefix-sharing livelock).  Carries the
+    running-batch size so a supervisor can decide between shedding load
+    and growing the pool.  The victim policy accounts uniquely-owned
+    pages and escalates youngest -> oldest before raising this."""
+
+    def __init__(self, n_running):
+        self.n_running = int(n_running)
+        super().__init__(
+            f"eviction stalled: none of the {self.n_running} running "
+            "sequence(s) owns a uniquely-held page — evicting any of "
+            "them would free nothing (all pages shared)")

@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["PagedKVCache", "write_prompt_kv", "write_token_kv"]
+__all__ = ["PagedKVCache", "write_prompt_kv", "write_prompt_kv_at",
+           "write_token_kv", "copy_page", "insert_pages"]
 
 
 def write_prompt_kv(pool_l, kv, block_table_row, true_len):
@@ -39,6 +40,44 @@ def write_prompt_kv(pool_l, kv, block_table_row, true_len):
     pages = jnp.where(t < true_len, block_table_row[t // S], P)
     return pool_l.at[pages, t % S].set(kv.astype(pool_l.dtype),
                                        mode="drop")
+
+
+def write_prompt_kv_at(pool_l, kv, block_table_row, start, true_len):
+    """Offset prompt writer for the prefix-sharing suffix prefill.
+
+    ``kv``: ``[T, H, D]`` SUFFIX K/V — position ``t`` of the suffix
+    lives at absolute position ``start + t``, so the scatter addresses
+    ``block_table_row[(start + t) // S]`` slot ``(start + t) % S``.
+    Positions ``>= true_len`` (suffix padding) drop.  ``start = 0``
+    degenerates to :func:`write_prompt_kv`.
+    """
+    P, S = pool_l.shape[0], pool_l.shape[1]
+    T = kv.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    posn = start + t
+    pages = jnp.where(t < true_len, block_table_row[posn // S], P)
+    return pool_l.at[pages, posn % S].set(kv.astype(pool_l.dtype),
+                                          mode="drop")
+
+
+def copy_page(k_pool, v_pool, src, dst):
+    """Fork-on-write: duplicate page ``src`` into page ``dst`` across
+    every layer of BOTH pools — the copy-on-write half of the round-14
+    prefix sharing, run in-graph through the same scatter machinery as
+    the writers (``mode="drop"`` fencing intact).  ``src``/``dst`` are
+    TRACED scalars, so one compiled program serves every fork (the
+    never-retrace contract covers forks)."""
+    k_pool = k_pool.at[:, dst].set(k_pool[:, src], mode="drop")
+    v_pool = v_pool.at[:, dst].set(v_pool[:, src], mode="drop")
+    return k_pool, v_pool
+
+
+def insert_pages(pool, block, rows):
+    """Disaggregation ship receiver: scatter a transferred page block
+    ``[L, nb, S, H, D]`` (the prefill slice's finished pages) into the
+    decode pool at page ids ``rows`` (``[nb]`` int32; padding rows carry
+    the out-of-range id ``P`` and drop)."""
+    return pool.at[:, rows].set(block.astype(pool.dtype), mode="drop")
 
 
 def write_token_kv(pool_l, kv, block_tables, pos):

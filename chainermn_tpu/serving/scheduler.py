@@ -149,10 +149,26 @@ class RequestScheduler:
         return None
 
     @staticmethod
-    def pick_victim(running):
+    def pick_victim(running, allocator=None):
         """Eviction policy: the YOUNGEST running request (last admitted
         — least service consumed, least recompute wasted).  ``running``
-        is admission-ordered oldest-first, as the engine keeps it."""
+        is admission-ordered oldest-first, as the engine keeps it.
+
+        With prefix sharing an ``allocator`` must be passed: a victim is
+        only useful if evicting it RETURNS pages to the pool, and a
+        sequence whose pages are all shared (refcount > 1) frees
+        nothing — picking it would spin the pool-dry loop forever.  The
+        policy therefore accounts only UNIQUELY-owned pages, escalating
+        youngest -> oldest past zero-unique candidates, and raises the
+        typed :class:`~chainermn_tpu.serving.errors.EvictionStalledError`
+        when no running sequence would free a single page (the round-14
+        livelock guard, pinned by test)."""
         if not running:
             return None
-        return running[-1]
+        if allocator is None:
+            return running[-1]
+        for req in reversed(running):
+            if allocator.unique_pages(req.request_id) > 0:
+                return req
+        from .errors import EvictionStalledError
+        raise EvictionStalledError(len(running))

@@ -157,3 +157,162 @@ def test_admission_order_exposed_for_eviction_policy():
     a.free("b")
     a.ensure("d", 2)
     assert a.sequences() == ["a", "c", "d"]
+
+
+# -- round 14: refcounted sharing + the prefix-hash trie ---------------------
+
+
+def test_share_refcounts_and_conservation():
+    a = BlockAllocator(8, 4)
+    t = a.ensure("prov", 9)              # 3 pages
+    a.share("bor", t[:2])                # 2 shared pages
+    assert a.refcount(t[0]) == 2 and a.refcount(t[2]) == 1
+    # conservation counts DISTINCT owned pages; logical counts holders
+    assert a.free_pages == 5 and a.used_pages == 3
+    assert a.logical_pages() == 5
+    assert a.unique_pages("prov") == 1 and a.unique_pages("bor") == 0
+    assert a.check()
+    # provider frees: shared pages stay alive through the borrower
+    assert a.free("prov") == 1           # only its unique page returns
+    assert a.refcount(t[0]) == 1
+    assert a.used_pages == 2 and a.check()
+    # borrower frees: now they come back
+    assert a.free("bor") == 2
+    assert a.free_pages == 8 and a.check()
+
+
+def test_shared_pages_recycle_fifo_at_refcount_zero():
+    """FIFO free-order is preserved AT THE MOMENT a page's refcount
+    hits zero — not at the first free of a holder (the page is still
+    live then)."""
+    a = BlockAllocator(6, 2)
+    t = a.ensure(0, 8)                   # pages 0..3
+    a.share(1, t[:2])                    # 0,1 shared
+    a.free(0)                            # frees 2,3 only (0,1 shared)
+    assert a.ensure(2, 4) == [4, 5]      # FIFO: the untouched tail first
+    assert a.ensure(3, 4) == [2, 3]      # then 0's returned unique pages
+    a.free(1)                            # NOW 0,1 return, in table order
+    assert a.ensure(4, 4) == [0, 1]
+    assert a.check()
+
+
+def test_fork_moves_refcount_and_is_atomic():
+    a = BlockAllocator(4, 4)
+    t = a.ensure("prov", 6)              # pages 0,1
+    a.share("bor", t)
+    old, new = a.fork("bor", 1)
+    assert (old, new) == (1, 2)
+    assert a.refcount(1) == 1 and a.refcount(2) == 1
+    assert a.block_table("bor") == [0, 2]
+    assert a.block_table("prov") == [0, 1]   # provider untouched
+    assert a.check()
+    # unshared page: fork degenerates to a no-op (old == new)
+    assert a.fork("bor", 1) == (2, 2)
+    # pool dry: typed + atomic
+    a.ensure("filler", 4)                # takes the last free page
+    a.share("b2", a.block_table("prov"))
+    snapshot = (a.free_pages, a.block_table("b2"))
+    with pytest.raises(PagePoolExhaustedError):
+        a.fork("b2", 0)
+    assert (a.free_pages, a.block_table("b2")) == snapshot
+    assert a.check()
+
+
+def test_trie_match_full_pages_and_partial_tail():
+    a = BlockAllocator(16, 4)
+    prompt = tuple(range(10))            # 2 full chunks + 2-token tail
+    a.ensure("prov", 11)
+    a.register_prefix("prov", prompt)
+    # identical prompt, capped at L-1=9: 2 full pages + 1 partial token
+    pages, matched, n_full, partial = a.match_prefix(prompt, 9)
+    assert (matched, n_full, partial) == (9, 2, 1)
+    assert pages == a.block_table("prov")[:3]
+    # page-aligned divergence: only the matching full chunk shares
+    other = tuple(range(4)) + (99,) * 6
+    pages, matched, n_full, partial = a.match_prefix(other, 9)
+    assert (matched, n_full, partial) == (4, 1, 0)
+    # no registration -> no match
+    assert a.match_prefix((7, 7, 7, 7), 3) == ([], 0, 0, 0)
+    # freeing the provider unregisters: nothing matches afterwards
+    a.free("prov")
+    assert a.match_prefix(prompt, 9) == ([], 0, 0, 0)
+    assert a.check()
+
+
+def test_trie_partial_cap_and_first_registration_wins():
+    a = BlockAllocator(16, 4)
+    a.ensure("p1", 7)
+    a.register_prefix("p1", (1, 2, 3, 4, 5, 6))      # tail (5, 6)
+    a.ensure("p2", 7)
+    a.register_prefix("p2", (1, 2, 3, 4, 5, 7))      # tail (5, 7)
+    # both partials match (5,...) with c=1: the FIRST registration wins
+    pages, matched, n_full, partial = a.match_prefix(
+        (1, 2, 3, 4, 5, 8, 9), 6)
+    assert (matched, n_full, partial) == (5, 1, 1)
+    assert pages[-1] == a.block_table("p1")[1]
+    # the longer common prefix wins over registration order
+    pages2, matched2, _, partial2 = a.match_prefix(
+        (1, 2, 3, 4, 5, 7, 9), 6)
+    assert (matched2, partial2) == (6, 2)
+    assert pages2[-1] == a.block_table("p2")[1]
+    # cap clips a would-be partial match entirely
+    assert a.match_prefix((1, 2, 3, 4, 5, 6), 4)[1] == 4
+
+
+def test_seeded_trace_with_sharing_is_deterministic():
+    """The PR 9 determinism contract survives sharing: a seeded
+    admit/share/fork/free churn replays to bit-identical tables."""
+    def replay(seed):
+        rng = np.random.RandomState(seed)
+        a = BlockAllocator(24, 4)
+        live = {}
+        tables = []
+        for step in range(300):
+            op = rng.randint(4)
+            if op == 0 and len(live) < 8:          # admit w/ match
+                sid = step
+                toks = tuple(int(x) for x in rng.randint(0, 3, 11))
+                pages, m, n_full, c = a.match_prefix(toks, len(toks) - 1)
+                try:
+                    if m:
+                        a.share(sid, pages)
+                        if c:
+                            a.fork(sid, n_full)
+                        a.ensure(sid, len(toks) + 1)
+                    else:
+                        a.ensure(sid, len(toks) + 1)
+                    a.register_prefix(sid, toks)
+                    live[sid] = toks
+                except PagePoolExhaustedError:
+                    if sid in a.sequences():
+                        a.free(sid)
+            elif op == 1 and live:                 # grow
+                sid = sorted(live)[int(rng.randint(len(live)))]
+                try:
+                    a.ensure(sid, a.capacity(sid) + 1)
+                except PagePoolExhaustedError:
+                    a.free(sid)
+                    del live[sid]
+            elif op == 2 and live:                 # retire
+                sid = sorted(live)[int(rng.randint(len(live)))]
+                a.free(sid)
+                del live[sid]
+            assert a.check()
+            tables.append({s: tuple(a.block_table(s)) for s in live})
+        return tables
+
+    assert replay(11) == replay(11)
+    assert replay(11) != replay(12)
+
+
+def test_eviction_accounting_unique_pages():
+    """The livelock guard's accounting surface: a sequence whose pages
+    are ALL shared would free nothing; unique_pages says so."""
+    a = BlockAllocator(8, 4)
+    t = a.ensure("prov", 8)              # 2 pages
+    a.share("bor", t)                    # borrower holds ONLY shared
+    assert a.unique_pages("bor") == 0
+    assert a.unique_pages("prov") == 0   # both sides fully shared now
+    a.ensure("bor", 9)                   # growth page is unique
+    assert a.unique_pages("bor") == 1
+    assert a.check()

@@ -128,8 +128,16 @@ def test_cpu_smoke_is_clamped_labeled_and_retrace_free(tmp_path):
     assert row["completed"] == 12
     for key in ("p50_token_latency_ms", "p99_token_latency_ms",
                 "page_occupancy_mean", "page_occupancy_max",
-                "attn_mode", "page_dtype"):
+                "attn_mode", "page_dtype", "prefix_hit_rate",
+                "prefix_matched_tokens", "effective_capacity_x",
+                "forks", "disagg", "transferred_page_bytes", "tp"):
         assert key in row, key
+    # the chat-shaped load (per-tenant shared system prompts, the
+    # default) must actually HIT: measured sharing economics, not
+    # zero-filled columns (the ISSUE 13 acceptance pin)
+    assert row["prefix_hit_rate"] > 0
+    assert row["effective_capacity_x"] > 1.0
+    assert row["disagg"] is False and row["tp"] == 1
     # the smoke never touches the caches (metric fencing end-to-end)
     assert not os.path.exists(tmp_path / "cache.json")
     assert not os.path.exists(tmp_path / "repo.json")

@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-sp${BENCH_SERVE_PREFIX:-d}-sd${BENCH_SERVE_DISAGG:-d}-stp${BENCH_SERVE_TP:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,43 +78,47 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 27 bench steps recorded, each once, in queue order
+    # all 30 bench steps recorded, each once, in queue order
     expected = [
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # prewarm
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # flagship
-        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # donation
-        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # headroom
-        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # input
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # prewarm
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # flagship
+        "resnet50-bs256-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bs256-NCHW-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bs256-d-scan8-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn0-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # donation
+        "resnet50-bs512-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # headroom
+        "resnet50-bsd-d-scand-seqd-ip1-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # input
         # ISSUE 5: bucket-MB sweep + reduce-scatter A/B legs
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk1-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk4-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exbucketed-bk16-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exreduce_scatter-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         # ISSUE 6: hierarchical two-level exchange, forced 2x4 split
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         # ISSUE 8: DCN wire-dtype A/B + error-feedback ablation
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdnone-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef0-sqd-std-prd-med-mkd",
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-srd-gdint8-ef1-sqd-std-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdnone-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef0-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         # ISSUE 11: striped multi-path exchange, 2x4 split at r=0.25
-        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exstriped-bkd-is2-sr0.25-gdd-ef1-sqd-std-prd-med-mkd",
-        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",  # flash
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exstriped-bkd-is2-sr0.25-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "longcontext-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # flash
         # ISSUE 9: serving engine rows (flagship qps16x4 + saturation)
-        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sq64-st8-prd-med-mkd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sq64-st8-spd-sdd-stpd-prd-med-mkd",
+        # ISSUE 13: serving scale-out A/Bs (prefix-off, disagg, tp=2)
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-sp0-sdd-stpd-prd-med-mkd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sq64-std-spd-sd1-stpd-prd-med-mkd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stp2-prd-med-mkd",
         # ISSUE 12: MoE dispatch A/B rows (flat vs two-stage vs
         # two-stage+int8; BENCH_MOE_* fingerprint knobs pinned — the
         # int8 row sets BENCH_MOE_TOPK explicitly)
-        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-prd-med-mkd",
-        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-prd-med-mk1",
+        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mk1",
     ]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
@@ -170,7 +174,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the twenty-seven bench rows
+    the queue must still complete (|| true), the thirty bench rows
     must already be folded, and NO empty 'Flash-vs-XLA' section may be
     appended."""
     shim = tmp_path / "bin"
@@ -194,5 +198,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 27
+                if '"final"' in ln]) == 30
     assert "Flash-vs-XLA" not in notes_text

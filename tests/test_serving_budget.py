@@ -32,7 +32,9 @@ def _budgets():
 
 def test_budget_schema():
     b = _budgets()
-    assert set(b["structure"]) == {"decode", "prefill"}
+    assert set(b["structure"]) == {"decode", "prefill", "prefix_prefill",
+                                   "disagg_decode_slice",
+                                   "transfer_insert"}
     g = b["geometry"]
     # the full-T detector's soundness precondition: T strictly exceeds
     # every feature dimension of the census vertical, so two T-sized
@@ -114,6 +116,59 @@ def test_full_t_detector_is_alive():
     assert facts["full_t_score_dots"] >= g["n_layers"]
 
 
+def test_prefix_prefill_structure_gate():
+    """The round-14 prefix-hit contract, machine-checked: the suffix
+    prefill reads the shared prefix THROUGH the block table (one gather
+    per pool per layer), scatters only the suffix (one offset write per
+    pool per layer), and runs ZERO flash kernels — recomputing the
+    matched prefix with a full flash pass is the regression this gate
+    exists to catch.  No [T, T] score dot either: the score is
+    suffix-bucket × context, which is the FLOP saving itself."""
+    b = _budgets()
+    census = serving_census.prefix_prefill_census()
+    assert census == b["structure"]["prefix_prefill"], (
+        f"prefix_prefill structure drifted: traced {census}, committed "
+        f"{b['structure']['prefix_prefill']}")
+    L = b["geometry"]["n_layers"]
+    assert census["flash_fwd_kernels"] == 0   # ZERO flash over shared pages
+    assert census["pool_gathers"] == 2 * L    # prefix read via the table
+    assert census["pool_scatters"] == 2 * L   # suffix written, offset
+    assert census["full_t_score_dots"] == 0
+    assert census["bwd_kernels"] == 0
+    # detector soundness for the suffix score: one dim (context) may
+    # reach T, the suffix bucket must stay strictly below it
+    g = b["geometry"]
+    assert g["prefix_suffix_T"] < g["max_context"]
+
+
+def test_disagg_decode_slice_gate():
+    """Disaggregation's decode-slice contract: the only compute program
+    on the HBM-bound slice is the decode step — zero prefill (flash)
+    kernels, zero full-T dots, zero bwd kernels.  Pinned against the
+    live decode trace so it cannot drift from the single-mesh decode
+    either (the trajectory-identity hatch is structural too)."""
+    b = _budgets()
+    census = serving_census.disagg_decode_slice_census()
+    assert census == b["structure"]["disagg_decode_slice"]
+    assert census == b["structure"]["decode"]   # same program, one mesh
+    assert census["flash_fwd_kernels"] == 0     # no prefill on the slice
+    assert census["full_t_score_dots"] == 0
+    assert census["bwd_kernels"] == 0
+
+
+def test_transfer_insert_gate():
+    """The page ship lands as ONE drop-fenced full-pool scatter — data
+    movement only: no gathers, no kernels, no score dots.  A transfer
+    that recomputes (or reads back) on arrival fails here."""
+    b = _budgets()
+    census = serving_census.transfer_insert_census()
+    assert census == b["structure"]["transfer_insert"]
+    assert census["pool_scatters"] == 1
+    assert census["pool_gathers"] == 0
+    assert census["flash_fwd_kernels"] == 0
+    assert census["bwd_kernels"] == 0
+
+
 def test_targets_armed_when_measured():
     b = _budgets()
     t = b["targets"]
@@ -138,7 +193,9 @@ def test_census_tool_cli_smoke():
         env=env, capture_output=True, text=True, timeout=600, cwd=root)
     assert out.returncode == 0, out.stderr[-2000:]
     rows = [json.loads(l) for l in out.stdout.strip().splitlines()]
-    assert {r["phase"] for r in rows} == {"decode", "prefill"}
+    assert {r["phase"] for r in rows} == {
+        "decode", "prefill", "prefix_prefill", "disagg_decode_slice",
+        "transfer_insert"}
     committed = _budgets()["structure"]
     for r in rows:
         facts = {k: v for k, v in r.items() if k not in ("probe", "phase")}

@@ -758,7 +758,12 @@ def probe_serving():
             ("decode", 2 * g["n_layers"] * g["max_context"] * H * D
              * kv_itemsize),
             # prefill writes each position's K+V exactly once
-            ("prefill", 2 * g["n_layers"] * H * D * kv_itemsize)):
+            ("prefill", 2 * g["n_layers"] * H * D * kv_itemsize),
+            # a prefix-hit suffix token reads the whole context's K+V
+            # once (decode's shape) instead of recomputing the matched
+            # prefix — the byte cost of the FLOPs the hit saves
+            ("prefix_prefill", 2 * g["n_layers"] * g["max_context"]
+             * H * D * kv_itemsize)):
         print(json.dumps({
             "probe": "serving_phase_table", "phase": phase,
             "kv_bytes_per_token_at_max_context": per_tok,

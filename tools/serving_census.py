@@ -18,6 +18,21 @@ them while the numeric half waits for a chip:
   grad is ever traced on the serving path), and the same no-[T, T]
   fact at the XLA level (scores live in kernel tiles).
 
+Round 14 (ISSUE 13) adds the scale-out configs:
+
+* **prefix_prefill**: the prefix-HIT suffix prefill reads the shared
+  prefix through the block table — one gather per pool per layer, one
+  offset scatter per pool per layer — and runs ZERO flash kernels over
+  shared pages (zero Pallas kernels at all: the suffix-by-context
+  softmax is the saving the hit buys) and no [T, T] score dot (scores
+  are suffix-bucket × context, one T-sized dim).
+* **disagg_decode_slice**: the ONLY compute program the decode slice
+  runs between transfers is the decode step — zero prefill (flash)
+  kernels on the decode slice, pinned against the decode trace.
+* **transfer_insert**: the slice-to-slice page ship lands with ONE
+  full-pool scatter (drop-fenced padding rows), no gathers, no
+  kernels — shipping is data movement, never recompute.
+
 The prefill trace forces ``CHAINERMN_TPU_FLASH_INTERPRET=1`` so the CPU
 census sees the same Pallas lowering a TPU run compiles.  ``--write-
 budgets`` regenerates the structure/geometry halves (trace properties —
@@ -45,10 +60,16 @@ BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: n_vocab = 128), so the full-T detector — "a dot output with TWO dims
 #: >= T" — can only fire on a genuine [T, T] score matrix, never on a
 #: [B·T, features] GEMM.
+#: round-14 additions: prefix_start/prefix_suffix_T shape the suffix
+#: prefill trace (a 128-token page-aligned hit + a 32-token suffix
+#: bucket — suffix strictly below the full-T threshold, so the no-[T,T]
+#: detector stays sound for the suffix-by-context score), and
+#: transfer_pages sizes the disaggregation ship's page block.
 GEOMETRY = {
     "n_vocab": 128, "d_model": 48, "n_heads": 2, "n_layers": 2,
     "max_len": 256, "page_size": 16, "num_pages": 32,
     "max_context": 256, "prefill_T": 256, "decode_B": 4,
+    "prefix_start": 128, "prefix_suffix_T": 32, "transfer_pages": 8,
 }
 
 
@@ -197,9 +218,70 @@ def prefill_census():
     return _census_facts(jaxpr.jaxpr, pool_shape, g["prefill_T"])
 
 
+def prefix_prefill_census():
+    """Facts of the prefix-HIT suffix-prefill program at the committed
+    geometry: a ``prefix_start``-token shared prefix read back through
+    the block table + a ``prefix_suffix_T`` suffix.  The headline fact
+    is ``flash_fwd_kernels == 0`` — a prefix hit never reruns a flash
+    kernel over shared pages."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving import prefix_prefill_program
+
+    model, state, (k_pool, v_pool), N, rng = _vertical()
+    g = GEOMETRY
+    T = g["prefix_suffix_T"]
+    tokens = jnp.zeros((1, T), jnp.int32)
+    bt_row = jnp.zeros(N, jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda s, k, v, t, tl, st, b: prefix_prefill_program(
+            model, s, k, v, t, tl, st, b))(
+        state, k_pool, v_pool, tokens, jnp.int32(T),
+        jnp.int32(g["prefix_start"]), bt_row)
+    pool_shape = tuple(k_pool.shape[1:])
+    return _census_facts(jaxpr.jaxpr, pool_shape, g["max_context"])
+
+
+def disagg_decode_slice_census():
+    """Facts of the decode slice's step program on the disaggregated
+    split.  The decode slice runs ONLY the decode step (plus the
+    data-movement insert, censused separately): the committed fact is
+    zero prefill kernels — ``flash_fwd_kernels == 0`` — so a refactor
+    cannot quietly move FLOP-bound prefill work onto the HBM-bound
+    slice."""
+    return decode_census("paged")
+
+
+def transfer_insert_census():
+    """Facts of the disaggregation ship's receiving scatter: one
+    drop-fenced full-pool scatter, zero gathers, zero kernels — the
+    transfer is data movement, never recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving import insert_pages
+
+    g = GEOMETRY
+    L, P, S = g["n_layers"], g["num_pages"], g["page_size"]
+    H, D = g["n_heads"], g["d_model"] // g["n_heads"]
+    nb = g["transfer_pages"]
+    pool = jnp.zeros((L, P, S, H, D), jnp.float32)
+    block = jnp.zeros((L, nb, S, H, D), jnp.float32)
+    rows = jnp.zeros(nb, jnp.int32)
+    jaxpr = jax.make_jaxpr(insert_pages)(pool, block, rows)
+    # attribute by the FULL pool shape: the insert scatters all layers
+    # at once (one scatter per pool per transfer, not per layer)
+    return _census_facts(jaxpr.jaxpr, tuple(pool.shape),
+                         g["max_context"])
+
+
 def structure():
     return {"decode": decode_census("paged"),
-            "prefill": prefill_census()}
+            "prefill": prefill_census(),
+            "prefix_prefill": prefix_prefill_census(),
+            "disagg_decode_slice": disagg_decode_slice_census(),
+            "transfer_insert": transfer_insert_census()}
 
 
 def write_budgets():

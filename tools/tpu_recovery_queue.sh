@@ -43,6 +43,15 @@
 #     this is the structural A/B; the real slow-fabric payoff needs a
 #     >=2-host pod).
 #
+#  7. serving scale-out A/Bs (ISSUE 13): the three serving rows below —
+#     prefix-cache OFF vs the (prefix-on) flagship serving row, the
+#     disaggregated prefill/decode split vs the single-mesh hatch, and
+#     tp=2 paged decode vs single-chip.  Record the tokens/sec + p99 +
+#     prefix_hit_rate / effective_capacity_x / transferred_page_bytes
+#     deltas in BENCH_NOTES; the flagship serving row's numbers (now
+#     chat-shaped, prefix on) stamp tools/serving_budgets.json targets
+#     as in item 4.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
@@ -206,6 +215,23 @@ run_one "serving engine open-loop qps16 x4 tenants (flagship serving)" \
 run_one "serving engine qps64 x8 tenants (saturation/preemption probe)" \
   BENCH_MODEL=serving BENCH_SERVE_QPS=64 BENCH_SERVE_TENANTS=8 \
   BENCH_DEADLINE_S=900
+# ISSUE 13: the serving scale-out A/Bs.  (a) prefix cache OFF vs the
+# chat-shaped flagship serving row above = the copy-on-write sharing
+# payoff (tokens/sec + p99 + the pool pressure the hit rate removes);
+# (b) disaggregated prefill/decode vs the single-mesh hatch = what
+# moving FLOP-bound prefills off the decode slice buys at qps64 (the
+# saturation shape, where prefill stalls show in p99) plus the
+# transferred_page_bytes wire cost; (c) tp=2 paged decode vs the
+# single-chip row = the head-sharded pool read's scaling (each shard
+# reads half the cache bytes).  All serving rows are metric-fenced out
+# of the flagship cache by construction.
+run_one "serving prefix-cache OFF (A/B: prefix sharing payoff)" \
+  BENCH_MODEL=serving BENCH_SERVE_PREFIX=0 BENCH_DEADLINE_S=900
+run_one "serving disaggregated prefill/decode qps64 (A/B vs single-mesh)" \
+  BENCH_MODEL=serving BENCH_SERVE_DISAGG=1 BENCH_SERVE_QPS=64 \
+  BENCH_DEADLINE_S=900
+run_one "serving tp=2 paged decode (A/B vs single-chip)" \
+  BENCH_MODEL=serving BENCH_SERVE_TP=2 BENCH_DEADLINE_S=900
 # ISSUE 12: the MoE dispatch A/B — the Switch-FFN expert-parallel
 # vertical under the flat single-axis dispatch, the two-stage ici×dcn
 # dispatch on the forced 2x4 split, and the two-stage dispatch with
