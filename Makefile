@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos chaos-elastic bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving sweep-flash audit dryrun examples clean
+.PHONY: test chaos chaos-elastic bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -104,6 +104,14 @@ probe-serving:    ## committed serving budgets + live decode/prefill census + pe
 	@# gate tests/test_serving_budget.py's data) and the decode
 	@# roofline byte table.
 	PROBE=serving PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
+
+probe-obs:        ## runtime observability join: trace schema + merged metrics registry (no chip)
+	@# runs a tiny seeded trainer + one serving request with the span
+	@# tracer on (CHAINERMN_TPU_TRACE=events), validates the exported
+	@# Chrome-trace shard against the committed schema, round-trips it
+	@# through tools/trace_merge.py, and renders the rank-merged
+	@# metrics registry in Prometheus text format (docs/observability.md).
+	PROBE=obs PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
 probe-comm:       ## committed gradient-exchange budgets + live per-bucket/per-hop tables (no chip)
 	@# jaxpr collective census per exchange config (per_leaf / flat /

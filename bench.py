@@ -386,7 +386,7 @@ _DEFAULT_FINGERPRINTS = {
                  "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
                  "stripe_ratio": 0,
                  "grad_dtype": "bfloat16", "error_feedback": True,
-                 "preempt_rank": -1},
+                 "preempt_rank": -1, "trace": "off"},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -397,7 +397,7 @@ _DEFAULT_FINGERPRINTS = {
                     "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
                     "stripe_ratio": 0,
                     "grad_dtype": "bfloat16", "error_feedback": True,
-                    "preempt_rank": -1},
+                    "preempt_rank": -1, "trace": "off"},
 }
 
 def _env_float(name, default):
@@ -479,6 +479,10 @@ def _config_fingerprint(model=None):
             # the elastic A/B (preempt-and-rejoin, ISSUE 10) measures a
             # resizing world — never flagship data (-1 = no preemption)
             "preempt_rank": _env_int("BENCH_PREEMPT_RANK", -1),
+            # span tracing (ISSUE 14): a traced run pays the recording
+            # overhead — its numbers stamp the overhead DELTA (recovery
+            # queue), never the flagship datum
+            "trace": os.environ.get("CHAINERMN_TPU_TRACE", "off"),
         }
     return {
         "model": "resnet50",
@@ -499,6 +503,7 @@ def _config_fingerprint(model=None):
         "error_feedback":
             os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
         "preempt_rank": _env_int("BENCH_PREEMPT_RANK", -1),
+        "trace": os.environ.get("CHAINERMN_TPU_TRACE", "off"),
     }
 
 
@@ -1672,7 +1677,11 @@ def _run_bench_serving():
     measured window), p50/p99 PER-TOKEN latency (first token: arrival →
     production, includes queueing + prefill; later tokens: gap since
     the previous token of the same request, includes preemption
-    stalls), and page-pool occupancy (mean/max over decode steps).
+    stalls), p50/p99 QUEUE WAIT (the sum of the request's
+    per-admission waits — arrival → first admission plus each
+    eviction-requeue → re-admission dwell; the pure scheduling share
+    of its latency, ISSUE 14), and page-pool occupancy (mean/max over
+    decode steps).
 
     Round 14: the load is CHAT-SHAPED — every tenant re-sends a fixed
     ``BENCH_SERVE_PREFIX``-token system prompt ahead of a random tail —
@@ -1815,6 +1824,15 @@ def _run_bench_serving():
         lat.append(req.token_times[0] - req.arrival_time)
         lat.extend(np.diff(req.token_times))
     lat = np.asarray(lat) if lat else np.asarray([0.0])
+    # scheduler health (ISSUE 14 satellite): queue wait = the SUM of
+    # the request's per-admission waits (arrival -> first admission,
+    # plus eviction-requeue -> re-admission) — the pure scheduling
+    # share of its life, decode time excluded.  The same per-admission
+    # values the observability histogram buckets when tracing is on;
+    # the bench reports them exactly (per-request sums, not bucket
+    # bounds), trace on or off.
+    qwait = np.asarray([r.queue_wait_s for r in engine.completed
+                        if r.admit_time is not None] or [0.0])
     # token_times, not tokens: an evicted request's generated tokens
     # fold into its prompt (recompute on re-admit) but each kept its
     # one production timestamp — len(tokens) would deflate tokens/sec
@@ -1833,6 +1851,10 @@ def _run_bench_serving():
                                       2),
         "p99_token_latency_ms": round(float(np.percentile(lat, 99)) * 1e3,
                                       2),
+        "p50_queue_wait_ms": round(float(np.percentile(qwait, 50)) * 1e3,
+                                   2),
+        "p99_queue_wait_ms": round(float(np.percentile(qwait, 99)) * 1e3,
+                                   2),
         "page_occupancy_mean": round(float(np.mean(occ)), 3) if occ
         else 0.0,
         "page_occupancy_max": round(float(np.max(occ)), 3) if occ

@@ -32,6 +32,7 @@ from . import models
 from . import parallel
 from . import ops
 from . import serving
+from . import observability
 from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
 from . import extensions

@@ -124,6 +124,12 @@ class _MultiNodeCheckpointer(Extension):
         torn file that could win the consensus vote (``_scan`` refuses
         unverifiable files).
         """
+        from .. import observability
+        with observability.span("train/checkpoint_serialize",
+                                tags={"iteration": int(iteration)}):
+            return self._save_impl(trainer, iteration)
+
+    def _save_impl(self, trainer, iteration):
         start = time.time()
         out = self._dir(trainer)
         os.makedirs(out, exist_ok=True)
@@ -207,6 +213,11 @@ class _MultiNodeCheckpointer(Extension):
         the newest intact common generation.  The resumed iteration is
         then pinned against GC (see ``_gc``).
         """
+        from .. import observability
+        with observability.span("recover/consensus_load"):
+            return self._maybe_load_impl(trainer, optimizer, path)
+
+    def _maybe_load_impl(self, trainer, optimizer=None, path=None):
         out = path or self._dir(trainer)
         local = self._scan(out)
         all_sets = self.comm.allgather_obj(sorted(local))

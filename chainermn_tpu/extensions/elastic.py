@@ -65,6 +65,7 @@ import time
 
 import numpy as np
 
+from .. import observability
 from ..communicators._host_channel import ChannelError
 from ..communicators._membership import ElasticMembership
 from ..communicators.fault_schedule import InjectedFault, RankPreempted
@@ -287,7 +288,15 @@ class ElasticRecovery(FailureRecovery):
         self._log(f"recovering from {type(exc).__name__}: {exc} "
                   f"(attempt {self.stats['recoveries']}"
                   f"/{self.max_recoveries})")
-        self._quiesce_transport()
+        # the elastic timeline's first mark: detection is the moment
+        # the typed failure reached the supervisor (the time between
+        # the wire fault and here is the detection timeout the chaos
+        # gate budgets)
+        observability.instant("elastic/preempt_detect",
+                              tags={"exc": type(exc).__name__,
+                                    "rank": getattr(exc, "rank", None)})
+        with observability.span("recover/quiesce"):
+            self._quiesce_transport()
         suspects = set()
         rank = getattr(exc, "rank", None)
         if rank is not None and not isinstance(exc, InjectedFault):
@@ -320,6 +329,9 @@ class ElasticRecovery(FailureRecovery):
         resolve — the world would never actually change size."""
         epoch_at_leave = self.membership.current_epoch()
         self.membership.announce_leave(note=str(exc))
+        observability.instant("elastic/preempt_detect",
+                              tags={"exc": type(exc).__name__,
+                                    "self_preempted": True})
         self._log(f"preempted ({exc}); leave announced")
         if self.rejoin_after_s is None:
             raise exc  # hard exit: the scheduler owns the restart
@@ -345,10 +357,13 @@ class ElasticRecovery(FailureRecovery):
             # require= the survivors: a joiner must never settle a
             # world by itself (a resolve that cannot reach them times
             # out typed)
-            view = self.membership.resolve(
-                expect=set(prev.members) | {self.stable_rank},
-                require=set(prev.members) - {self.stable_rank},
-                timeout_ms=self.resolve_timeout_ms)
+            with observability.span("elastic/resolve",
+                                    tags={"rejoin": True,
+                                          "attempt": attempt + 1}):
+                view = self.membership.resolve(
+                    expect=set(prev.members) | {self.stable_rank},
+                    require=set(prev.members) - {self.stable_rank},
+                    timeout_ms=self.resolve_timeout_ms)
             if self.stable_rank in view:
                 break
         if self.stable_rank not in view:
@@ -361,8 +376,10 @@ class ElasticRecovery(FailureRecovery):
         grow ride this; the joiner enters at :meth:`_adopt` after its
         own resolve returns the same view)."""
         prev = self.view
-        view = self.membership.resolve(
-            expect=expect, timeout_ms=self.resolve_timeout_ms)
+        with observability.span("elastic/resolve",
+                                tags={"expect": sorted(expect)}):
+            view = self.membership.resolve(
+                expect=expect, timeout_ms=self.resolve_timeout_ms)
         if self.stable_rank not in view:
             # the split-brain escape: we were too slow and the leader
             # settled without us — re-enter as a joiner rather than
@@ -373,10 +390,12 @@ class ElasticRecovery(FailureRecovery):
                     "excluded from the decided membership view",
                     membership=view)
             self.membership.announce_join(note="excluded, re-joining")
-            view = self.membership.resolve(
-                expect=set(view.members) | {self.stable_rank},
-                require=set(view.members) - {self.stable_rank},
-                timeout_ms=self.resolve_timeout_ms)
+            with observability.span("elastic/resolve",
+                                    tags={"rejoin": True}):
+                view = self.membership.resolve(
+                    expect=set(view.members) | {self.stable_rank},
+                    require=set(view.members) - {self.stable_rank},
+                    timeout_ms=self.resolve_timeout_ms)
             if self.stable_rank not in view:
                 raise RecoveryGivingUp(
                     "re-join after exclusion was not admitted",
@@ -395,22 +414,29 @@ class ElasticRecovery(FailureRecovery):
         joined = [r for r in view.members if r not in prev_view]
         self.last_view = view
         self.view = view
-        new_comm = (self.comm_factory(view) if self.comm_factory
-                    is not None else self._default_factory(view))
-        self._check_batch(trainer, new_comm)
-        self._swap_communicator(trainer, new_comm)
+        with observability.span("elastic/rebuild",
+                                tags={"epoch": view.epoch,
+                                      "members": list(view.members),
+                                      "lost": lost, "joined": joined}):
+            new_comm = (self.comm_factory(view) if self.comm_factory
+                        is not None else self._default_factory(view))
+            self._check_batch(trainer, new_comm)
+            self._swap_communicator(trainer, new_comm)
         self.stats["ranks_lost"] += len(lost)
         self.stats["ranks_joined"] += len(joined)
         if view.size != prev_view.size:
             self.stats["resizes"] += 1
+        self._publish_stats()
         self._log(f"world e{view.epoch}: members {list(view.members)} "
                   f"(lost {lost}, joined {joined}, size {prev_view.size}"
                   f"->{view.size})")
         resumed = None
         if self.checkpointer is not None:
-            if joined:
-                self._sync_snapshot_to_joiners(trainer, joined)
-            resumed = self.checkpointer.maybe_load(trainer)
+            with observability.span("elastic/snapshot_sync",
+                                    tags={"joined": joined}):
+                if joined:
+                    self._sync_snapshot_to_joiners(trainer, joined)
+                resumed = self.checkpointer.maybe_load(trainer)
         elif joined:
             raise ElasticConfigError(
                 "growing the world needs a checkpointer: the joiner's "

@@ -35,6 +35,7 @@ from __future__ import annotations
 import sys
 import time
 
+from .. import observability
 from ..communicators._host_channel import ChannelError, PeerLostError
 from ..communicators.fault_schedule import InjectedFault
 from ..training.trainer import Extension, PRIORITY_READER
@@ -166,19 +167,26 @@ class FailureRecovery(Extension):
             print(f"chainermn_tpu: recovering from {type(exc).__name__}: "
                   f"{exc} (attempt {self.stats['recoveries']}"
                   f"/{self.max_recoveries})", file=sys.stderr)
+        observability.instant("recover/detect",
+                              tags={"exc": type(exc).__name__})
         if self.cooldown_s:
             self._sleep(self.cooldown_s)
-        self._quiesce_transport()
+        with observability.span("recover/quiesce"):
+            self._quiesce_transport()
         resumed = None
         if self.checkpointer is not None:
+            # checkpointer.maybe_load carries its own
+            # "recover/consensus_load" span
             resumed = self.checkpointer.maybe_load(trainer)
         if self.rebuild is not None:
-            new_comm = self.rebuild(trainer, exc)
+            with observability.span("recover/rebuild"):
+                new_comm = self.rebuild(trainer, exc)
             if new_comm is not None:
                 self.comm = new_comm
                 if self.checkpointer is not None:
                     self.checkpointer.comm = new_comm
         self.stats["resumed_iterations"].append(resumed)
+        self._publish_stats()
         if self.verbose:
             print(f"chainermn_tpu: consensus resume -> iteration "
                   f"{resumed if resumed is not None else '(fresh state)'}",
@@ -186,6 +194,22 @@ class FailureRecovery(Extension):
         if self.on_recover is not None:
             self.on_recover(trainer, exc, resumed)
         return resumed
+
+    def _publish_stats(self):
+        """Fold :attr:`stats` into the observability registry (ISSUE
+        14): the supervisor's lifetime telemetry — recoveries,
+        generation bumps, and the elastic resize/rank-churn counts —
+        become gauges a ``PROBE=obs`` render (or a real scraper) reads
+        next to the subsystem counters.  No-op when observability is
+        off."""
+        if not observability.enabled():
+            return
+        reg = observability.registry()
+        for key in ("recoveries", "generation_bumps", "resizes",
+                    "ranks_lost", "ranks_joined"):
+            reg.gauge(f"chainermn_tpu_recovery_{key}",
+                      help="FailureRecovery.stats['%s']" % key).set(
+                self.stats[key])
 
     def _quiesce_transport(self):
         """Clear a posted abort flag and rotate the host channel's key

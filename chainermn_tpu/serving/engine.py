@@ -73,6 +73,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability
 from ..core.link import bind_state, extract_state
 from ..nn import functions as F
 from ..ops import attention as flash_attention_op
@@ -452,6 +453,58 @@ class ServingEngine:
         row[:len(table)] = table
         return row
 
+    # -- observability (ISSUE 14) -------------------------------------------
+
+    @staticmethod
+    def _req_tid(req):
+        """Synthetic per-request trace track: request lifecycle spans
+        (queue wait → prefill → finish) overlap OTHER requests' spans
+        in time, so they cannot share one thread's B/E stack — each
+        request gets its own Chrome ``tid`` lane (the merged trace then
+        shows one swimlane per request under the engine's rank).
+
+        Request ids are caller-supplied and only ever used as dict keys
+        elsewhere, so non-integer ids are legal — they map onto a
+        deterministic crc32 lane (PYTHONHASHSEED-independent)."""
+        rid = req.request_id
+        if isinstance(rid, int):
+            return 1 + rid
+        import zlib
+        return 1 + (zlib.crc32(str(rid).encode()) & 0x7FFFFFFF)
+
+    def _obs_admitted(self, req, wait_s, readmit):
+        """Queue-wait attribution at admission: a retroactive span on
+        the request's lane (duration measured on the ENGINE clock —
+        exact; absolute placement is the tracer's) plus the per-tenant
+        queue-wait histogram the scheduler-health satellite commits.
+
+        A RE-admission (evicted request re-entering) measures from the
+        EVICTION'S requeue stamp, not the original arrival — the
+        original window was already spanned (re-measuring from arrival
+        would overlap it on the lane) and the prior RUNNING period is
+        decode time, not queue wait."""
+        tags = {"tenant": req.tenant, "request": req.request_id,
+                "prompt": int(req.prompt.size)}
+        if readmit:
+            tags["readmit"] = True
+        observability.tracer().complete("serve/queue_wait", wait_s,
+                                        tags=tags,
+                                        tid=self._req_tid(req))
+        observability.registry().histogram(
+            "chainermn_tpu_serving_queue_wait_ms",
+            help="admission queue wait per request (ms)").observe(
+            wait_s * 1e3, tenant=req.tenant)
+
+    def _obs_queue_depths(self):
+        queues = getattr(self.scheduler, "_queues", None)
+        if queues is None:   # a custom scheduler without tenant queues
+            return
+        gauge = observability.registry().gauge(
+            "chainermn_tpu_serving_queue_depth",
+            help="pending requests per tenant at the last decode step")
+        for tenant in list(queues):
+            gauge.set(self.scheduler.pending(tenant), tenant=tenant)
+
     def _record_token(self, req, tok, now):
         req.tokens.append(int(tok))
         req.token_times.append(now)
@@ -469,15 +522,33 @@ class ServingEngine:
         self.running.remove(req)
         req.finish_time = now
         self.completed.append(req)
+        if observability.enabled():
+            observability.instant("serve/finish",
+                                  tags={"tenant": req.tenant,
+                                        "request": req.request_id,
+                                        "tokens": len(req.tokens)},
+                                  tid=self._req_tid(req))
 
-    def _evict(self, req):
+    def _evict(self, req, now=None):
         """Preemption: free pages (refcount-aware — shared pages stay
         alive through their other holders), fold generated tokens into
-        the prompt, re-queue front-of-line (recompute on re-admit)."""
+        the prompt, re-queue front-of-line (recompute on re-admit).
+        ``now`` stamps the requeue instant so the re-admission's queue
+        wait measures the re-queue dwell, not the running period."""
         self.allocator.free(req.request_id)
         self.running.remove(req)
+        req.requeue_time = now
         self.scheduler.requeue_front(req)
         self.evictions += 1
+        if observability.enabled():
+            observability.instant("serve/evict",
+                                  tags={"tenant": req.tenant,
+                                        "request": req.request_id},
+                                  tid=self._req_tid(req))
+            observability.registry().counter(
+                "chainermn_tpu_serving_evictions_total",
+                help="running sequences preempted for pool pages").inc(
+                1, tenant=req.tenant)
 
     def _run_fork(self, src, dst):
         """Copy-on-write page copy, in-graph (traced indices: every
@@ -486,6 +557,12 @@ class ServingEngine:
             self.kv.k_pool, self.kv.v_pool, jnp.int32(src),
             jnp.int32(dst))
         self.forks += 1
+        if observability.enabled():
+            observability.instant("serve/fork",
+                                  tags={"src": int(src), "dst": int(dst)})
+            observability.registry().counter(
+                "chainermn_tpu_serving_forks_total",
+                help="copy-on-write page forks").inc(1)
 
     def _run_prefix_prefill(self, req, L, matched):
         """Prefix HIT: prefill only the unmatched suffix, against the
@@ -528,9 +605,19 @@ class ServingEngine:
             req.request_id)[:n_pages]
         self.kv.k_pool, self.kv.v_pool = self._insert_fn(
             self.kv.k_pool, self.kv.v_pool, kb, vb, jnp.asarray(rows))
-        self.transferred_page_bytes += \
-            nb * self.kv.n_layers * self.kv.page_bytes
+        shipped = nb * self.kv.n_layers * self.kv.page_bytes
+        self.transferred_page_bytes += shipped
         self.transfers += 1
+        if observability.enabled():
+            observability.instant("serve/page_transfer",
+                                  tags={"request": req.request_id,
+                                        "pages": int(nb),
+                                        "bytes": int(shipped)},
+                                  tid=self._req_tid(req))
+            observability.registry().counter(
+                "chainermn_tpu_serving_transferred_page_bytes_total",
+                help="KV page bytes shipped prefill slice -> decode "
+                     "pool").inc(shipped)
         return logits
 
     def _admit(self, req, clock):
@@ -546,6 +633,7 @@ class ServingEngine:
         page (copy-on-write) before the suffix's first write."""
         L = int(req.prompt.size)
         sid = req.request_id
+        t_admit = clock()
         matched = 0
         prompt_t = tuple(int(t) for t in req.prompt) \
             if self.prefix_cache else ()
@@ -570,22 +658,54 @@ class ServingEngine:
                     self._run_fork(old, new)
         if not matched:
             self.allocator.ensure(sid, L + 1)
+        # queue-wait accounting (always — the bench reads it trace-off):
+        # this admission's wait is arrival → now, or requeue → now after
+        # an eviction (the prior RUNNING period is decode time, not
+        # queue wait); the request accumulates the sum over admissions
+        readmit = req.requeue_time is not None   # stamped by _evict
+        wait_s = max(0.0, t_admit - (req.requeue_time if readmit
+                                     else req.arrival_time))
+        req.queue_wait_s += wait_s
+        # lazy tag construction: the conditional expressions below keep
+        # the trace-off path free of per-admission dict/lane-id work
+        # (the module's near-zero-cost-off contract)
+        obs_on = observability.enabled()
+        rtid = self._req_tid(req) if obs_on else None
+        if obs_on:
+            self._obs_admitted(req, wait_s, readmit)
         if matched:
-            logits = self._run_prefix_prefill(req, L, matched)
+            with observability.span(
+                    "serve/suffix_prefill",
+                    tags={"request": sid, "matched": matched,
+                          "suffix": L - matched} if obs_on else None,
+                    tid=rtid):
+                logits = self._run_prefix_prefill(req, L, matched)
             self.prefix_hits += 1
             self.prefix_tokens_matched += matched
         elif self.disagg:
-            logits = self._run_disagg_prefill(req, L)
+            with observability.span(
+                    "serve/prefill",
+                    tags={"request": sid, "prompt": L,
+                          "disagg": True} if obs_on else None,
+                    tid=rtid):
+                logits = self._run_disagg_prefill(req, L)
         else:
-            Tb = _bucket(L, self.prefill_buckets, "prompt length")
-            tokens = np.zeros((1, Tb), dtype=np.int32)
-            tokens[0, :L] = req.prompt
-            k_pool, v_pool, logits = self._prefill_fn(
-                self.state, self.kv.k_pool, self.kv.v_pool,
-                jnp.asarray(tokens), np.int32(L),
-                jnp.asarray(self._bt_row(sid)))
-            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            with observability.span(
+                    "serve/prefill",
+                    tags={"request": sid,
+                          "prompt": L} if obs_on else None,
+                    tid=rtid):
+                Tb = _bucket(L, self.prefill_buckets, "prompt length")
+                tokens = np.zeros((1, Tb), dtype=np.int32)
+                tokens[0, :L] = req.prompt
+                k_pool, v_pool, logits = self._prefill_fn(
+                    self.state, self.kv.k_pool, self.kv.v_pool,
+                    jnp.asarray(tokens), np.int32(L),
+                    jnp.asarray(self._bt_row(sid)))
+                self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
         self.admissions += 1
+        req.admit_time = t_admit
+        req.requeue_time = None   # consumed: next eviction re-stamps
         if self.prefix_cache:
             self.allocator.register_prefix(sid, prompt_t)
         tok = int(np.asarray(jnp.argmax(logits)))
@@ -691,7 +811,7 @@ class ServingEngine:
                 # prefix-sharing livelock guard)
                 victim = self.scheduler.pick_victim(self.running,
                                                     self.allocator)
-                self._evict(victim)
+                self._evict(victim, clock())
                 # victim may be req: the slot under scrutiny vanished —
                 # re-check the same index (now the next request)
         # admission at decode-step granularity, into the pages left
@@ -714,23 +834,29 @@ class ServingEngine:
         stats["occupancy"] = (self.allocator.used_pages
                               / self.allocator.num_pages)
         stats["capacity_x"] = self.capacity_multiplier()
+        if observability.enabled():
+            self._obs_queue_depths()
         if n == 0:
             stats["decoded"] = 0
             return stats
-        Bb = _bucket(n, self.batch_buckets, "batch")
-        toks = np.zeros(Bb, dtype=np.int32)
-        pos = np.full(Bb, -1, dtype=np.int32)
-        bts = np.zeros((Bb, self.n_block_entries), dtype=np.int32)
-        for j, req in enumerate(self.running):
-            toks[j] = req.tokens[-1]
-            pos[j] = req._ctx
-            bts[j] = self._bt_row(req.request_id)
-        k_pool, v_pool, _logits, nxt = self._decode_fn(
-            self.state, self.kv.k_pool, self.kv.v_pool,
-            jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(bts))
-        self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
-        nxt = np.asarray(nxt)   # device->host sync: the step really ran
-        self.decode_steps += 1
+        with observability.span(
+                "serve/decode_window",
+                tags={"batch": n, "step": self.decode_steps}
+                if observability.enabled() else None):
+            Bb = _bucket(n, self.batch_buckets, "batch")
+            toks = np.zeros(Bb, dtype=np.int32)
+            pos = np.full(Bb, -1, dtype=np.int32)
+            bts = np.zeros((Bb, self.n_block_entries), dtype=np.int32)
+            for j, req in enumerate(self.running):
+                toks[j] = req.tokens[-1]
+                pos[j] = req._ctx
+                bts[j] = self._bt_row(req.request_id)
+            k_pool, v_pool, _logits, nxt = self._decode_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(bts))
+            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            nxt = np.asarray(nxt)   # device->host sync: the decode
+            self.decode_steps += 1  # window span times the real step
         t_tok = clock()
         for j, req in enumerate(list(self.running)):
             req._ctx += 1

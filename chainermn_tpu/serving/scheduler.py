@@ -72,6 +72,16 @@ class Request:
         self.preemptions = 0
         self.first_token_time = None
         self.finish_time = None
+        # queue-wait accounting (ISSUE 14): admit_time = engine clock
+        # at the last admission; requeue_time = engine clock at the
+        # last EVICTION (so a re-admission measures the true re-queue
+        # dwell, never the prior running period); queue_wait_s = the
+        # SUM of per-admission waits — the pure scheduling share of the
+        # request's life, what the serving bench's p50/p99 queue wait
+        # and the observability histogram both derive from
+        self.admit_time = None
+        self.requeue_time = None
+        self.queue_wait_s = 0.0
 
     @property
     def total_len(self):

@@ -195,12 +195,49 @@ class Trainer:
                         traceback.print_exc()
                     raise
         finally:
+            # exception-isolated: one extension's failing finalize must
+            # not starve the others' cleanup (a Profile extension mid-
+            # trace-window would leak an open jax.profiler trace —
+            # ISSUE 14 satellite, pinned by regression test).  The
+            # first finalize failure is re-raised after every finalizer
+            # (and the updater's) has run — unless the loop itself is
+            # already unwinding with an exception, which must win.
+            finalize_exc = None
             for entry in extensions:
                 finalize = getattr(entry.extension, "finalize", None)
                 if finalize:
-                    finalize()
-            self.updater.finalize()
+                    try:
+                        finalize()
+                    except BaseException as e:  # noqa: BLE001
+                        print(f"Exception in finalize of extension "
+                              f"{entry.name}: {e}", file=sys.stderr)
+                        if finalize_exc is None:
+                            finalize_exc = e
+            # the updater's finalize rides the same isolation: its
+            # failure must not swallow a captured extension-finalize
+            # exception, nor skip the trace export below
+            try:
+                self.updater.finalize()
+            except BaseException as e:  # noqa: BLE001
+                print(f"Exception in updater.finalize: {e}",
+                      file=sys.stderr)
+                if finalize_exc is None:
+                    finalize_exc = e
             self._done = True
+            # observability (ISSUE 14): with tracing on, every run
+            # leaves its rank's Chrome-trace shard next to its outputs
+            # (merge shards with tools/trace_merge.py).  Off = the
+            # default: no file, no cost.
+            from .. import observability
+            if observability.enabled():
+                try:
+                    tr = observability.tracer()
+                    tr.export(os.path.join(
+                        self.out, f"trace-rank{tr.rank}.jsonl"))
+                except Exception as e:  # noqa: BLE001 — never mask
+                    print(f"trace export failed: {e}", file=sys.stderr)
+            if finalize_exc is not None and sys.exc_info()[0] is None:
+                raise finalize_exc
 
     def serialize(self, serializer):
         self.updater.serialize(serializer["updater"])

@@ -8,6 +8,9 @@ whole compute step is inside ``Optimizer.update``'s jitted program.
 
 from __future__ import annotations
 
+import time
+
+from .. import observability
 from ..dataset.convert import concat_examples
 
 __all__ = ["Updater", "StandardUpdater", "FusedUpdater"]
@@ -82,12 +85,13 @@ class StandardUpdater(Updater):
         batch = self._next_reporting_stall(iterator)
         in_arrays = self.converter(batch, self.device)
         loss_func = self.loss_func or optimizer.target
-        if isinstance(in_arrays, tuple):
-            optimizer.update(loss_func, *in_arrays)
-        elif isinstance(in_arrays, dict):
-            optimizer.update(loss_func, **in_arrays)
-        else:
-            optimizer.update(loss_func, in_arrays)
+        with observability.span("train/optimizer_update"):
+            if isinstance(in_arrays, tuple):
+                optimizer.update(loss_func, *in_arrays)
+            elif isinstance(in_arrays, dict):
+                optimizer.update(loss_func, **in_arrays)
+            else:
+                optimizer.update(loss_func, in_arrays)
         if self.is_new_epoch:
             optimizer.new_epoch()
 
@@ -102,12 +106,45 @@ class StandardUpdater(Updater):
                     iterator.input_stall_ms - stall_before})
 
     @classmethod
+    def _record_stall_metric(cls, iterator, stall_before, t0):
+        """ONE home for the universal input-stall counter semantics
+        (ISSUE 14 satellite; both updater paths call this): accounted
+        stall where the iterator measures it
+        (``DevicePrefetchIterator.input_stall_ms`` — blocked-on-feed
+        time, overlap subtracted), the pull's wall time where it does
+        not (for a non-prefetching iterator the consumer is blocked
+        for exactly that long) — labeled by iterator kind and updater
+        path, pinned by the contract test."""
+        stall_ms = (iterator.input_stall_ms - stall_before
+                    if stall_before is not None
+                    else (time.monotonic() - t0) * 1e3)
+        observability.registry().counter(
+            "chainermn_tpu_input_stall_ms_total",
+            help="cumulative input-feed stall (ms) by iterator kind "
+                 "and updater path").inc(
+            stall_ms, iterator=type(iterator).__name__,
+            updater=cls.__name__)
+
+    @classmethod
     def _next_reporting_stall(cls, iterator):
-        """``iterator.next()`` with the stall delta reported, when the
-        iterator accounts it (``DevicePrefetchIterator.input_stall_ms``)."""
+        """``iterator.next()`` with the stall delta reported.
+
+        Observation reporting keeps the original contract — only an
+        iterator that ACCOUNTS its own stall reports into the
+        per-iteration observation.  The observability counter
+        (:meth:`_record_stall_metric`) is universal."""
         stall_before = getattr(iterator, "input_stall_ms", None)
-        batch = iterator.next()
+        if not observability.enabled():
+            batch = iterator.next()
+            cls._report_stall_delta(iterator, stall_before)
+            return batch
+        t0 = time.monotonic()
+        with observability.span(
+                "train/input_stall",
+                tags={"iterator": type(iterator).__name__}):
+            batch = iterator.next()
         cls._report_stall_delta(iterator, stall_before)
+        cls._record_stall_metric(iterator, stall_before, t0)
         return batch
 
     def finalize(self):
@@ -167,20 +204,37 @@ class FusedUpdater(StandardUpdater):
         # one stall observation across all K pulls (per-pull reports
         # would overwrite each other inside a single observation)
         stall_before = getattr(iterator, "input_stall_ms", None)
-        batches = [self.converter(iterator.next(), self.device)
-                   for _ in range(self.n_fused)]
+        # lazy tags (the near-zero-cost-off contract — same pattern as
+        # _next_reporting_stall and the serving engine)
+        obs_on = observability.enabled()
+        t0 = time.monotonic() if obs_on else 0.0
+        with observability.span(
+                "train/input_stall",
+                tags={"iterator": type(iterator).__name__,
+                      "n_fused": self.n_fused} if obs_on else None):
+            batches = [self.converter(iterator.next(), self.device)
+                       for _ in range(self.n_fused)]
         self._report_stall_delta(iterator, stall_before)
+        if obs_on:
+            # the shared counter semantics (converter included here —
+            # this path stacks K batches host-side, and that cost is
+            # exposed feed latency)
+            self._record_stall_metric(iterator, stall_before, t0)
         loss_func = self.loss_func or optimizer.target
         first = batches[0]
-        if isinstance(first, tuple):
-            stacked = tuple(jnp.stack([b[i] for b in batches])
-                            for i in range(len(first)))
-            optimizer.update_scan(loss_func, *stacked)
-        elif isinstance(first, dict):
-            stacked = {k: jnp.stack([b[k] for b in batches]) for k in first}
-            optimizer.update_scan(loss_func, **stacked)
-        else:
-            optimizer.update_scan(loss_func, jnp.stack(batches))
+        with observability.span(
+                "train/optimizer_update",
+                tags={"n_fused": self.n_fused} if obs_on else None):
+            if isinstance(first, tuple):
+                stacked = tuple(jnp.stack([b[i] for b in batches])
+                                for i in range(len(first)))
+                optimizer.update_scan(loss_func, *stacked)
+            elif isinstance(first, dict):
+                stacked = {k: jnp.stack([b[k] for b in batches])
+                           for k in first}
+                optimizer.update_scan(loss_func, **stacked)
+            else:
+                optimizer.update_scan(loss_func, jnp.stack(batches))
         # epoch boundaries can land on ANY of the K pulls (is_new_epoch
         # only reflects the last one) — fire new_epoch once per boundary
         # crossed so epoch-driven schedules stay in step

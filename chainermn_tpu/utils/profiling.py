@@ -41,6 +41,22 @@ class Profile(Extension):
 
     ``trainer.extend(Profile(start=10, n_steps=3))`` captures steady-state
     steps (skipping compilation) into ``<out>/trace``.
+
+    Leak contract (ISSUE 14 satellite): a run that ends — or RAISES —
+    inside the trace window must still stop the trace.  Three layers
+    close it:
+
+    * ``on_error`` stops the trace the moment a failure escapes the
+      training loop — BEFORE any recovery supervisor resumes, so a
+      recovered run's capture cannot silently bleed across the failure
+      (and a fail-stop run doesn't rely on finalizers at all);
+    * ``finalize`` (the trainer's ``finally``) stops it on any exit,
+      and ``Trainer.run`` exception-isolates the finalize fan-out so
+      another extension's failing ``finalize`` can no longer starve
+      this one (the leak the regression test pins);
+    * ``_stop`` itself is idempotent and swallows ``stop_trace``'s own
+      errors into a warning — a profiler wedge must not mask the
+      original exception.
     """
 
     trigger = (1, "iteration")
@@ -59,10 +75,21 @@ class Profile(Extension):
                 self.log_dir or f"{trainer.out}/trace")
             self._active = True
         elif self._active and it >= self.start + self.n_steps:
+            self._stop()
+
+    def _stop(self):
+        if not self._active:
+            return
+        self._active = False   # first: a failing stop must not re-fire
+        try:
             jax.profiler.stop_trace()
-            self._active = False
+        except Exception as e:  # noqa: BLE001 — never mask the caller
+            import warnings
+            warnings.warn(f"jax.profiler.stop_trace failed while "
+                          f"closing a Profile window: {e}", stacklevel=2)
+
+    def on_error(self, trainer, exc, tb):
+        self._stop()
 
     def finalize(self):
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+        self._stop()

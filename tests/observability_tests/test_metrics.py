@@ -1,0 +1,132 @@
+"""Metrics registry (ISSUE 14): counters/gauges/histograms, the
+cross-rank merge semantics, and the Prometheus text rendering.
+
+Host-only — no jit, no devices."""
+
+import pytest
+
+from chainermn_tpu.observability import (DEFAULT_TIME_BUCKETS_MS,
+                                         MetricsRegistry)
+from chainermn_tpu.observability import metrics as metrics_mod
+
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", help="requests")
+    c.inc()
+    c.inc(2, tenant="a")
+    c.inc(3, tenant="a")
+    assert c.value() == 1
+    assert c.value(tenant="a") == 5
+    with pytest.raises(ValueError, match="negative"):
+        c.inc(-1)
+
+
+def test_counter_get_or_create_idempotent_and_kind_clash():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("x")
+
+
+def test_gauge_set():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(4, tenant="a")
+    g.set(2, tenant="a")
+    assert g.value(tenant="a") == 2
+
+
+def test_histogram_buckets_sum_count_percentile():
+    reg = MetricsRegistry()
+    h = reg.histogram("wait_ms", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 5.0, 50.0):
+        h.observe(v)
+    counts, total, n = h.value()
+    assert counts == [1, 2, 1, 0] and total == 60.5 and n == 4
+    assert h.percentile(50) == 10.0
+    assert h.percentile(99) == 100.0
+    h.observe(1e9)
+    assert h.percentile(100) == float("inf")
+    assert reg.histogram("empty").percentile(50) is None
+
+
+def test_histogram_rejects_unsorted_bounds():
+    with pytest.raises(ValueError, match="sorted"):
+        MetricsRegistry().histogram("h", buckets=(10.0, 1.0))
+
+
+def test_merge_counters_sum_histograms_add_gauges_rank_label():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for reg, v in ((a, 1), (b, 2)):
+        reg.counter("c").inc(v)
+        reg.gauge("g").set(v, tenant="t")
+        reg.histogram("h", buckets=(1.0, 10.0)).observe(v)
+    merged = MetricsRegistry()
+    merged.merge_dict(a.to_dict(), rank=0)
+    merged.merge_dict(b.to_dict(), rank=1)
+    assert merged.get("c").value() == 3
+    # gauges keep per-rank identity
+    assert merged.get("g").value(tenant="t", rank="0") == 1
+    assert merged.get("g").value(tenant="t", rank="1") == 2
+    counts, total, n = merged.get("h").value()
+    assert counts == [1, 1, 0] and total == 3 and n == 2
+
+
+def test_merge_rejects_mismatched_histogram_bounds():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("h", buckets=(1.0,)).observe(0.5)
+    b.histogram("h", buckets=(2.0,)).observe(0.5)
+    merged = MetricsRegistry()
+    merged.merge_dict(a.to_dict(), rank=0)
+    with pytest.raises(ValueError, match="differ"):
+        merged.merge_dict(b.to_dict(), rank=1)
+
+
+def test_merge_across_rides_object_collectives():
+    from chainermn_tpu.communicators import DummyCommunicator
+    reg = MetricsRegistry()
+    reg.counter("c").inc(7)
+    merged = reg.merge_across(DummyCommunicator())
+    assert merged.get("c").value() == 7
+
+
+def test_label_key_roundtrip():
+    key = (("a", "1"), ("b", "x y"))
+    assert metrics_mod.unjson_key(metrics_mod.json_key(key)) == key
+    assert metrics_mod.unjson_key(metrics_mod.json_key(())) == ()
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("c_total", help="the c").inc(2, tenant="a")
+    reg.gauge("g").set(1.5)
+    reg.histogram("h_ms", buckets=(1.0, 10.0)).observe(0.5)
+    text = reg.to_prometheus()
+    lines = text.strip().split("\n")
+    assert "# HELP c_total the c" in lines
+    assert "# TYPE c_total counter" in lines
+    assert 'c_total{tenant="a"} 2' in lines
+    assert "# TYPE g gauge" in lines
+    assert "g 1.5" in lines
+    assert "# TYPE h_ms histogram" in lines
+    assert 'h_ms_bucket{le="1.0"} 1' in lines
+    assert 'h_ms_bucket{le="+Inf"} 1' in lines
+    assert "h_ms_sum 0.5" in lines
+    assert "h_ms_count 1" in lines
+
+
+def test_default_buckets_sorted():
+    assert list(DEFAULT_TIME_BUCKETS_MS) == sorted(DEFAULT_TIME_BUCKETS_MS)
+
+
+def test_prometheus_escapes_hostile_label_values():
+    """Label values are caller-supplied (tenant names) — quotes,
+    backslashes, and newlines must be escaped per the text exposition
+    format, or one hostile tenant breaks/forges the whole scrape."""
+    reg = MetricsRegistry()
+    reg.counter("c").inc(1, tenant='a"b\\c\nd')
+    (line,) = [l for l in reg.to_prometheus().splitlines()
+               if not l.startswith("#")]
+    assert line == 'c{tenant="a\\"b\\\\c\\nd"} 1'
+    assert "\n" not in line

@@ -1,0 +1,162 @@
+"""Profile extension trace-leak regression (ISSUE 14 satellite).
+
+The leak: a run that ends — or raises — inside the [start, start +
+n_steps) capture window used to depend on every OTHER extension's
+``finalize`` succeeding before Profile's ran; one failing finalizer
+earlier in the fan-out left ``jax.profiler.start_trace`` open forever.
+Pinned here: ``on_error`` stops the trace at the failure itself,
+``Trainer.run`` exception-isolates the finalize fan-out, and ``_stop``
+is idempotent and never masks the original exception."""
+
+import pytest
+
+import jax
+
+from chainermn_tpu.training import Trainer
+from chainermn_tpu.training.trainer import Extension
+from chainermn_tpu.training.updaters import Updater
+from chainermn_tpu.utils.profiling import Profile
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.active = False
+        self.starts = 0
+        self.stops = 0
+
+    def start_trace(self, log_dir):
+        assert not self.active, "start_trace while already tracing"
+        self.active = True
+        self.starts += 1
+
+    def stop_trace(self):
+        assert self.active, "stop_trace with no active trace"
+        self.active = False
+        self.stops += 1
+
+
+@pytest.fixture
+def profiler(monkeypatch):
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", fake)
+    return fake
+
+
+class _StubUpdater(Updater):
+    def __init__(self, fail_at=None):
+        self.iteration = 0
+        self.fail_at = fail_at
+
+    def connect_trainer(self, trainer):
+        pass
+
+    def get_all_optimizers(self):
+        return {}
+
+    def update(self):
+        self.iteration += 1
+        if self.fail_at is not None and self.iteration == self.fail_at:
+            raise RuntimeError("boom")
+
+    def finalize(self):
+        pass
+
+    def serialize(self, serializer):
+        pass
+
+
+class _HostileFinalize(Extension):
+    priority = 500  # finalizes BEFORE Profile (higher priority first)
+
+    def __call__(self, trainer):
+        pass
+
+    def finalize(self):
+        raise ValueError("hostile finalize")
+
+
+def test_run_ends_inside_window_trace_stopped(profiler, tmp_path):
+    trainer = Trainer(_StubUpdater(), (3, "iteration"),
+                      out=str(tmp_path))
+    trainer.extend(Profile(start=1, n_steps=10))
+    trainer.run(show_loop_exception_msg=False)
+    assert profiler.starts == 1
+    assert not profiler.active, "trace leaked past a pre-window-end run"
+
+
+def test_raise_inside_window_trace_stopped(profiler, tmp_path):
+    trainer = Trainer(_StubUpdater(fail_at=2), None, out=str(tmp_path))
+    trainer.extend(Profile(start=1, n_steps=10))
+    with pytest.raises(RuntimeError, match="boom"):
+        trainer.run(show_loop_exception_msg=False)
+    assert profiler.starts == 1
+    assert not profiler.active, "trace leaked past the raise"
+
+
+def test_hostile_finalize_cannot_starve_profile_stop(profiler, tmp_path,
+                                                     capsys):
+    """THE regression: another extension's failing finalize used to
+    abort the fan-out before Profile's finalize ran.  The trainer now
+    isolates each finalizer; the first finalize failure is still
+    re-raised (a clean run must not swallow it) AFTER everyone's
+    cleanup ran."""
+    trainer = Trainer(_StubUpdater(), (3, "iteration"),
+                      out=str(tmp_path))
+    trainer.extend(_HostileFinalize())
+    trainer.extend(Profile(start=1, n_steps=10))
+    with pytest.raises(ValueError, match="hostile finalize"):
+        trainer.run(show_loop_exception_msg=False)
+    assert not profiler.active, "hostile finalize starved Profile._stop"
+    assert "hostile finalize" in capsys.readouterr().err
+
+
+def test_updater_finalize_isolated_too(profiler, tmp_path):
+    """Review finding: a failing updater.finalize must neither swallow
+    a captured extension-finalize exception nor skip later cleanup."""
+    class _HostileUpdater(_StubUpdater):
+        def finalize(self):
+            raise OSError("updater cleanup failed")
+
+    trainer = Trainer(_HostileUpdater(), (3, "iteration"),
+                      out=str(tmp_path))
+    trainer.extend(_HostileFinalize())
+    trainer.extend(Profile(start=1, n_steps=10))
+    # the FIRST finalize failure (the extension's) is the one re-raised
+    with pytest.raises(ValueError, match="hostile finalize"):
+        trainer.run(show_loop_exception_msg=False)
+    assert not profiler.active
+
+
+def test_loop_exception_wins_over_finalize_exception(profiler, tmp_path):
+    """When the loop is already unwinding with the real failure, a
+    finalize failure must not REPLACE it."""
+    trainer = Trainer(_StubUpdater(fail_at=1), None, out=str(tmp_path))
+    trainer.extend(_HostileFinalize())
+    trainer.extend(Profile(start=1, n_steps=10))
+    with pytest.raises(RuntimeError, match="boom"):
+        trainer.run(show_loop_exception_msg=False)
+    assert not profiler.active
+
+
+def test_stop_is_idempotent_and_never_masks(profiler, tmp_path):
+    p = Profile(start=0, n_steps=5)
+    profiler.start_trace("x")
+    p._active = True
+    p._stop()
+    p._stop()   # second stop: no error, no double stop_trace
+    assert profiler.stops == 1
+
+    class _Wedged:
+        def stop_trace(self):
+            raise RuntimeError("profiler wedged")
+
+    p2 = Profile()
+    p2._active = True
+    monkey = jax.profiler
+    try:
+        jax.profiler = _Wedged()
+        with pytest.warns(UserWarning, match="wedged"):
+            p2._stop()   # swallowed into a warning, _active cleared
+        assert not p2._active
+    finally:
+        jax.profiler = monkey

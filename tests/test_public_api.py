@@ -70,6 +70,11 @@ MODULES = {
     "chainermn_tpu.serializers": ["save_npz", "load_npz"],
     "chainermn_tpu.utils": ["use_platform", "simulate_devices", "trace",
                             "annotate", "Profile"],
+    # round 15 (observability, docs/observability.md)
+    "chainermn_tpu.observability": [
+        "span", "instant", "tracer", "SpanTracer", "validate_events",
+        "set_mode", "enabled", "MetricsRegistry", "Counter", "Gauge",
+        "Histogram", "registry"],
 }
 
 F_FUNCTIONS = [

@@ -52,6 +52,15 @@
 #     chat-shaped, prefix on) stamp tools/serving_budgets.json targets
 #     as in item 4.
 #
+#  8. observability overhead delta (ISSUE 14): after the flagship rows
+#     above land, re-run ONE resnet50 flagship bench row and ONE serving
+#     row with CHAINERMN_TPU_TRACE=events and record the tokens/sec +
+#     ms/step delta vs the traced=off rows in BENCH_NOTES — that delta
+#     is the committed cost of leaving span tracing on in production
+#     (docs/observability.md "overhead" table).  Traced rows carry
+#     trace=events in their fingerprint and are never flagship-cacheable
+#     by construction, so they cannot contaminate the last-good cache.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
