@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos chaos-elastic bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
+.PHONY: test chaos chaos-elastic chaos-fleet bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -25,6 +25,16 @@ chaos-elastic:    ## elastic preempt-and-rejoin E2E (2-process gloo)
 	@# Runs under the chaos marker (tier-1 runs it too; this target is
 	@# the focused repro loop).
 	$(PY) -m pytest tests/multiprocess_tests/test_elastic_chaos.py -q -m chaos
+
+chaos-fleet:      ## serving-fleet kill-a-replica E2E (2-process gloo)
+	@# ISSUE 15 acceptance: one of two decode replicas preempted under
+	@# open-loop load -> typed-timeout detection, fleet membership
+	@# shrinks, in-flight sequences replay on the survivor with ZERO
+	@# drops and solo-run trajectories -> the replica re-joins and
+	@# adopts bit-identical weights over the multicast-tree sync ->
+	@# the router spreads new admissions to it.  Chaos-marked (tier-1
+	@# runs it too; this target is the focused repro loop).
+	$(PY) -m pytest tests/multiprocess_tests/test_fleet_chaos.py -q -m chaos
 
 bench:            ## real-hardware benchmark (one JSON line)
 	$(PY) bench.py
@@ -97,12 +107,14 @@ sweep-flash:      ## on-chip flash fwd/bwd/fwd+bwd tile sweep; regenerates tools
 probe-flash:      ## committed flash budgets joined with live fused-vs-split rows (cpu = smoke)
 	PROBE=flash PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
-probe-serving:    ## committed serving budgets + live decode/prefill census + per-phase table (no chip)
+probe-serving:    ## committed serving budgets + live decode/prefill census + per-phase + fleet tables (no chip)
 	@# decode: one gather per pool per layer through the block table,
 	@# no [T, T] score dot; prefill: flash forward kernels, zero bwd
 	@# kernels — joined with tools/serving_budgets.json (the tier-1
 	@# gate tests/test_serving_budget.py's data) and the decode
-	@# roofline byte table.
+	@# roofline byte table; plus the ISSUE 15 fleet table (one row per
+	@# replica seat: live, queue depth, routed/reroute counters) from a
+	@# tiny live 2-replica fleet with one replica preempted mid-load.
 	PROBE=serving PROBE_PLATFORM=cpu $(PY) tools/probe_perf.py
 
 probe-obs:        ## runtime observability join: trace schema + merged metrics registry (no chip)

@@ -76,11 +76,18 @@ a variant that is excluded from the last-good cache):
                 tokens in the chat-shaped load; 0 disables the prefix
                 cache — the A/B off leg), BENCH_SERVE_DISAGG (0|1:
                 disaggregated prefill/decode slices),
-                BENCH_SERVE_TP (1: tensor-parallel decode ways) —
-                serving (continuous-batching engine under a seeded
-                open-loop Poisson load: tokens/sec + p50/p99 per-token
-                latency + page-pool occupancy + prefix_hit_rate /
-                effective_capacity_x / transferred_page_bytes / tp;
+                BENCH_SERVE_TP (1: tensor-parallel decode ways),
+                BENCH_SERVE_REPLICAS (1: >1 serves through a
+                ReplicaFleet behind the router — rows grow replicas/
+                reroutes/weight_sync_s), BENCH_FLEET_KILL_AT (-1:
+                decode step at which the highest replica preempts;
+                its in-flight sequences reroute with zero drops and a
+                cold replica joins via the multicast-tree weight
+                sync) — serving (continuous-batching engine under a
+                seeded open-loop Poisson load: tokens/sec + p50/p99
+                per-token latency + page-pool occupancy +
+                prefix_hit_rate / effective_capacity_x /
+                transferred_page_bytes / tp;
                 CPU runs clamp to a labeled cpu_smoke row; never
                 cached as flagship data);
                 BENCH_MOE_EXPERTS (chip count), BENCH_MOE_TOPK (1),
@@ -386,7 +393,8 @@ _DEFAULT_FINGERPRINTS = {
                  "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
                  "stripe_ratio": 0,
                  "grad_dtype": "bfloat16", "error_feedback": True,
-                 "preempt_rank": -1, "trace": "off"},
+                 "preempt_rank": -1, "trace": "off",
+                 "serve_replicas": 1, "fleet_kill_at": -1},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -397,7 +405,8 @@ _DEFAULT_FINGERPRINTS = {
                     "exchange": "flat", "bucket_mb": 0, "inter_size": 0,
                     "stripe_ratio": 0,
                     "grad_dtype": "bfloat16", "error_feedback": True,
-                    "preempt_rank": -1, "trace": "off"},
+                    "preempt_rank": -1, "trace": "off",
+                    "serve_replicas": 1, "fleet_kill_at": -1},
 }
 
 def _env_float(name, default):
@@ -483,6 +492,12 @@ def _config_fingerprint(model=None):
             # overhead — its numbers stamp the overhead DELTA (recovery
             # queue), never the flagship datum
             "trace": os.environ.get("CHAINERMN_TPU_TRACE", "off"),
+            # the serving-fleet knobs (ISSUE 15): a multi-replica or
+            # kill-under-load run is a fleet measurement — fenced from
+            # the flagship fingerprints like every A/B knob (serving
+            # rows are metric-fenced anyway; this closes the env half)
+            "serve_replicas": _env_int("BENCH_SERVE_REPLICAS", 1),
+            "fleet_kill_at": _env_int("BENCH_FLEET_KILL_AT", -1),
         }
     return {
         "model": "resnet50",
@@ -504,6 +519,8 @@ def _config_fingerprint(model=None):
             os.environ.get("BENCH_ERROR_FEEDBACK", "1") == "1",
         "preempt_rank": _env_int("BENCH_PREEMPT_RANK", -1),
         "trace": os.environ.get("CHAINERMN_TPU_TRACE", "off"),
+        "serve_replicas": _env_int("BENCH_SERVE_REPLICAS", 1),
+        "fleet_kill_at": _env_int("BENCH_FLEET_KILL_AT", -1),
     }
 
 
@@ -1730,6 +1747,16 @@ def _run_bench_serving():
     prefix_len = _env_int("BENCH_SERVE_PREFIX", 16)
     disagg = os.environ.get("BENCH_SERVE_DISAGG", "0") == "1"
     tp = _env_int("BENCH_SERVE_TP", 1)
+    # round-16 fleet knobs (ISSUE 15): BENCH_SERVE_REPLICAS > 1 serves
+    # through a ReplicaFleet behind the router; BENCH_FLEET_KILL_AT=K
+    # preempts the highest replica at decode step K (its in-flight
+    # sequences reroute — zero drops) and a cold replica then joins via
+    # the multicast-tree weight sync (weight_sync_s measures it)
+    from chainermn_tpu.serving.fleet import fleet_mode as _fleet_mode
+    replicas = max(1, _env_int("BENCH_SERVE_REPLICAS", 1))
+    if not _fleet_mode():
+        replicas = 1   # CHAINERMN_TPU_FLEET=off: single-engine hatch
+    fleet_kill_at = _env_int("BENCH_FLEET_KILL_AT", -1)
     d_model = _env_int("BENCH_D_MODEL", 256)
     n_layers = _env_int("BENCH_LAYERS", 4)
     n_vocab = _env_int("BENCH_VOCAB", 8192)
@@ -1755,12 +1782,31 @@ def _run_bench_serving():
                           n_heads=n_heads, n_layers=n_layers,
                           max_len=max_context, seed=0,
                           compute_dtype=jnp.bfloat16)
-    engine = ServingEngine(model, num_pages=num_pages,
-                           page_size=page_size, max_batch=max_batch,
-                           max_context=max_context,
-                           max_queue=n_requests + max_batch,
-                           prefix_cache=prefix_len > 0, disagg=disagg,
-                           tp=tp)
+
+    def _build_engine(rid=0):
+        return ServingEngine(model, num_pages=num_pages,
+                             page_size=page_size, max_batch=max_batch,
+                             max_context=max_context,
+                             max_queue=n_requests + max_batch,
+                             prefix_cache=prefix_len > 0, disagg=disagg,
+                             tp=tp)
+
+    if replicas > 1:
+        from chainermn_tpu.serving import ReplicaFleet
+        fleet = ReplicaFleet(engine_factory=_build_engine,
+                             replicas=replicas)
+        if fleet_kill_at >= 0:
+            # seeded kill-under-load: the HIGHEST replica preempts at
+            # that decode step (deterministic — the same discipline as
+            # the elastic BENCH_PREEMPT_RANK leg)
+            fleet.replicas[max(fleet.replicas)].kill_at = fleet_kill_at
+        target = fleet
+        engines = [r.engine for r in fleet.live_replicas()]
+    else:
+        fleet = None
+        engine = _build_engine()
+        target = engine
+        engines = [engine]
 
     rng = np.random.RandomState(0)
     # chat-shaped load: every tenant re-sends its own fixed system
@@ -1791,21 +1837,33 @@ def _run_bench_serving():
     _check_compile_budget()
     _stamp_compile("compile", _COMPILE_CREDIT[0])
     t0 = time.perf_counter()
-    engine.warmup()
+    for e in engines:
+        e.warmup()
     compile_s = time.perf_counter() - t0
     _COMPILE_CREDIT[0] += compile_s
     _stamp_compile("done", _COMPILE_CREDIT[0])
-    traces_before = (engine.prefill_traces, engine.decode_traces)
+    traces_before = sum(e.prefill_traces + e.decode_traces
+                        for e in engines)
 
     # -- measured open-loop window
     for req in synth_requests(n_requests, 0.0):
-        engine.submit(req)
+        target.submit(req)
     occ, cap_x, steps = [], [], 0
+    joined = False
     base = time.monotonic()
-    while engine.running or engine.scheduler.pending():
+    while (fleet.pending() if fleet is not None
+           else engine.running or engine.scheduler.pending()):
         if _remaining() < 20:
             break  # cooperative: report the partial window honestly
-        st = engine.step(now=time.monotonic() - base)
+        st = target.step(now=time.monotonic() - base)
+        if fleet is not None and fleet.sheds and not joined:
+            # scale back after the kill: a COLD replica joins mid-load
+            # and syncs weights over the multicast tree — weight_sync_s
+            # is the row's cold-start cost column (its compiles are
+            # cold-start cost too, outside the initial engines'
+            # never-retrace window)
+            fleet.join()
+            joined = True
         if st["decoded"] == 0 and st["admitted"] == 0:
             # open-loop idle tick: nothing arrived yet — wait for the
             # load, don't spin (idle ticks are not decode steps and
@@ -1817,8 +1875,13 @@ def _run_bench_serving():
         steps += 1
     elapsed = time.monotonic() - base
 
+    completed = (fleet.completed if fleet is not None
+                 else engine.completed)
+    all_engines = engines if fleet is None else \
+        [r.engine for r in fleet.replicas.values() if not r.remote]
+
     lat = []
-    for req in engine.completed:
+    for req in completed:
         if not req.token_times:
             continue
         lat.append(req.token_times[0] - req.arrival_time)
@@ -1831,13 +1894,14 @@ def _run_bench_serving():
     # values the observability histogram buckets when tracing is on;
     # the bench reports them exactly (per-request sums, not bucket
     # bounds), trace on or off.
-    qwait = np.asarray([r.queue_wait_s for r in engine.completed
-                        if r.admit_time is not None] or [0.0])
+    qwait = np.asarray([r.queue_wait_s for r in completed
+                        if r.admit_time is not None
+                        or r.queue_wait_s > 0] or [0.0])
     # token_times, not tokens: an evicted request's generated tokens
     # fold into its prompt (recompute on re-admit) but each kept its
     # one production timestamp — len(tokens) would deflate tokens/sec
     # exactly on the saturation rows where eviction happens
-    n_tokens = sum(len(r.token_times) for r in engine.completed)
+    n_tokens = sum(len(r.token_times) for r in completed)
 
     result = {
         "metric": "serving_engine_throughput",
@@ -1860,34 +1924,47 @@ def _run_bench_serving():
         "page_occupancy_max": round(float(np.max(occ)), 3) if occ
         else 0.0,
         "qps": qps, "tenants": tenants, "requests": n_requests,
-        "completed": len(engine.completed),
+        "completed": len(completed),
         "generated_tokens": int(n_tokens),
-        "evictions": engine.evictions,
+        "evictions": sum(e.evictions for e in all_engines),
         "decode_steps": steps,
         "max_batch": max_batch, "page_size": page_size,
         "num_pages": num_pages, "max_context": max_context,
         "d_model": d_model, "n_layers": n_layers, "n_vocab": n_vocab,
-        "attn_mode": engine.mode,
-        "page_dtype": str(engine.kv.dtype),
+        "attn_mode": engines[0].mode,
+        "page_dtype": str(engines[0].kv.dtype),
         # round-14 scale-out surface: the chat-shaped load's measured
         # prefix economics, the disagg ship's wire bytes, and tp
         "prefix_tokens": prefix_len,
-        "prefix_hit_rate": round(engine.prefix_hits
-                                 / max(1, engine.admissions), 3),
-        "prefix_matched_tokens": int(engine.prefix_tokens_matched),
-        "forks": engine.forks,
+        "prefix_hit_rate": round(
+            sum(e.prefix_hits for e in all_engines)
+            / max(1, sum(e.admissions for e in all_engines)), 3),
+        "prefix_matched_tokens": int(sum(e.prefix_tokens_matched
+                                         for e in all_engines)),
+        "forks": sum(e.forks for e in all_engines),
         "effective_capacity_x": round(float(np.mean(cap_x)), 3)
         if cap_x else 1.0,
         "effective_capacity_x_max": round(float(np.max(cap_x)), 3)
         if cap_x else 1.0,
-        "disagg": engine.disagg,
-        "transferred_page_bytes": int(engine.transferred_page_bytes),
-        "tp": engine.tp,
+        "disagg": engines[0].disagg,
+        "transferred_page_bytes": int(sum(e.transferred_page_bytes
+                                          for e in all_engines)),
+        "tp": engines[0].tp,
         "compile_s": round(compile_s, 1),
         # the never-retrace contract, measured: bucket programs compiled
-        # in warmup, zero traces during the window
-        "window_retraces": (engine.prefill_traces - traces_before[0]
-                            + engine.decode_traces - traces_before[1]),
+        # in warmup, zero traces during the window — counted over the
+        # INITIAL replicas (a mid-window joiner compiles cold by
+        # design; that cost is the join's, not the window's)
+        "window_retraces": (sum(e.prefill_traces + e.decode_traces
+                                for e in engines) - traces_before),
+        # round-16 fleet surface (ISSUE 15): present on EVERY serving
+        # row (single-engine rows backfill the fleet-less defaults, so
+        # row consumers never key-miss)
+        "replicas": replicas,
+        "reroutes": fleet.reroutes if fleet is not None else 0,
+        "weight_sync_s": round(fleet.weight_sync_s, 3)
+        if fleet is not None else 0.0,
+        "fleet_kill_at": fleet_kill_at if fleet is not None else -1,
     }
     if cpu_smoke:
         # labeled loudly: mechanics smoke, not a serving measurement

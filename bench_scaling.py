@@ -456,6 +456,176 @@ def _gloo_elastic_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
                 trainer.updater.iteration * gbs / wall, 1)}), flush=True)
 
 
+def _gloo_fleet_worker(pid, nprocs, port, n_requests, kill_step):
+    """One process of the serving-fleet kill-under-load A/B (ISSUE 15):
+    process 0 runs the router + replica 0, every other process one
+    :class:`FleetWorker` replica over the REAL host channel.  On the
+    kill leg the worker replica preempts at decode step ``kill_step``
+    (announced leave + silence — the router detects through the typed
+    channel timeout), its in-flight requests replay on the survivor
+    with ZERO drops, and the preempted replica re-joins via the
+    multicast-tree weight sync.  ``kill_step < 0`` is the uninterrupted
+    baseline leg; the p99 completion-latency delta between the legs is
+    the detection-bounded spike the FIRST-CHIP-CONTACT checklist item 9
+    stamps."""
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from chainermn_tpu.communicators._communication_utility import (
+        initialize_distributed)
+    assert initialize_distributed(f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import ElasticMembership
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import (FleetWorker, RemoteReplica,
+                                       ReplicaFleet, Request,
+                                       ServingEngine)
+
+    comm = ct.create_communicator("jax_ici")
+    ch = comm._host_channel()
+    ch._timeout_ms = 6000   # typed detection in seconds, not minutes
+    membership = ElasticMembership(ch._client, rank=pid, world=nprocs,
+                                   role="fleet", settle_s=0.5,
+                                   poll_s=0.02, timeout_ms=90_000)
+    model = TransformerLM(n_vocab=257, d_model=64, n_heads=2,
+                          n_layers=2, max_len=64, seed=0)
+    engine = ServingEngine(model, num_pages=64, page_size=16,
+                           max_batch=4, max_context=64,
+                           prefix_cache=False)
+
+    if pid != 0:
+        worker = FleetWorker(engine, ch, membership=membership,
+                             router_process=0)
+        outcome = worker.serve(kill_at=kill_step if kill_step >= 0
+                               else None)
+        if outcome == "preempted":
+            # park until the survivors' shrink decision lands (a join
+            # announced mid-shrink would collapse shrink+grow into one
+            # no-op resolve — the elastic _preempted discipline)
+            epoch_at_leave = membership.current_epoch()
+            deadline = _time.monotonic() + 60
+            while membership.current_epoch() == epoch_at_leave \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            _time.sleep(0.5)
+            membership.announce_join(note="rejoin after preemption")
+            view = membership.resolve(
+                expect={0, pid}, require={0})
+            worker.sync_weights(view, joiners=(pid,))
+            worker.serve()   # back in rotation until the router stops us
+        return
+
+    # -- process 0: router + local replica 0 --------------------------------
+    remotes = {p: RemoteReplica(p, ch, p) for p in range(1, nprocs)}
+    fleet = ReplicaFleet(engines={0: engine, **remotes},
+                         membership=membership)
+    rng = np.random.RandomState(0)
+    reqs = [Request(rng.randint(0, 257, 8).astype(np.int32), 4,
+                    tenant=f"t{i % 2}", arrival_time=0.0)
+            for i in range(n_requests)]
+    submit_wall = {}
+    t0 = _time.monotonic()
+    for r in reqs:
+        fleet.submit(r)
+        submit_wall[r.request_id] = _time.monotonic()
+    rejoined = kill_step < 0
+    deadline = _time.monotonic() + 120
+    while (fleet.pending() or not rejoined) \
+            and _time.monotonic() < deadline:
+        if fleet.pending():
+            fleet.step()
+        if not rejoined:
+            if fleet.sheds:
+                joins = membership.pending_joins(fleet.view)
+                if joins:
+                    fleet.join(engines={joins[0]: RemoteReplica(
+                        joins[0], ch, joins[0])})
+                    rejoined = True
+                else:
+                    _time.sleep(0.05)
+            elif not fleet.pending():
+                break   # kill never fired: report the row honestly
+    wall = _time.monotonic() - t0
+    for rep in fleet.replicas.values():
+        if rep.remote and rep.live:
+            rep.stop()
+    done_ms = [(r.finish_time - submit_wall[r.request_id]) * 1e3
+               for r in fleet.completed if r.finish_time is not None
+               and r.request_id in submit_wall]
+    print(json.dumps({
+        "fleet": True, "processes": nprocs, "kill_step": kill_step
+        if kill_step >= 0 else None, "requests": n_requests,
+        "completed": len(fleet.completed),
+        "dropped": n_requests - len(fleet.completed),
+        "reroutes": fleet.reroutes, "sheds": fleet.sheds,
+        "rejoined": rejoined and kill_step >= 0,
+        "detection_s": round(fleet.last_detection_s, 3)
+        if fleet.last_detection_s is not None else None,
+        "weight_sync_s": round(fleet.weight_sync_s, 3),
+        "p99_completion_ms": round(float(
+            np.percentile(done_ms, 99)), 2) if done_ms else None,
+        "wall_s": round(wall, 3)}), flush=True)
+
+
+def _run_fleet_ab(nprocs, n_requests, kill_step):
+    """The 2-replica gloo fleet kill-under-load A/B (ISSUE 15): one
+    uninterrupted run, one kill-and-rejoin run; the summary line is the
+    detection-bounded p99 completion spike + the tree weight-sync cost
+    (FIRST-CHIP-CONTACT checklist item 9)."""
+    import re
+    import socket
+    import subprocess
+    import sys
+    if kill_step < 0:
+        raise SystemExit(f"--fleet-kill {kill_step} must be a decode "
+                         f"step index >= 0")
+    env = dict(os.environ)
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "",
+            env["XLA_FLAGS"])
+    rows = []
+    for leg_kill in (-1, kill_step):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--gloo-fleet-worker", str(pid), str(nprocs), str(port),
+             str(n_requests), str(leg_kill)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in range(nprocs)]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=600)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        assert all(p.returncode == 0 for p in procs), \
+            [(p.returncode, o[-2000:]) for p, o in zip(procs, outs)]
+        row = json.loads([ln for ln in outs[0].splitlines()
+                          if ln.startswith("{")][-1])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base, killed = rows
+    print(json.dumps({
+        "fleet_ab": True, "processes": nprocs,
+        "kill_step": kill_step,
+        "dropped": killed["dropped"],
+        "reroutes": killed["reroutes"],
+        "detection_s": killed["detection_s"],
+        "weight_sync_s": killed["weight_sync_s"],
+        "p99_spike_ms_vs_baseline": round(
+            (killed["p99_completion_ms"] or 0)
+            - (base["p99_completion_ms"] or 0), 2)}), flush=True)
+    return rows
+
+
 def _run_elastic_ab(nprocs, per_rank_bs, hidden, steps, preempt_rank):
     """The ≥2-host elastic A/B (ISSUE 10): one uninterrupted P-process
     run, one preempt-and-rejoin run, and the delta — the end-to-end
@@ -533,6 +703,21 @@ def main():
                         help=argparse.SUPPRESS)  # internal
     parser.add_argument("--gloo-elastic-worker", nargs=7, default=None,
                         help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--gloo-fleet-worker", nargs=5, default=None,
+                        help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--fleet-kill", type=int, default=None,
+                        help="run the serving-fleet kill-under-load A/B"
+                             " (ISSUE 15): an uninterrupted 2-replica "
+                             "gloo fleet run vs one where the worker "
+                             "replica preempts at this decode step, its"
+                             " in-flight requests replay on the "
+                             "survivor (zero drops) and the replica "
+                             "re-joins via the multicast-tree weight "
+                             "sync; P = max of --gloo-procs (default "
+                             "2).  The summary line is the detection-"
+                             "bounded p99 spike + the sync cost")
+    parser.add_argument("--fleet-requests", type=int, default=16,
+                        help="open-loop request count for --fleet-kill")
     parser.add_argument("--preempt-rank", type=int, default=None,
                         help="run the elastic preempt-and-rejoin A/B "
                              "(ISSUE 10): an uninterrupted P-process "
@@ -586,6 +771,14 @@ def main():
         return
     if args.gloo_elastic_worker:
         _gloo_elastic_worker(*map(int, args.gloo_elastic_worker))
+        return
+    if args.gloo_fleet_worker:
+        _gloo_fleet_worker(*map(int, args.gloo_fleet_worker))
+        return
+    if args.fleet_kill is not None:
+        nprocs = max(int(c) for c in args.gloo_procs.split(",")) \
+            if args.gloo_procs else 2
+        _run_fleet_ab(nprocs, args.fleet_requests, args.fleet_kill)
         return
     if args.preempt_rank is not None:
         nprocs = max(int(c) for c in args.gloo_procs.split(",")) \

@@ -50,7 +50,8 @@ import jax
 
 from ._host_channel import (ChannelError, ChannelTimeoutError, PeerLostError,
                             HostChannel, HeartbeatMonitor)
-from ._membership import ElasticMembership, MembershipView
+from ._membership import (ElasticMembership, MembershipView,
+                          multicast_tree_plan)
 from .communicator_base import CommunicatorBase
 from .debug_communicator import DebugCommunicator
 from .dummy_communicator import DummyCommunicator
@@ -68,7 +69,7 @@ __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
            "schedule_from_env",
            "ChannelError", "ChannelTimeoutError", "PeerLostError",
            "HostChannel", "HeartbeatMonitor",
-           "ElasticMembership", "MembershipView",
+           "ElasticMembership", "MembershipView", "multicast_tree_plan",
            "EXCHANGES", "exchange_knobs"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",

@@ -13,9 +13,21 @@ Protocol (see ``docs/resilience.md`` §7):
 
 * Membership is a monotonically increasing **epoch** counter plus, per
   epoch, a decided **view** (the sorted tuple of live controller
-  ranks).  Keys live under ``<ns>/elastic`` — OUTSIDE the host
-  channel's per-generation prefix, so a ``bump_generation`` (the
-  fixed-size recovery quiesce) never strands a membership decision.
+  ranks).  Keys live under ``<ns>/<role>`` (``<ns>/elastic`` for the
+  training group; the serving fleet binds ``role="fleet"`` →
+  ``<ns>/fleet``) — OUTSIDE the host channel's per-generation prefix,
+  so a ``bump_generation`` (the fixed-size recovery quiesce) never
+  strands a membership decision.  Role groups are fully disjoint key
+  namespaces: a fleet group and a training elastic group sharing one
+  KV store never see each other's presence/candidate/intent keys, and
+  every decided view carries its ``role`` so downstream diagnostics
+  (``RecoveryGivingUp``) name the right group.
+* Each decision also publishes a **multicast tree plan**
+  (:func:`multicast_tree_plan`, a pure function of the member set):
+  the O(log N)-round binomial broadcast schedule bulk state transfers
+  (serving-fleet weight sync, ISSUE 15) ride instead of N sequential
+  root bcasts.  The plan key is informational — every member computes
+  the identical plan from the identical view.
 * ``announce_leave()`` / ``announce_join()`` are non-blocking,
   generation-keyed intents a rank posts before it departs / when it
   wants back in.  A standing ``leave`` excludes its rank from the next
@@ -52,15 +64,71 @@ import time
 
 from ._host_channel import ChannelTimeoutError
 
-__all__ = ["MembershipView", "ElasticMembership"]
+__all__ = ["MembershipView", "ElasticMembership", "multicast_tree_plan"]
+
+
+def multicast_tree_plan(members, root=None):
+    """Binomial broadcast-tree schedule over ``members`` — the O(log N)
+    replacement for the lowest-survivor O(N) sequential bcast.
+
+    Returns a tuple of ROUNDS; round ``k`` is a tuple of ``(src, dst)``
+    member pairs whose transfers can all run concurrently (every ``src``
+    already holds the payload: the root before round 0, plus every
+    ``dst`` of an earlier round).  Pure function of ``(members, root)``
+    — every member computes the identical plan, so no coordination
+    beyond the decided view is needed.  Properties (pinned by test):
+
+    * every non-root member appears EXACTLY once as a ``dst``;
+    * every ``src`` of round ``k`` is the root or a ``dst`` of a round
+      ``< k`` (no transfer from an empty holder);
+    * depth ``== ceil(log2 N)`` (``0`` rounds for a single member).
+
+    ``root`` defaults to the lowest member (the serving fleet's lowest
+    survivor; the elastic snapshot root).
+    """
+    members = tuple(sorted(int(m) for m in members))
+    if not members:
+        raise ValueError("multicast_tree_plan needs at least one member")
+    if len(set(members)) != len(members):
+        raise ValueError(f"duplicate members: {members!r}")
+    root = members[0] if root is None else int(root)
+    if root not in members:
+        raise ValueError(f"root {root} is not a member of {members!r}")
+    order = (root,) + tuple(m for m in members if m != root)
+    n = len(order)
+    rounds = []
+    have = 1  # holders so far: order[:have]
+    while have < n:
+        rounds.append(tuple((order[i], order[i + have])
+                            for i in range(have) if i + have < n))
+        have *= 2
+    return tuple(rounds)
+
+
+def _serialize_tree_plan(plan):
+    return ";".join(",".join(f"{s}>{d}" for s, d in rnd) for rnd in plan)
+
+
+def _parse_tree_plan(raw):
+    plan = []
+    for rnd in str(raw).split(";"):
+        if not rnd:
+            continue
+        plan.append(tuple(tuple(int(x) for x in pair.split(">"))
+                          for pair in rnd.split(",") if pair))
+    return tuple(plan)
 
 
 class MembershipView:
     """One decided membership generation: ``epoch`` + sorted ``members``
-    (global controller ranks).  Immutable value object."""
+    (global controller ranks) + the ``role`` of the group that decided
+    it (``"elastic"`` for the training group, ``"fleet"`` for the
+    serving fleet — views from different role groups never compare
+    equal).  Immutable value object."""
 
-    def __init__(self, epoch, members):
+    def __init__(self, epoch, members, role="elastic"):
         self.epoch = int(epoch)
+        self.role = str(role)
         self.members = tuple(sorted(int(m) for m in members))
         if len(set(self.members)) != len(self.members):
             raise ValueError(f"duplicate members in view: {members!r}")
@@ -79,14 +147,20 @@ class MembershipView:
 
     def __eq__(self, other):
         return (isinstance(other, MembershipView)
-                and (self.epoch, self.members)
-                == (other.epoch, other.members))
+                and (self.epoch, self.members, self.role)
+                == (other.epoch, other.members, other.role))
 
     def __hash__(self):
-        return hash((self.epoch, self.members))
+        return hash((self.epoch, self.members, self.role))
+
+    def tree_plan(self, root=None):
+        """The view's multicast tree plan (pure; see
+        :func:`multicast_tree_plan`)."""
+        return multicast_tree_plan(self.members, root=root)
 
     def __repr__(self):
-        return f"<MembershipView epoch={self.epoch} members={self.members}>"
+        return (f"<MembershipView role={self.role!r} epoch={self.epoch} "
+                f"members={self.members}>")
 
 
 class ElasticMembership:
@@ -96,6 +170,9 @@ class ElasticMembership:
     ``rank``/``world``: this process's GLOBAL controller rank and the
     boot-time process count — membership ranks are stable process
     identities; a resized communicator maps them to dense slots.
+    ``role``: the group namespace suffix — ``"elastic"`` (default, the
+    training group) or ``"fleet"`` (the serving fleet); groups of
+    different roles in the same KV store are fully key-disjoint.
     ``settle_s``: how long the candidate set must be unchanged before
     the leader decides without the full ``expect`` set (the per-peer
     timeout).  ``stall_s``: a candidate whose presence beat freezes
@@ -104,13 +181,17 @@ class ElasticMembership:
     """
 
     def __init__(self, client, rank, world, namespace="cmn",
-                 settle_s=1.0, stall_s=10.0, poll_s=0.05,
+                 role="elastic", settle_s=1.0, stall_s=10.0, poll_s=0.05,
                  timeout_ms=60_000, clock=time.monotonic,
                  sleep=time.sleep):
         self._client = client
         self.rank = int(rank)
         self.world = int(world)
-        self._base = f"{namespace}/elastic"
+        self.role = str(role)
+        if not self.role or "/" in self.role:
+            raise ValueError(f"membership role must be a single path "
+                             f"segment, got {role!r}")
+        self._base = f"{namespace}/{self.role}"
         self.settle_s = float(settle_s)
         self.stall_s = float(stall_s)
         self.poll_s = float(poll_s)
@@ -206,7 +287,7 @@ class ElasticMembership:
     def bootstrap_view(self):
         """Epoch-0 view: every boot-time controller rank (the world
         before any elasticity event)."""
-        return MembershipView(0, range(self.world))
+        return MembershipView(0, range(self.world), role=self.role)
 
     def current_view(self):
         """The newest decided view, or the bootstrap view when no
@@ -225,7 +306,7 @@ class ElasticMembership:
             members = [int(tok) for tok in str(raw).split(",") if tok != ""]
         except ValueError:
             return None
-        return MembershipView(epoch, members)
+        return MembershipView(epoch, members, role=self.role)
 
     # -- announcements (generation-keyed intents) ---------------------------
     def announce_leave(self, note=""):
@@ -326,20 +407,39 @@ class ElasticMembership:
                     cand = tuple(r for r in cand
                                  if r == self.rank or seen[r][2] >= 1)
                 if cand and cand[0] == self.rank:
-                    view = MembershipView(epoch, cand)
+                    view = MembershipView(epoch, cand, role=self.role)
                     self._publish(view)
                     self.stats["led"] += 1
                     return view
             self._sleep(self.poll_s)
+
+    def read_tree_plan(self, epoch=None):
+        """The leader-published multicast tree plan of the (given or
+        newest) epoch, or the locally computed plan when the key is
+        absent (the plan is a pure function of the view, so the two can
+        never disagree — the published key exists for operators and
+        cross-version readers)."""
+        epoch = self.current_epoch() if epoch is None else int(epoch)
+        raw = self._try_get(f"{self._base}/e{epoch}/tree")
+        if raw is not None:
+            return _parse_tree_plan(raw)
+        view = self._read_view(epoch) if epoch else self.bootstrap_view()
+        if view is None:
+            return None
+        return multicast_tree_plan(view.members)
 
     def _publish(self, view):
         """Leader-side decision write: the view key first, then the
         epoch's append-only marker (a reader that discovers the new
         epoch always finds its view), then the consumed join/leave
         intents are scrubbed (admitted ranks' joins, departed ranks'
-        leaves)."""
+        leaves).  The view's multicast tree plan (rooted at the lowest
+        member) is published next to it — informational, every member
+        recomputes the identical plan."""
         prefix = f"{self._base}/e{view.epoch}"
         self._set(f"{prefix}/view", ",".join(str(m) for m in view.members))
+        self._set(f"{prefix}/tree",
+                  _serialize_tree_plan(view.tree_plan()))
         self._set(f"{self._base}/epochs/{view.epoch}", "1")
         for r in view.members:
             self._delete(f"{self._base}/join/{r}")

@@ -64,14 +64,18 @@ class RecoveryGivingUp(RuntimeError):
     an operator reads one line of a crash log, and "who was in the
     world when we stopped trying" is the first question (ISSUE 10
     satellite — a bare budget count told you nothing about *who* was
-    missing)."""
+    missing).  The message also names the view's GROUP role (ISSUE 15
+    satellite): a give-up inside a serving-role membership group
+    (``role="fleet"``) must point the operator at the fleet namespace,
+    not the training ``elastic`` one — the same process may hold both."""
 
     def __init__(self, message, membership=None):
         self.membership = membership
         if membership is not None:
             message = (f"{message} [last membership view: epoch "
                        f"{membership.epoch}, members "
-                       f"{list(membership.members)}]")
+                       f"{list(membership.members)}, group "
+                       f"'{getattr(membership, 'role', 'elastic')}']")
         super().__init__(message)
 
 

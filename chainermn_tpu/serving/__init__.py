@@ -22,7 +22,13 @@ tensor-parallel paged decode.  Pieces, each its own module:
 * :mod:`.engine` — the prefill/decode split wired together as bucketed
   jit programs over the shared pools, with the prefix cache, the
   disaggregated slices (``CHAINERMN_TPU_SERVE_DISAGG``), and the ``tp``
-  mesh axis.
+  mesh axis;
+* :mod:`.fleet` / :mod:`.router` — round 16 (ISSUE 15): the elastic
+  serving fleet — decode replicas in a ``role="fleet"`` membership
+  group behind a per-tenant fair router, preempted replicas' in-flight
+  sequences replayed on survivors with zero drops, cold joiners
+  weight-synced over a multicast tree in O(log N) rounds
+  (``CHAINERMN_TPU_FLEET=off`` = single-engine hatch).
 
 Measurement: ``BENCH_MODEL=serving python bench.py`` (tokens/sec,
 p50/p99 per-token latency, page-pool occupancy, ``prefix_hit_rate`` +
@@ -37,9 +43,12 @@ from .engine import (ServingEngine, decode_program, prefill_program,
                      prefix_prefill_program, serve_disagg_mode)
 from .errors import (EvictionStalledError, PagePoolExhaustedError,
                      QueueSaturatedError, ServingError)
+from .fleet import (FleetWorker, LocalReplica, QueueDepthScalePolicy,
+                    RemoteReplica, ReplicaFleet, fleet_mode)
 from .kv_cache import (PagedKVCache, copy_page, insert_pages,
                        write_prompt_kv, write_prompt_kv_at, write_token_kv)
 from .page_allocator import BlockAllocator
+from .router import FleetRouter, NoLiveReplicaError
 from .scheduler import Request, RequestScheduler
 
 __all__ = [
@@ -50,4 +59,8 @@ __all__ = [
     "BlockAllocator", "Request", "RequestScheduler",
     "ServingError", "PagePoolExhaustedError", "QueueSaturatedError",
     "EvictionStalledError",
+    # round 16 (ISSUE 15): the elastic serving fleet
+    "ReplicaFleet", "FleetRouter", "LocalReplica", "RemoteReplica",
+    "FleetWorker", "QueueDepthScalePolicy", "fleet_mode",
+    "NoLiveReplicaError",
 ]

@@ -138,6 +138,12 @@ class RequestScheduler:
             return len(self._queues.get(tenant, ()))
         return sum(len(q) for q in self._queues.values())
 
+    def tenant_depths(self):
+        """``{tenant: queue depth}`` for every tenant ever seen — the
+        public per-tenant health surface (the fleet's gauges and the
+        remote replica reports read this, never ``_queues``)."""
+        return {t: len(q) for t, q in self._queues.items()}
+
     def next_admission(self, arrived_by=None):
         """Pop the next request in fair rotation, or None.
 

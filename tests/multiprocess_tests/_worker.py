@@ -43,6 +43,8 @@ def main():
         run_chaos_recovery(pid, nprocs, tmpdir)
     elif scenario == "elastic":
         run_elastic(pid, nprocs, tmpdir)
+    elif scenario == "fleet":
+        run_fleet(pid, nprocs, tmpdir)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 
@@ -835,6 +837,165 @@ def run_elastic(pid, nprocs, tmpdir):
             assert _host_value(a).tobytes() == _host_value(b).tobytes()
     _ok("elastic_cross_size_resume_bit_exact")
 
+    print("ALL_OK", flush=True)
+
+
+def run_fleet(pid, nprocs, tmpdir):
+    """Serving-fleet chaos over REAL 2-process gloo transport (the
+    ISSUE 15 acceptance gate): process 0 runs the router + replica 0,
+    process 1 one FleetWorker replica.  A seeded kill preempts replica
+    1 at decode step 2 under open-loop load — the worker announces its
+    fleet-role leave and goes silent, the router detects through the
+    TYPED channel timeout (the committed detection bound), resolves the
+    fleet membership down to {0}, and replays every request replica 1
+    held from its ORIGINAL prompt on the survivor: zero dropped
+    requests, every trajectory equal to its solo run.  Replica 1 then
+    parks, re-joins through the membership protocol, PERTURBS its
+    weights, and adopts the root's over the multicast-tree sync —
+    bit-identical restoration proven on the worker — and the router
+    spreads new admissions to the re-joined replica."""
+    import time
+
+    import numpy as np
+    import jax
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import ElasticMembership
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import (FleetWorker, RemoteReplica,
+                                       ReplicaFleet, Request,
+                                       ServingEngine)
+
+    DETECT_S = 6.0          # the committed typed detection bound
+    KILL_AT = 2
+    N_REQS = 8
+
+    comm = ct.create_communicator("jax_ici")
+    ch = comm._host_channel()
+    ch._timeout_ms = int(DETECT_S * 1000)
+    membership = ElasticMembership(ch._client, rank=pid, world=nprocs,
+                                   role="fleet", settle_s=0.5,
+                                   poll_s=0.02, timeout_ms=90_000)
+    model = TransformerLM(n_vocab=127, d_model=32, n_heads=1,
+                          n_layers=1, max_len=32, seed=0)
+    engine = ServingEngine(model, num_pages=32, page_size=16,
+                           max_batch=2, max_context=32,
+                           prefix_cache=False)
+
+    def leaves(e):
+        return [np.asarray(x) for x in jax.tree.leaves(e.state)]
+
+    if pid == 1:
+        worker = FleetWorker(engine, ch, membership=membership,
+                             router_process=0)
+        outcome = worker.serve(kill_at=KILL_AT)
+        assert outcome == "preempted", outcome
+        before = leaves(engine)
+        # park until the survivors' shrink decision lands, then rejoin
+        epoch_at_leave = membership.current_epoch()
+        deadline = time.monotonic() + 60
+        while membership.current_epoch() == epoch_at_leave \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert membership.current_view().members == (0,)
+        _ok("fleet_shrunk_to_survivor")
+        time.sleep(0.5)
+        # perturb the weights: the tree sync must RESTORE them
+        # bit-identically from the root (a cold joiner's weights are
+        # whatever its factory seeded — here, provably wrong ones)
+        import jax.numpy as jnp
+        ls, treedef = jax.tree.flatten(engine.state)
+        engine.state = jax.tree.unflatten(
+            treedef, [jnp.asarray(np.asarray(x) + 1.0) for x in ls])
+        membership.announce_join(note="rejoin after preemption")
+        view = membership.resolve(expect={0, 1}, require={0})
+        assert 1 in view and view.role == "fleet"
+        rounds = worker.sync_weights(view, joiners=(1,))
+        assert rounds == 1, rounds   # 1 joiner: ceil(log2 2) rounds
+        after = leaves(engine)
+        assert all((a == b).all() for a, b in zip(after, before)), \
+            "tree sync did not restore bit-identical weights"
+        _ok("fleet_weight_sync_bit_identical")
+        worker.serve()   # back in rotation until the router stops us
+        print("ALL_OK", flush=True)
+        return
+
+    # -- process 0: router + local replica 0 --------------------------------
+    remote = RemoteReplica(1, ch, 1)
+    fleet = ReplicaFleet(engines={0: engine, 1: remote},
+                         membership=membership)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 127, rng.randint(4, 9)).astype(np.int32)
+               for _ in range(N_REQS)]
+    reqs = [Request(p, 4, tenant=f"t{i % 2}", arrival_time=0.0,
+                    request_id=i) for i, p in enumerate(prompts)]
+    placements = [fleet.submit(r) for r in reqs]
+    assert set(placements) == {0, 1}, placements
+    rejoined = False
+    detect_dt = None
+    deadline = time.monotonic() + 120
+    while (fleet.pending() or not rejoined) \
+            and time.monotonic() < deadline:
+        if fleet.pending():
+            sheds_before = fleet.sheds
+            t0 = time.monotonic()
+            fleet.step()
+            if fleet.sheds > sheds_before:
+                detect_dt = time.monotonic() - t0
+        if not rejoined and fleet.sheds:
+            joins = membership.pending_joins(fleet.view)
+            if joins:
+                fleet.join(engines={1: RemoteReplica(1, ch, 1)})
+                rejoined = True
+            else:
+                time.sleep(0.05)
+    assert rejoined, "replica 1 never re-joined"
+
+    # zero dropped requests: every submitted id completed exactly once
+    done_ids = sorted(r.request_id for r in fleet.completed)
+    assert done_ids == list(range(N_REQS)), done_ids
+    assert fleet.sheds == 1 and fleet.reroutes >= 1, fleet.stats()
+    _ok("fleet_zero_drop")
+
+    # detection bounded: the shed step paid at most the typed channel
+    # deadline (plus resolve/replay slack), never an unbounded hang
+    assert detect_dt is not None and detect_dt <= DETECT_S + 8.0, \
+        detect_dt
+    _ok("fleet_detection_bounded")
+
+    # solo-run trajectory parity (rerouted sequences replay from their
+    # prompts; greedy decode regenerates identical tokens)
+    golden = ServingEngine(TransformerLM(n_vocab=127, d_model=32,
+                                         n_heads=1, n_layers=1,
+                                         max_len=32, seed=0),
+                           num_pages=32, page_size=16, max_batch=2,
+                           max_context=32, prefix_cache=False)
+    for req in sorted(fleet.completed, key=lambda r: r.request_id):
+        if req.request_id >= N_REQS:
+            continue
+        generated = list(req.prompt[len(prompts[req.request_id]):]) \
+            + list(req.tokens)
+        g = Request(prompts[req.request_id], 4, tenant="g",
+                    arrival_time=0.0)
+        golden.submit(g)
+        golden.drain(now=1.0)
+        assert generated == golden.completed[-1].tokens, req.request_id
+    _ok("fleet_replay_parity")
+
+    # the router spreads new admissions onto the re-joined replica
+    more = [Request(rng.randint(1, 127, 5).astype(np.int32), 2,
+                    tenant="t0", arrival_time=0.0,
+                    request_id=100 + i) for i in range(3)]
+    new_placements = [fleet.submit(r) for r in more]
+    assert 1 in new_placements, new_placements
+    fleet.drain()
+    assert sorted(r.request_id for r in fleet.completed
+                  if r.request_id >= 100) == [100, 101, 102]
+    _ok("fleet_router_spreads_to_joiner")
+
+    for rep in fleet.replicas.values():
+        if rep.remote and rep.live:
+            rep.stop()
     print("ALL_OK", flush=True)
 
 

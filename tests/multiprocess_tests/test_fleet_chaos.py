@@ -1,0 +1,33 @@
+"""Serving-fleet chaos over REAL 2-process gloo transport (the
+ISSUE 15 acceptance gate, see docs/serving.md §"Elastic serving
+fleet").
+
+One run: a seeded kill preempts the worker-process replica at decode
+step 2 under open-loop load → the worker announces its FLEET-role
+leave and goes silent, the router detects through the typed channel
+timeout (bounded by the committed detection deadline), the fleet
+membership shrinks to {0}, and every request the dead replica held
+replays from its ORIGINAL prompt on the survivor — zero dropped
+requests, every trajectory equal to its solo run → the replica parks,
+re-joins through the membership protocol, perturbs its weights, and
+adopts the root's BIT-IDENTICALLY over the multicast-tree sync → the
+router spreads new admissions to the re-joined replica."""
+
+import pytest
+
+from .test_two_process import _launch
+
+pytestmark = pytest.mark.chaos
+
+
+def test_two_process_fleet_kill_reroute_and_rejoin(tmp_path):
+    outs = _launch("fleet", 2, tmp_path, timeout=420)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-6000:]}"
+        assert "ALL_OK" in out, out[-6000:]
+    combined = "\n".join(out for _, out in outs)
+    for name in ("fleet_zero_drop", "fleet_detection_bounded",
+                 "fleet_replay_parity", "fleet_router_spreads_to_joiner",
+                 "fleet_shrunk_to_survivor",
+                 "fleet_weight_sync_bit_identical"):
+        assert f"PASS {name}" in combined, (name, combined[-6000:])

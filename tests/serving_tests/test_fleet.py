@@ -1,0 +1,546 @@
+"""Elastic serving fleet, single process, tier-1 (ISSUE 15).
+
+Four layers, all deterministic and tiny (the tier-1 compile budget):
+
+* the ROLE-NAMESPACED MEMBERSHIP protocol — a ``fleet`` group and a
+  training ``elastic`` group sharing one KV store are fully
+  key-disjoint (presence/intent/epoch keys never cross), views carry
+  their group role, and the leader publishes the multicast tree plan
+  next to every decided view;
+* the MULTICAST TREE PLAN — a pure function of the member set:
+  deterministic, every non-root member exactly once, every source
+  already a holder, depth ``== ceil(log2 N)``;
+* the ROUTER — per-tenant fair spread with decorrelated rotations,
+  typed sideways shedding on saturation, typed give-up when no live
+  replica remains;
+* the FLEET ARC on real (tiny) engines — kill one of two replicas
+  under load → ZERO dropped requests, every request finishing with its
+  solo-run trajectory; a third cold replica joins → bit-identical
+  weights via the tree sync and the router spreads new admissions to
+  it; losing the last replica raises ``RecoveryGivingUp`` naming the
+  FLEET group (the ISSUE 15 small-fix pin).
+"""
+
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import observability
+from chainermn_tpu.communicators import (ElasticMembership, MembershipView,
+                                         RankPreempted,
+                                         multicast_tree_plan)
+from chainermn_tpu.extensions import RecoveryGivingUp
+from chainermn_tpu.serving import (FleetRouter, NoLiveReplicaError,
+                                   QueueDepthScalePolicy,
+                                   QueueSaturatedError, ReplicaFleet,
+                                   Request, ServingEngine, fleet_mode)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    observability.reset_registry()
+    yield
+    observability.reset_registry()
+
+
+# -- multicast tree plan (pure) ----------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 16, 17])
+def test_tree_plan_properties(n):
+    members = tuple(range(100, 100 + 3 * n, 3))   # arbitrary ids
+    root = members[n // 2]
+    plan = multicast_tree_plan(members, root=root)
+    # deterministic pure function
+    assert plan == multicast_tree_plan(members, root=root)
+    # depth == ceil(log2 N)
+    assert len(plan) == (math.ceil(math.log2(n)) if n > 1 else 0)
+    # every non-root member exactly once as a destination
+    dsts = [d for rnd in plan for _, d in rnd]
+    assert sorted(dsts) == sorted(m for m in members if m != root)
+    # every source already holds the payload when it sends
+    have = {root}
+    for rnd in plan:
+        for src, dst in rnd:
+            assert src in have and dst not in have
+        have |= {d for _, d in rnd}
+    assert have == set(members)
+
+
+def test_tree_plan_default_root_and_errors():
+    assert multicast_tree_plan([5, 3, 9]) \
+        == multicast_tree_plan([3, 5, 9], root=3)
+    with pytest.raises(ValueError):
+        multicast_tree_plan([])
+    with pytest.raises(ValueError):
+        multicast_tree_plan([1, 1, 2])
+    with pytest.raises(ValueError):
+        multicast_tree_plan([1, 2], root=7)
+
+
+# -- role-namespaced membership ----------------------------------------------
+
+class KV:
+    """Thread-safe in-memory stand-in for the coordination KV store
+    (the real client's narrow surface: try_get raises on missing)."""
+
+    def __init__(self):
+        self.store = {}
+        self.lock = threading.Lock()
+
+    def key_value_set(self, k, v):
+        with self.lock:
+            self.store[k] = str(v)
+
+    def key_value_try_get(self, k):
+        with self.lock:
+            if k not in self.store:
+                raise KeyError(k)
+            return self.store[k]
+
+    def key_value_delete(self, k):
+        with self.lock:
+            self.store.pop(k, None)
+
+
+def _member(kv, rank, role="elastic", world=2, **kw):
+    kw.setdefault("settle_s", 0.05)
+    kw.setdefault("poll_s", 0.002)
+    kw.setdefault("timeout_ms", 4000)
+    return ElasticMembership(kv, rank=rank, world=world, role=role, **kw)
+
+
+def test_role_groups_are_key_disjoint():
+    """A fleet group and a training elastic group in the same store
+    never see each other's keys: intents, presence, epochs, views."""
+    kv = KV()
+    fleet0 = _member(kv, 0, role="fleet")
+    el0 = _member(kv, 0)
+    # a fleet join intent is invisible to the elastic group (and vice
+    # versa)
+    _member(kv, 1, role="fleet").announce_join()
+    _member(kv, 1).announce_leave()
+    assert el0.pending_joins() == ()
+    assert "cmn/fleet/join/1" in kv.store
+    assert "cmn/elastic/leave/1" in kv.store
+    # the elastic rank-1 LEAVE must NOT exclude fleet rank 1: both
+    # fleet members resolve and the fleet view keeps rank 1
+    out = {}
+    fleet1 = _member(kv, 1, role="fleet")
+    t = threading.Thread(target=lambda: out.setdefault(
+        1, fleet1.resolve(expect={0, 1})))
+    t.start()
+    out[0] = fleet0.resolve(expect={0, 1})
+    t.join()
+    assert out[0] == out[1]
+    assert out[0].members == (0, 1)
+    assert out[0].role == "fleet"
+    # meanwhile the elastic group's resolve honors ITS leave
+    v = el0.resolve(expect={0})
+    assert v.members == (0,) and v.role == "elastic"
+    # epochs advanced independently, and every key sits under its role
+    assert fleet0.current_epoch() == 1 and el0.current_epoch() == 1
+    assert all(k.startswith(("cmn/fleet/", "cmn/elastic/"))
+               for k in kv.store)
+
+
+def test_views_of_different_roles_never_compare_equal():
+    assert MembershipView(1, (0, 1), role="fleet") \
+        != MembershipView(1, (0, 1))
+    assert MembershipView(1, (0, 1)) == MembershipView(1, (0, 1))
+
+
+def test_leader_publishes_tree_plan_next_to_view():
+    kv = KV()
+    m0 = _member(kv, 0, role="fleet", world=3)
+    out = {}
+    others = [_member(kv, r, role="fleet", world=3) for r in (1, 2)]
+    ts = [threading.Thread(target=lambda m=m, r=r: out.setdefault(
+        r, m.resolve(expect={0, 1, 2})))
+        for r, m in zip((1, 2), others)]
+    for t in ts:
+        t.start()
+    out[0] = m0.resolve(expect={0, 1, 2})
+    for t in ts:
+        t.join()
+    assert out[0].members == (0, 1, 2)
+    assert "cmn/fleet/e1/tree" in kv.store
+    # the published plan IS the pure plan, from any member's reader
+    assert others[0].read_tree_plan(1) \
+        == multicast_tree_plan((0, 1, 2))
+    # and a reader without the key falls back to computing it
+    kv.key_value_delete("cmn/fleet/e1/tree")
+    assert m0.read_tree_plan(1) == multicast_tree_plan((0, 1, 2))
+
+
+def test_giving_up_names_the_fleet_group():
+    """ISSUE 15 small fix: a RecoveryGivingUp raised inside a
+    serving-role group names the FLEET namespace in its carried view —
+    not the training elastic one the same process may also hold."""
+    err = RecoveryGivingUp(
+        "fleet shrank below min_replicas=1",
+        membership=MembershipView(4, (0, 2), role="fleet"))
+    assert "group 'fleet'" in str(err)
+    assert "epoch 4" in str(err) and "members [0, 2]" in str(err)
+    # the training group keeps naming elastic (back-compat format)
+    err = RecoveryGivingUp(
+        "budget exhausted", membership=MembershipView(2, (0,)))
+    assert "group 'elastic'" in str(err)
+    plain = RecoveryGivingUp("budget exhausted")
+    assert "membership" not in str(plain)
+
+
+def test_membership_role_validation():
+    with pytest.raises(ValueError):
+        ElasticMembership(KV(), 0, 2, role="a/b")
+    with pytest.raises(ValueError):
+        ElasticMembership(KV(), 0, 2, role="")
+
+
+# -- router policy (fake replicas, no engines) -------------------------------
+
+class _FakeReplica:
+    remote = False
+
+    def __init__(self, rid, capacity=100):
+        self.rid = rid
+        self.live = True
+        self.capacity = capacity
+        self.q = []
+
+    def submit(self, req):
+        if len(self.q) >= self.capacity:
+            raise QueueSaturatedError(req.tenant, len(self.q),
+                                      self.capacity)
+        self.q.append(req)
+
+    def queue_depth(self, tenant=None):
+        if tenant is None:
+            return len(self.q)
+        return sum(1 for r in self.q if r.tenant == tenant)
+
+    def tenant_depths(self):
+        out = {}
+        for r in self.q:
+            out[r.tenant] = out.get(r.tenant, 0) + 1
+        return out
+
+    def busy(self):
+        return bool(self.q)
+
+
+class _FakeFleet:
+    def __init__(self, replicas):
+        self.replicas = {r.rid: r for r in replicas}
+
+    def live_replicas(self):
+        return [self.replicas[rid] for rid in sorted(self.replicas)
+                if self.replicas[rid].live]
+
+
+def _req(tenant, rid=None):
+    return Request(np.arange(1, 5, dtype=np.int32), 2, tenant=tenant,
+                   arrival_time=0.0, request_id=rid)
+
+
+def test_router_per_tenant_fair_spread_is_decorrelated():
+    fleet = _FakeFleet([_FakeReplica(0), _FakeReplica(1),
+                        _FakeReplica(2)])
+    router = FleetRouter(fleet)
+    a = [router.route(_req("a")) for _ in range(6)]
+    b = [router.route(_req("b")) for _ in range(3)]
+    # each tenant rotates over ALL live replicas (fair spread)...
+    assert a == [0, 1, 2, 0, 1, 2]
+    # ...with its own persistent cursor: tenant b starts at 0 again,
+    # not wherever tenant a's flood left the rotation
+    assert b == [0, 1, 2]
+    assert router.by_replica == {0: 3, 1: 3, 2: 3}
+
+
+def test_router_sheds_sideways_and_reraises_typed():
+    full = _FakeReplica(0, capacity=0)
+    fleet = _FakeFleet([full, _FakeReplica(1)])
+    router = FleetRouter(fleet)
+    # replica 0 saturated: the request sheds to replica 1, typed error
+    # swallowed, spill counted
+    assert router.route(_req("t")) == 1
+    assert router.spills == 1
+    # every replica saturated: the LAST typed error surfaces unchanged
+    fleet.replicas[1].capacity = 1
+    with pytest.raises(QueueSaturatedError) as e:
+        router.route(_req("t"))
+    assert e.value.tenant == "t"
+    # no live replica at all: the typed no-capacity error
+    for r in fleet.replicas.values():
+        r.live = False
+    with pytest.raises(NoLiveReplicaError):
+        router.route(_req("t"))
+
+
+def test_router_sheds_channel_dead_replica_at_ingress():
+    """A dead remote worker discovered at SUBMIT time (typed channel
+    error) must not surface to the caller while a live replica exists:
+    the router skips it for this placement and sheds it afterwards
+    (review fix — it used to stay live, charging every admission the
+    full channel deadline)."""
+    from chainermn_tpu.communicators._host_channel import (
+        ChannelTimeoutError)
+
+    class _DeadReplica(_FakeReplica):
+        remote = True
+
+        def submit(self, req):
+            raise ChannelTimeoutError("p2p", "key", 6000, 1)
+
+    class _FleetWithPreempt(_FakeFleet):
+        def __init__(self, replicas):
+            super().__init__(replicas)
+            self.preempted = []
+
+        def preempt(self, rid, exc=None, now=None):
+            self.replicas[rid].live = False
+            self.preempted.append(rid)
+
+    fleet = _FleetWithPreempt([_DeadReplica(0), _FakeReplica(1)])
+    router = FleetRouter(fleet)
+    fleet.replicas[0].router = router
+    assert router.route(_req("t")) == 1
+    assert fleet.preempted == [0]
+    assert fleet.replicas[0].live is False
+    # subsequent admissions never touch the dead handle again
+    assert router.route(_req("t")) == 1
+
+
+def test_reroute_forces_past_saturated_survivor_zero_drop():
+    """Review fix: a survivor's saturated queue must not DROP rerouted
+    in-flight work mid-replay — refused requests force front-of-line
+    (bound-exempt, the eviction-requeue discipline) and every request
+    still completes."""
+    def factory(rid):
+        eng = _make_engine(seed=0)
+        eng.scheduler.max_queue = 1    # saturate trivially
+        return eng
+
+    fleet = ReplicaFleet(engine_factory=factory, replicas=2)
+    rng = np.random.RandomState(11)
+    reqs = [Request(rng.randint(1, 97, 5).astype(np.int32), 3,
+                    tenant="t0", arrival_time=0.0, request_id=i)
+            for i in range(2)]
+    placements = [fleet.submit(r) for r in reqs]
+    assert placements == [0, 1]          # one queued per replica
+    # replica 1 dies holding its queued request; replica 0's queue is
+    # at its bound — the replay must force past it, not raise/drop
+    fleet.preempt(1, now=0.0)
+    assert fleet.reroutes == 1
+    fleet.drain(now=1.0)
+    assert sorted(r.request_id for r in fleet.completed) == [0, 1]
+
+
+def test_drain_for_reroute_requeue_stamp_clock_domains():
+    """Review fix: RUNNING requests get a requeue stamp in the
+    caller's engine-clock domain (synthetic ``now`` when given, the
+    monotonic default otherwise) so re-admission books the re-queue
+    dwell — never the prior decode time — as queue wait; queued-only
+    requests keep arrival-based accounting (no stamp)."""
+    from chainermn_tpu.serving.fleet import LocalReplica
+    engine = _make_engine(seed=0)
+    running = Request(np.arange(1, 6, dtype=np.int32), 3, tenant="t",
+                      arrival_time=0.0, request_id="run")
+    queued = Request(np.arange(1, 6, dtype=np.int32), 3, tenant="t",
+                     arrival_time=0.0, request_id="q")
+    engine.submit(running)
+    engine.step(now=0.5)                  # 'running' admitted
+    engine.submit(queued)
+    replica = LocalReplica(0, engine)
+    reqs = {r.request_id: r for r in
+            replica.drain_for_reroute(now=5.0)}
+    assert reqs["run"].requeue_time == 5.0
+    assert reqs["q"].requeue_time is None
+    # and with no caller clock, the stamp falls back to the engines'
+    # monotonic default instead of None (None would re-book the whole
+    # prior life as queue wait at re-admission)
+    engine2 = _make_engine(seed=0)
+    r2 = Request(np.arange(1, 6, dtype=np.int32), 3, tenant="t",
+                 arrival_time=0.0)
+    engine2.submit(r2)
+    engine2.step()
+    out = LocalReplica(1, engine2).drain_for_reroute()
+    assert out[0].requeue_time is not None
+
+
+def test_router_exclude_and_ledger():
+    fleet = _FakeFleet([_FakeReplica(0), _FakeReplica(1)])
+    router = FleetRouter(fleet)
+    req = _req("t", rid="r-1")
+    assert router.route(req, exclude=(0,)) == 1
+    assert router.ledger["r-1"] == 1
+    assert router.placements(1) == ("r-1",)
+    assert router.rerouted == 0
+    router.route(_req("t", rid="r-2"), exclude=(1,), reroute=True)
+    assert router.rerouted == 1
+
+
+# -- scale policy off the registry gauges ------------------------------------
+
+def test_queue_depth_scale_policy_reads_registry_gauges():
+    reg = observability.registry()
+    policy = QueueDepthScalePolicy(scale_up_depth=8, scale_down_depth=0,
+                                   min_replicas=1, max_replicas=4)
+    # no gauge yet: hold
+    assert policy.decide(reg, 2) == 0
+    g = reg.gauge(QueueDepthScalePolicy.GAUGE)
+    g.set(3, tenant="a")
+    g.set(9, tenant="b")          # one tenant's backlog over the bound
+    assert policy.decide(reg, 2) == 1
+    assert policy.decide(reg, 4) == 0     # at max_replicas: hold
+    g.set(0, tenant="a")
+    g.set(0, tenant="b")
+    assert policy.decide(reg, 2) == -1    # everyone idle: shrink
+    assert policy.decide(reg, 1) == 0     # at min_replicas: hold
+
+
+# -- the fleet arc on real engines (tiny: the tier-1 compile budget) ---------
+
+def _make_engine(seed=0):
+    import jax.numpy as jnp  # noqa: F401 (cpu backend pinned by conftest)
+    from chainermn_tpu.models import TransformerLM
+    model = TransformerLM(n_vocab=97, d_model=32, n_heads=1, n_layers=1,
+                          max_len=32, seed=seed)
+    return ServingEngine(model, num_pages=32, page_size=16, max_batch=2,
+                         max_context=32, prefix_cache=False)
+
+
+def _state_leaves(engine):
+    import jax
+    return [np.asarray(x) for x in jax.tree.leaves(engine.state)]
+
+
+def test_fleet_arc_kill_join_parity():
+    """The scripted-membership tier-1 acceptance arc: kill one of two
+    replicas under seeded open-loop load → zero dropped requests and
+    every request completes with its solo-run trajectory (rerouted
+    sequences replay from their prompts); join a third (cold, different
+    seed) replica → bit-identical weights via the tree plan and the
+    router spreads new admissions to it; losing the last replica gives
+    up TYPED naming the fleet group."""
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 97, rng.randint(4, 9)).astype(np.int32)
+               for _ in range(6)]
+    fleet = ReplicaFleet(engine_factory=lambda rid: _make_engine(seed=0),
+                         replicas=2)
+    assert fleet.view.role == "fleet"
+    reqs = [Request(p, 4, tenant=f"t{i % 2}", arrival_time=0.0,
+                    request_id=i) for i, p in enumerate(prompts)]
+    placements = [fleet.submit(r) for r in reqs]
+    assert set(placements) == {0, 1}          # load spread over both
+    epoch0 = fleet.view.epoch
+    fleet.replicas[1].kill_at = 1             # seeded kill under load
+    fleet.drain(now=1.0)
+
+    # zero drops: every submitted request completed, exactly once
+    assert sorted(r.request_id for r in fleet.completed) \
+        == list(range(6))
+    assert fleet.sheds == 1 and fleet.reroutes >= 1
+    assert fleet.view.epoch > epoch0
+    rerouted = [r for r in fleet.completed if r.preemptions > 0]
+    assert rerouted, "the kill must have caught in-flight sequences"
+
+    # solo-run trajectory parity: each request's generated sequence
+    # (fold-surviving prompt suffix + final tokens) equals the solo run
+    golden = _make_engine(seed=0)
+    for i, req in enumerate(sorted(fleet.completed,
+                                   key=lambda r: r.request_id)):
+        generated = list(req.prompt[len(prompts[req.request_id]):]) \
+            + list(req.tokens)
+        g = Request(prompts[req.request_id], 4, tenant="g",
+                    arrival_time=0.0)
+        golden.submit(g)
+        golden.drain(now=1.0)
+        solo = golden.completed[-1].tokens
+        assert generated == solo, (req.request_id, generated, solo)
+
+    # join a COLD replica built with different seed weights: the tree
+    # sync must land it bit-identical to the root survivor
+    joiner = _make_engine(seed=123)
+    root_leaves = _state_leaves(fleet.replicas[0].engine)
+    assert any((a != b).any() for a, b in
+               zip(_state_leaves(joiner), root_leaves))
+    new_ids = fleet.join(engines={2: joiner})
+    assert new_ids == [2]
+    assert fleet.weight_syncs == 1
+    assert fleet.weight_sync_rounds == 1     # 1 joiner: ceil(log2 2)
+    assert fleet.weight_sync_bytes > 0
+    assert fleet.weight_sync_s >= 0.0
+    assert all((a == b).all() for a, b in
+               zip(_state_leaves(joiner), root_leaves))
+
+    # the router spreads NEW admissions onto the joiner
+    more = [Request(rng.randint(1, 97, 4).astype(np.int32), 2,
+                    tenant="t0", arrival_time=0.0,
+                    request_id=100 + i) for i in range(4)]
+    new_placements = [fleet.submit(r) for r in more]
+    assert 2 in new_placements
+    fleet.drain(now=2.0)
+    assert sorted(r.request_id for r in fleet.completed
+                  if r.request_id >= 100) == [100, 101, 102, 103]
+
+    # registry gauges published for the scale policy (trace-off)
+    reg = observability.registry()
+    assert reg.gauge("chainermn_tpu_fleet_replicas").value() == 2
+    assert reg.counter("chainermn_tpu_fleet_reroutes_total").value() \
+        == fleet.reroutes
+
+    # shrink to nothing: typed give-up carrying the FLEET-role view
+    fleet.preempt(0)
+    with pytest.raises(RecoveryGivingUp) as e:
+        fleet.preempt(2)
+    assert "group 'fleet'" in str(e.value)
+    assert e.value.membership.role == "fleet"
+
+
+def test_fleet_off_hatch_is_single_engine(monkeypatch):
+    """CHAINERMN_TPU_FLEET=off: the fleet clamps to ONE replica (the
+    factory is called once), every admission routes to it, and join()
+    refuses typed — single-engine serving, exactly the PR 13 shape."""
+    monkeypatch.setenv("CHAINERMN_TPU_FLEET", "off")
+    assert fleet_mode() is False
+    assert fleet_mode(True) is False          # the hatch wins
+    calls = []
+
+    def factory(rid):
+        calls.append(rid)
+        return _make_engine(seed=0)
+
+    fleet = ReplicaFleet(engine_factory=factory, replicas=3)
+    assert calls == [0]
+    reqs = [Request(np.arange(1, 6, dtype=np.int32), 2, tenant="t",
+                    arrival_time=0.0) for _ in range(3)]
+    assert [fleet.submit(r) for r in reqs] == [0, 0, 0]
+    with pytest.raises(RecoveryGivingUp) as e:
+        fleet.join(engines={1: _make_engine(seed=1)})
+    assert "CHAINERMN_TPU_FLEET=off" in str(e.value)
+    fleet.drain(now=1.0)
+    assert len(fleet.completed) == 3
+    monkeypatch.delenv("CHAINERMN_TPU_FLEET")
+    assert fleet_mode() is True
+    assert fleet_mode(False) is False
+
+
+def test_fleet_step_surfaces_scale_decision():
+    """The policy's decision rides step() stats (the fleet never grows
+    itself — capacity is granted through join/retire)."""
+    fleet = ReplicaFleet(engine_factory=lambda rid: _make_engine(seed=0),
+                         replicas=1,
+                         scale_policy=QueueDepthScalePolicy(
+                             scale_up_depth=2, max_replicas=4))
+    # back the queue up past the bound: submit more than one step admits
+    for i in range(8):
+        fleet.submit(Request(np.arange(1, 5, dtype=np.int32), 2,
+                             tenant="t", arrival_time=10.0 + i))
+    st = fleet.step(now=0.0)   # nothing eligible yet: queues deep
+    assert st["scale_decision"] == 1
+    fleet.drain(now=20.0)
+    st = fleet.step(now=30.0)
+    assert st["scale_decision"] in (-1, 0)

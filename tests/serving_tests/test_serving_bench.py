@@ -132,6 +132,12 @@ def test_cpu_smoke_is_clamped_labeled_and_retrace_free(tmp_path):
                 "prefix_matched_tokens", "effective_capacity_x",
                 "forks", "disagg", "transferred_page_bytes", "tp"):
         assert key in row, key
+    # round-16 fleet columns are present on EVERY serving row with the
+    # single-engine defaults backfilled (ISSUE 15 satellite: row
+    # consumers never key-miss on fleet-less rows)
+    assert row["replicas"] == 1
+    assert row["reroutes"] == 0
+    assert row["weight_sync_s"] == 0.0
     # the chat-shaped load (per-tenant shared system prompts, the
     # default) must actually HIT: measured sharing economics, not
     # zero-filled columns (the ISSUE 13 acceptance pin)
@@ -143,3 +149,65 @@ def test_cpu_smoke_is_clamped_labeled_and_retrace_free(tmp_path):
     assert not os.path.exists(tmp_path / "repo.json")
     # and a CPU run never stamps the serving prewarm sentinel
     assert not os.path.exists(str(tmp_path / "prewarm") + ".serving")
+
+
+def test_fleet_rows_are_fenced_and_knobs_defeat_flagship(monkeypatch):
+    """ISSUE 15 satellite: (env half) the fleet knobs defeat BOTH
+    flagship fingerprints — a multi-replica or kill-under-load run can
+    never be cached as training throughput; (payload half) a fleet
+    serving row is metric-fenced like every serving row."""
+    from tests.test_bench_harness import TPU_RESULT
+    for knob, value in (("BENCH_SERVE_REPLICAS", "2"),
+                        ("BENCH_FLEET_KILL_AT", "6")):
+        monkeypatch.setenv(knob, value)
+        assert not bench._cacheable(TPU_RESULT), knob
+        monkeypatch.delenv(knob)
+    assert bench._cacheable(TPU_RESULT)
+    # legacy fingerprints backfill the fleet-less defaults (a stored
+    # pre-round-16 flagship entry stays servable)
+    assert bench._backfill_fp("resnet50", {})["serve_replicas"] == 1
+    assert bench._backfill_fp("transformer", {})["fleet_kill_at"] == -1
+    # a fleet row (serving metric) is refused on every cache path
+    fleet_row = dict(SERVING_ROW, replicas=2, reroutes=5,
+                     weight_sync_s=0.8)
+    assert bench._cacheable(fleet_row) is False
+
+
+def test_cpu_smoke_fleet_kill_reroutes_with_zero_drops(tmp_path):
+    """End-to-end subprocess, fleet leg (ISSUE 15): 2 replicas behind
+    the router, the highest killed at decode step 3 — the row carries
+    replicas/reroutes/weight_sync_s with the kill actually fired (zero
+    dropped requests: completed == requests), stays labeled cpu_smoke,
+    and never touches the caches."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NO_SUPERVISE="1",
+               BENCH_MODEL="serving",
+               BENCH_SERVE_REQUESTS="64",      # clamps to 12
+               BENCH_SERVE_QPS="200",
+               BENCH_SERVE_TENANTS="3",
+               BENCH_SERVE_REPLICAS="2",
+               BENCH_FLEET_KILL_AT="3",
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo.json"),
+               BENCH_PREWARM_SENTINEL=str(tmp_path / "prewarm"),
+               BENCH_START_STAMP=str(tmp_path / "started"),
+               BENCH_DEADLINE_S="480")
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=420, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_engine_throughput"
+    assert row["cpu_smoke"] is True
+    assert row["replicas"] == 2
+    assert row["fleet_kill_at"] == 3
+    # the kill fired under load: in-flight sequences rerouted, none
+    # dropped, and a cold replica joined via the tree sync
+    assert row["reroutes"] > 0
+    assert row["weight_sync_s"] > 0.0
+    assert row["completed"] == row["requests"] == 12
+    assert row["value"] and row["value"] > 0
+    # the initial replicas' measured window stays retrace-free (the
+    # joiner's cold compiles are the join's cost, not the window's)
+    assert row["window_retraces"] == 0
+    assert not os.path.exists(tmp_path / "cache.json")
+    assert not os.path.exists(tmp_path / "repo.json")

@@ -1087,6 +1087,32 @@ def test_resize_invalidates_flagship_cache(monkeypatch):
     assert bench._payload_flagship_ok("resnet50", TPU_RESULT)
 
 
+def test_fleet_knobs_invalidate_flagship_cache(monkeypatch):
+    """ISSUE 15 satellite: the serving-fleet knobs (BENCH_SERVE_REPLICAS
+    / BENCH_FLEET_KILL_AT) are fingerprint knobs on BOTH flagship
+    models — a fleet measurement regime can never be cached or
+    re-served as flagship data, and legacy entries backfill the
+    fleet-less defaults (backfill-safe schema bump)."""
+    monkeypatch.setenv("BENCH_SERVE_REPLICAS", "2")
+    assert bench._config_fingerprint("resnet50")["serve_replicas"] == 2
+    assert bench._config_fingerprint("transformer")["serve_replicas"] \
+        == 2
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_SERVE_REPLICAS", raising=False)
+    monkeypatch.setenv("BENCH_FLEET_KILL_AT", "40")
+    assert bench._config_fingerprint("resnet50")["fleet_kill_at"] == 40
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_FLEET_KILL_AT", raising=False)
+    assert bench._cacheable(TPU_RESULT)
+    # backfill: a stored pre-round-16 fingerprint gains the defaults
+    for model in ("resnet50", "transformer"):
+        fp = dict(bench._DEFAULT_FINGERPRINTS[model])
+        fp.pop("serve_replicas")
+        fp.pop("fleet_kill_at")
+        assert bench._backfill_fp(model, fp) \
+            == bench._DEFAULT_FINGERPRINTS[model]
+
+
 def test_compile_credit_math(tmp_path):
     """The supervisor's deadline extension: recorded compile seconds,
     plus the in-flight phase's elapsed time, capped at grace, zero for

@@ -38,7 +38,7 @@ MODULES = {
     "chainermn_tpu.communicators": [
         "ElasticMembership", "MembershipView", "ElasticMeshCommunicator",
         "RankPreempted", "FaultSchedule", "FaultSpec",
-        "FaultInjectionCommunicator"],
+        "FaultInjectionCommunicator", "multicast_tree_plan"],
     "chainermn_tpu.parallel": [
         "ring_self_attention", "ring_attention", "ulysses_attention",
         "gpipe_apply", "one_f_one_b", "make_pipeline_train_step",
@@ -50,7 +50,15 @@ MODULES = {
         "ServingEngine", "Request", "RequestScheduler", "BlockAllocator",
         "PagedKVCache", "prefill_program", "decode_program",
         "write_prompt_kv", "write_token_kv", "ServingError",
-        "PagePoolExhaustedError", "QueueSaturatedError"],
+        "PagePoolExhaustedError", "QueueSaturatedError",
+        # round 16 (elastic serving fleet, docs/serving.md)
+        "ReplicaFleet", "FleetRouter", "FleetWorker", "RemoteReplica",
+        "QueueDepthScalePolicy", "fleet_mode", "NoLiveReplicaError"],
+    # round 16: the fleet module itself is a documented import surface
+    "chainermn_tpu.serving.fleet": [
+        "ReplicaFleet", "LocalReplica", "RemoteReplica", "FleetWorker",
+        "QueueDepthScalePolicy", "fleet_mode", "serialize_state",
+        "deserialize_state", "FLEET_ENV", "FLEET_ROLE"],
     "chainermn_tpu.models": [
         "MLP", "Classifier", "ResNet18", "ResNet50", "ResNet101",
         "AlexNet", "NIN", "VGG16", "GoogLeNet", "Seq2seq",

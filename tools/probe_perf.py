@@ -772,6 +772,41 @@ def probe_serving():
             * g["page_size"] * H * D * kv_itemsize,
             "targets_status": budgets["targets"]["status"]}), flush=True)
 
+    # -- fleet table (ISSUE 15): a tiny live 2-replica fleet, one
+    # replica preempted mid-load — one row per replica seat showing the
+    # router's view (live, queue depth) and the reroute counters the
+    # chaos gate pins.  Chip-free like the rest of the probe.
+    import numpy as np
+
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import ReplicaFleet, Request, ServingEngine
+
+    def _engine(_rid):
+        model = TransformerLM(n_vocab=97, d_model=32, n_heads=1,
+                              n_layers=1, max_len=32, seed=0)
+        return ServingEngine(model, num_pages=32, page_size=16,
+                             max_batch=2, max_context=32,
+                             prefix_cache=False)
+
+    fleet = ReplicaFleet(engine_factory=_engine, replicas=2)
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        fleet.submit(Request(rng.randint(1, 97, 6).astype(np.int32), 3,
+                             tenant=f"t{i % 2}", arrival_time=0.0))
+    fleet.step(now=1.0)
+    fleet.preempt(1)
+    fleet.drain(now=2.0)
+    for rid in sorted(fleet.replicas):
+        rep = fleet.replicas[rid]
+        print(json.dumps({
+            "probe": "serving_fleet", "replica": rid,
+            "live": rep.live, "queue_depth": rep.queue_depth(),
+            "routed": fleet.router.by_replica.get(rid, 0),
+            "reroutes": fleet.reroutes,
+            "completed": len(fleet.completed),
+            "epoch": fleet.view.epoch, "role": fleet.view.role}),
+            flush=True)
+
 
 def probe_obs():
     """PROBE=obs: the runtime observability join (ISSUE 14).

@@ -61,6 +61,18 @@
 #     trace=events in their fingerprint and are never flagship-cacheable
 #     by construction, so they cannot contaminate the last-good cache.
 #
+#  9. serving-fleet kill-under-load A/B (ISSUE 15): the 2-replica
+#     BENCH_SERVE_REPLICAS=2 BENCH_FLEET_KILL_AT=40 serving row below (one
+#     replica preempted mid-load, in-flight sequences rerouted with zero
+#     drops, a cold replica re-joined via the multicast-tree weight sync)
+#     vs the single-replica flagship serving row, PLUS the 2-replica gloo
+#     `bench_scaling --fleet-kill` A/B curve (uninterrupted vs
+#     kill-and-rejoin over real process boundaries).  STAMP the
+#     detection-bounded p99 spike (`p99_spike_ms_vs_baseline` — must be
+#     bounded by the committed 6 s typed detection deadline + replay) and
+#     the `weight_sync_s` tree-sync cost in BENCH_NOTES.  Fleet rows are
+#     fingerprint- AND metric-fenced out of the flagship cache.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
@@ -241,6 +253,17 @@ run_one "serving disaggregated prefill/decode qps64 (A/B vs single-mesh)" \
   BENCH_DEADLINE_S=900
 run_one "serving tp=2 paged decode (A/B vs single-chip)" \
   BENCH_MODEL=serving BENCH_SERVE_TP=2 BENCH_DEADLINE_S=900
+# ISSUE 15: the serving-fleet kill-under-load A/B — 2 replicas behind
+# the router, the highest preempted at decode step 40 under the
+# flagship open-loop load: its in-flight sequences reroute to the
+# survivor (zero drops — `completed == requests` in the row) and a
+# cold replica joins via the multicast-tree weight sync.  Deltas vs
+# the flagship serving row = the fleet's steady-state routing cost and
+# the kill's detection-bounded p99 spike; `weight_sync_s` is the
+# tree-sync cost.  Fleet rows are fenced out of the flagship cache.
+run_one "serving fleet 2 replicas kill@40 (A/B: reroute + tree sync)" \
+  BENCH_MODEL=serving BENCH_SERVE_REPLICAS=2 BENCH_FLEET_KILL_AT=40 \
+  BENCH_DEADLINE_S=900
 # ISSUE 12: the MoE dispatch A/B — the Switch-FFN expert-parallel
 # vertical under the flat single-axis dispatch, the two-stage ici×dcn
 # dispatch on the forced 2x4 split, and the two-stage dispatch with
@@ -323,6 +346,13 @@ stepf=$STEPDIR/step_commab.log
   # detection + two membership resolves + two rebuilds + snapshot sync
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 60 \
     --preempt-rank 1
+  # ISSUE 15: the >=2-host serving-fleet A/B — one FleetWorker replica
+  # per extra process over the REAL host channel; the kill leg preempts
+  # the worker replica at decode step 2 (typed-timeout detection,
+  # zero-drop replay on the survivor, multicast-tree rejoin); the
+  # summary line's p99 spike vs the uninterrupted leg is the
+  # detection-bounded number checklist item 9 stamps
+  python bench_scaling.py --gloo-procs 1,2 --fleet-kill 2
 } > "$stepf" 2>&1 || true
 cat "$stepf"
 if grep -q '^{' "$stepf"; then
