@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos chaos-elastic chaos-fleet bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
+.PHONY: test chaos chaos-elastic chaos-fleet chaos-convert bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -35,6 +35,17 @@ chaos-fleet:      ## serving-fleet kill-a-replica E2E (2-process gloo)
 	@# the router spreads new admissions to it.  Chaos-marked (tier-1
 	@# runs it too; this target is the focused repro loop).
 	$(PY) -m pytest tests/multiprocess_tests/test_fleet_chaos.py -q -m chaos
+
+chaos-convert:    ## capacity-transfer E2E (2-process gloo)
+	@# ISSUE 16 acceptance: a seeded preempt kills a training->serving
+	@# conversion mid-flight -> the survivor's recover_orphans sweep
+	@# aborts the orphan through the real KV journal and the rank
+	@# rejoins training; then queue pressure trips the hysteresis +1,
+	@# the CapacityBroker converts the rank into a serving replica
+	@# (bit-identical tree weight sync), the fleet drains with ZERO
+	@# drops, the -1 retires it back into training.  Chaos-marked
+	@# (tier-1 runs it too; this target is the focused repro loop).
+	$(PY) -m pytest tests/multiprocess_tests/test_capacity_chaos.py -q -m chaos
 
 bench:            ## real-hardware benchmark (one JSON line)
 	$(PY) bench.py

@@ -83,7 +83,17 @@ a variant that is excluded from the last-good cache):
                 decode step at which the highest replica preempts;
                 its in-flight sequences reroute with zero drops and a
                 cold replica joins via the multicast-tree weight
-                sync) — serving (continuous-batching engine under a
+                sync), BENCH_DIURNAL (0|1: sinusoidal arrival rate
+                plus a CapacityBroker auto-applying the hysteresis
+                policy's +1/-1 as REAL training<->serving role
+                transfers — rows grow conversions/role_transfers/
+                convert_s and are payload-fenced from the flagship
+                cache), BENCH_DIURNAL_PERIOD (8.0 s),
+                BENCH_DIURNAL_AMP (0.8), BENCH_DIURNAL_WORLD (2:
+                synthetic training ranks eligible to convert),
+                BENCH_DIURNAL_UP (8) / BENCH_DIURNAL_DOWN (0:
+                queue-depth water marks) — serving (continuous-batching
+                engine under a
                 seeded open-loop Poisson load: tokens/sec + p50/p99
                 per-token latency + page-pool occupancy +
                 prefix_hit_rate / effective_capacity_x /
@@ -394,7 +404,8 @@ _DEFAULT_FINGERPRINTS = {
                  "stripe_ratio": 0,
                  "grad_dtype": "bfloat16", "error_feedback": True,
                  "preempt_rank": -1, "trace": "off",
-                 "serve_replicas": 1, "fleet_kill_at": -1},
+                 "serve_replicas": 1, "fleet_kill_at": -1,
+                 "diurnal": False, "diurnal_period": 0.0},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -406,7 +417,8 @@ _DEFAULT_FINGERPRINTS = {
                     "stripe_ratio": 0,
                     "grad_dtype": "bfloat16", "error_feedback": True,
                     "preempt_rank": -1, "trace": "off",
-                    "serve_replicas": 1, "fleet_kill_at": -1},
+                    "serve_replicas": 1, "fleet_kill_at": -1,
+                    "diurnal": False, "diurnal_period": 0.0},
 }
 
 def _env_float(name, default):
@@ -498,6 +510,12 @@ def _config_fingerprint(model=None):
             # rows are metric-fenced anyway; this closes the env half)
             "serve_replicas": _env_int("BENCH_SERVE_REPLICAS", 1),
             "fleet_kill_at": _env_int("BENCH_FLEET_KILL_AT", -1),
+            # the diurnal capacity-transfer scenario (ISSUE 16): a
+            # sinusoidal-QPS run with the broker moving ranks between
+            # training and serving measures a TWO-ROLE world — a
+            # measurement, never flagship data
+            "diurnal": os.environ.get("BENCH_DIURNAL", "0") == "1",
+            "diurnal_period": _env_float("BENCH_DIURNAL_PERIOD", 0),
         }
     return {
         "model": "resnet50",
@@ -521,6 +539,8 @@ def _config_fingerprint(model=None):
         "trace": os.environ.get("CHAINERMN_TPU_TRACE", "off"),
         "serve_replicas": _env_int("BENCH_SERVE_REPLICAS", 1),
         "fleet_kill_at": _env_int("BENCH_FLEET_KILL_AT", -1),
+        "diurnal": os.environ.get("BENCH_DIURNAL", "0") == "1",
+        "diurnal_period": _env_float("BENCH_DIURNAL_PERIOD", 0),
     }
 
 
@@ -562,6 +582,11 @@ def _payload_flagship_ok(model, result):
         # a mid-run communicator resize (elastic shrink/grow, ISSUE 10)
         # changes the measured world mid-row — never flagship data
         # (legacy rows lack the key and were fixed-size by construction)
+        return False
+    if result.get("conversions") or result.get("role_transfers"):
+        # a capacity transfer (ISSUE 16): ranks changed ROLE mid-row —
+        # the measured world was part training, part serving; never
+        # flagship data (legacy rows lack the keys: no broker existed)
         return False
     if result.get("exchange", "flat") != "flat":
         # bucketed/reduce_scatter/per_leaf legs compile a different
@@ -1757,6 +1782,19 @@ def _run_bench_serving():
     if not _fleet_mode():
         replicas = 1   # CHAINERMN_TPU_FLEET=off: single-engine hatch
     fleet_kill_at = _env_int("BENCH_FLEET_KILL_AT", -1)
+    # round-17 diurnal scenario (ISSUE 16): BENCH_DIURNAL=1 modulates
+    # the arrival rate sinusoidally — λ(t) = qps·(1 + amp·sin(2πt/T))
+    # — and runs a CapacityBroker over a synthetic training group next
+    # to the fleet: the peak trips the hysteresis policy's +1 and a
+    # training rank CONVERTS into a serving replica; the trough trips
+    # the -1 and it retires back.  The row's conversions /
+    # role_transfers / convert_s columns measure the transfers.
+    diurnal = os.environ.get("BENCH_DIURNAL", "0") == "1"
+    if not _fleet_mode():
+        diurnal = False   # no fleet to grow: nothing to convert into
+    diurnal_period = _env_float("BENCH_DIURNAL_PERIOD", 8.0)
+    diurnal_amp = _env_float("BENCH_DIURNAL_AMP", 0.8)
+    diurnal_world = max(2, _env_int("BENCH_DIURNAL_WORLD", 2))
     d_model = _env_int("BENCH_D_MODEL", 256)
     n_layers = _env_int("BENCH_LAYERS", 4)
     n_vocab = _env_int("BENCH_VOCAB", 8192)
@@ -1791,15 +1829,37 @@ def _run_bench_serving():
                              prefix_cache=prefix_len > 0, disagg=disagg,
                              tp=tp)
 
-    if replicas > 1:
+    broker = None
+    if replicas > 1 or diurnal:
         from chainermn_tpu.serving import ReplicaFleet
+        scale_policy = None
+        if diurnal:
+            from chainermn_tpu.serving.fleet import QueueDepthScalePolicy
+            scale_policy = QueueDepthScalePolicy(
+                scale_up_depth=_env_float("BENCH_DIURNAL_UP", 8),
+                scale_down_depth=_env_float("BENCH_DIURNAL_DOWN", 0),
+                min_replicas=1,
+                max_replicas=replicas + diurnal_world - 1)
         fleet = ReplicaFleet(engine_factory=_build_engine,
-                             replicas=replicas)
+                             replicas=replicas,
+                             scale_policy=scale_policy)
         if fleet_kill_at >= 0:
             # seeded kill-under-load: the HIGHEST replica preempts at
             # that decode step (deterministic — the same discipline as
             # the elastic BENCH_PREEMPT_RANK leg)
             fleet.replicas[max(fleet.replicas)].kill_at = fleet_kill_at
+        if diurnal:
+            # the diurnal scenario's training side is synthetic (this
+            # is a single-host bench): diurnal_world ranks sit in a
+            # LocalTrainGroup and the broker EXECUTES the policy's
+            # decisions as real role transfers — the converted rank's
+            # engine joins through the same tree-sync path a gloo
+            # fleet uses, its compiles landing as conversion cost
+            from chainermn_tpu.elastic import (CapacityBroker,
+                                               LocalTrainGroup)
+            broker = CapacityBroker(LocalTrainGroup(world=diurnal_world),
+                                    fleet, engine_factory=_build_engine,
+                                    min_world=1)
         target = fleet
         engines = [r.engine for r in fleet.live_replicas()]
     else:
@@ -1818,7 +1878,16 @@ def _run_bench_serving():
     def synth_requests(n, t0):
         reqs, t = [], t0
         for _ in range(n):
-            t += rng.exponential(1.0 / qps)
+            lam = qps
+            if diurnal:
+                # sinusoidal day: λ(t) = qps·(1 + amp·sin(2πt/T)),
+                # floored so the trough still trickles arrivals — the
+                # peak builds the queue that trips the +1, the trough
+                # drains it for the -1
+                lam = max(qps * 0.05,
+                          qps * (1.0 + diurnal_amp * np.sin(
+                              2.0 * np.pi * t / diurnal_period)))
+            t += rng.exponential(1.0 / lam)
             ten = rng.randint(tenants)
             tail = rng.randint(
                 0, n_vocab,
@@ -1856,6 +1925,13 @@ def _run_bench_serving():
         if _remaining() < 20:
             break  # cooperative: report the partial window honestly
         st = target.step(now=time.monotonic() - base)
+        if broker is not None and st.get("scale_decision"):
+            # auto-apply INSIDE the loop: the -1 fires mid-drain (the
+            # hysteresis policy disarms after answering, and a
+            # post-drain read returns 0) so the decision must be
+            # executed the step it surfaces
+            broker.apply(st["scale_decision"],
+                         now=time.monotonic() - base)
         if fleet is not None and fleet.sheds and not joined:
             # scale back after the kill: a COLD replica joins mid-load
             # and syncs weights over the multicast tree — weight_sync_s
@@ -1965,6 +2041,18 @@ def _run_bench_serving():
         "weight_sync_s": round(fleet.weight_sync_s, 3)
         if fleet is not None else 0.0,
         "fleet_kill_at": fleet_kill_at if fleet is not None else -1,
+        # round-17 capacity surface (ISSUE 16): present on EVERY
+        # serving row (broker-less rows backfill zeros); any non-zero
+        # conversions/role_transfers payload-fences the row from the
+        # flagship cache — the measured world changed ROLE mid-window
+        "conversions": broker.stats["conversions"]
+        if broker is not None else 0,
+        "role_transfers": broker.stats["role_transfers"]
+        if broker is not None else 0,
+        "convert_s": round(broker.stats["convert_s"], 3)
+        if broker is not None else 0.0,
+        "diurnal": diurnal,
+        "diurnal_period": diurnal_period if diurnal else 0.0,
     }
     if cpu_smoke:
         # labeled loudly: mechanics smoke, not a serving measurement

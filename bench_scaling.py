@@ -626,6 +626,261 @@ def _run_fleet_ab(nprocs, n_requests, kill_step):
     return rows
 
 
+def _gloo_capacity_worker(pid, nprocs, port, n_requests, convert):
+    """One process of the capacity-transfer A/B (ISSUE 16).  BOTH legs
+    train the same data-parallel MLP over real gloo transport and serve
+    the same open-loop burst from process 0 — they differ only in what
+    the cluster does with rank 1 during the burst.  Baseline
+    (``convert=0``): rank 1 keeps training (full world) and ONE replica
+    serves.  Capacity leg (``convert=1``): queue pressure trips the
+    hysteresis policy's +1 and the :class:`CapacityBroker` converts
+    rank 1 into a second replica over the REAL KV membership +
+    multicast tree (training continues at world 1 on rank 0's data
+    shard), the drained queues trip the -1 and rank 1 retires back
+    into training.  Both legs run the SAME total optimizer-step count
+    and end with a root-0 param resync (the rejoin's state sync), so
+    the runner can gate final-loss parity."""
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from chainermn_tpu.communicators._communication_utility import (
+        initialize_distributed)
+    assert initialize_distributed(f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import ElasticMembership
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.elastic import CapacityBroker
+    from chainermn_tpu.models import MLP, Classifier, TransformerLM
+    from chainermn_tpu.serving import (FleetWorker, RemoteReplica,
+                                       ReplicaFleet, Request,
+                                       ServingEngine)
+    from chainermn_tpu.serving.fleet import QueueDepthScalePolicy
+
+    CAP_TAG = 7003
+    T_JOINT_IN, T_STINT, T_JOINT_OUT = 4, 6, 6
+    comm = ct.create_communicator("jax_ici")
+    ch = comm._host_channel()
+    ch._timeout_ms = 30_000   # solo-step compiles pause the pump loop
+    kv = ch._client
+    train_mem = ElasticMembership(kv, rank=pid, world=nprocs,
+                                  role="elastic",
+                                  settle_s=2.0 if pid == 0 else 0.5,
+                                  poll_s=0.02, timeout_ms=90_000)
+    fleet_mem = ElasticMembership(kv, rank=pid, world=nprocs,
+                                  role="fleet",
+                                  settle_s=2.0 if pid == 0 else 0.5,
+                                  poll_s=0.02, timeout_ms=90_000)
+
+    rng = np.random.RandomState(0)
+    # a SMOOTH training problem (large batch, learnable labels): the
+    # parity gate compares the two legs' final loss, so the landscape
+    # must not be a memorization cliff where any trajectory split
+    # explodes the relative delta
+    gbs = 128 * nprocs
+    x = rng.normal(0, 1, (gbs, 64)).astype(np.float32)
+    w_true = rng.normal(0, 1, (64, 10)).astype(np.float32)
+    t = np.argmax(x @ w_true, axis=1).astype(np.int32)
+    model = Classifier(MLP(n_units=64, n_out=10, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.05, momentum=0.9), comm).setup(model)
+
+    # the convertible rank's engine seeds DIFFERENT weights (seed=pid):
+    # the tree sync must overwrite them from replica 0
+    serve_model = TransformerLM(n_vocab=257, d_model=32, n_heads=1,
+                                n_layers=1, max_len=32, seed=pid)
+    engine = ServingEngine(serve_model, num_pages=64, page_size=8,
+                           max_batch=4, max_context=32,
+                           prefix_cache=False)
+
+    for _ in range(T_JOINT_IN):
+        opt.update(model, x, t)
+
+    if pid != 0:
+        msg = ch.recv_obj(0, tag=CAP_TAG)
+        if msg == ("stint",):   # baseline: keep training at full world
+            for _ in range(T_STINT):
+                opt.update(model, x, t)
+        else:                   # capacity leg: become a serving replica
+            assert msg == ("convert",), msg
+            fleet_mem.announce_join(note="capacity transfer")
+            fview = fleet_mem.resolve(expect=set(range(nprocs)),
+                                      require={0})
+            worker = FleetWorker(engine, ch, membership=fleet_mem,
+                                 router_process=0)
+            worker.sync_weights(fview, joiners=(pid,))
+            outcome = worker.serve()   # until the retire stops us
+            assert outcome == "stopped", outcome
+            train_mem.announce_join(note="capacity transfer: rejoin")
+            train_mem.resolve(expect=set(range(nprocs)), require={0})
+        comm.bcast_data(model)  # root-0 resync (the rejoin's state
+        #                         sync; an idempotent no-op baseline)
+        for _ in range(T_JOINT_OUT):
+            opt.update(model, x, t)
+        return
+
+    # -- process 0: router + replica 0 + the broker --------------------------
+    policy = QueueDepthScalePolicy(scale_up_depth=2, scale_down_depth=0,
+                                   min_replicas=1, max_replicas=2)
+    fleet = ReplicaFleet(engines={0: engine}, membership=fleet_mem,
+                         min_replicas=1,
+                         scale_policy=policy if convert else None)
+    broker = CapacityBroker(
+        train_mem, fleet,
+        engine_factory=lambda r: RemoteReplica(r, ch, r),
+        min_world=1) if convert else None
+
+    srng = np.random.RandomState(3)
+    reqs = [Request(srng.randint(1, 257, 8).astype(np.int32), 4,
+                    tenant=f"t{i % 2}", arrival_time=0.0, request_id=i)
+            for i in range(n_requests)]
+    submit_wall = {}
+    t0 = _time.monotonic()
+    for r in reqs:
+        fleet.submit(r)
+        submit_wall[r.request_id] = _time.monotonic()
+
+    if convert:
+        st = fleet.step()
+        assert st["scale_decision"] == 1, st
+        ch.send_obj(("convert",), 1, tag=CAP_TAG)
+        # wait for the worker's fleet join intent so the admission
+        # resolve can never settle without it
+        deadline = _time.monotonic() + 60
+        while fleet_mem._try_get(f"{fleet_mem._base}/join/1") is None \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        res = broker.apply(st["scale_decision"])
+        assert res == ("convert", 1), res
+    else:
+        ch.send_obj(("stint",), 1, tag=CAP_TAG)
+
+    # the stint: training continues WHILE the burst is served —
+    # baseline at full world (rank 1 in lockstep), capacity leg at
+    # world 1 on rank 0's own data shard (rank 1 is busy serving)
+    decision = 0
+    shard = slice(0, gbs // nprocs)
+    for _ in range(T_STINT):
+        if convert:
+            opt.actual_optimizer.update(model, x[shard], t[shard])
+        else:
+            opt.update(model, x, t)
+        for _ in range(4):
+            if not fleet.pending():
+                break
+            st = fleet.step()
+            if st.get("scale_decision"):
+                decision = st["scale_decision"]
+    steps = 0
+    while fleet.pending() and steps < 10_000:
+        st = fleet.step()
+        if st.get("scale_decision"):
+            decision = st["scale_decision"]
+        steps += 1
+    if convert:
+        assert decision == -1, decision  # the drain tripped the -1
+        res = broker.apply(decision)
+        assert res == ("retire", 1), res
+        deadline = _time.monotonic() + 60
+        while not train_mem.pending_joins() \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        train_mem.resolve(expect=set(range(nprocs)))
+    comm.bcast_data(model)
+    final_loss = None
+    for _ in range(T_JOINT_OUT):
+        final_loss = float(opt.update(model, x, t))
+    wall = _time.monotonic() - t0
+
+    done_ms = [(r.finish_time - submit_wall[r.request_id]) * 1e3
+               for r in fleet.completed if r.finish_time is not None
+               and r.request_id in submit_wall]
+    print(json.dumps({
+        "capacity": True, "processes": nprocs,
+        "convert": bool(convert), "requests": n_requests,
+        "completed": len(fleet.completed),
+        "dropped": n_requests - len(fleet.completed),
+        "p99_completion_ms": round(float(
+            np.percentile(done_ms, 99)), 2) if done_ms else None,
+        "final_loss": round(final_loss, 6),
+        "conversions": broker.stats["conversions"]
+        if broker is not None else 0,
+        "role_transfers": broker.stats["role_transfers"]
+        if broker is not None else 0,
+        "convert_s": round(broker.stats["convert_s"], 3)
+        if broker is not None else 0.0,
+        "weight_sync_s": round(fleet.weight_sync_s, 3),
+        "wall_s": round(wall, 3)}), flush=True)
+
+
+def _run_capacity_ab(nprocs, n_requests):
+    """The 2-process gloo capacity-transfer A/B (ISSUE 16): one leg
+    where rank 1 keeps training through the serving burst (one
+    replica), one where the CapacityBroker converts it into a second
+    replica for the burst and retires it after the drain.  Gates: ZERO
+    drops on both legs, exactly one conversion + retire on the
+    capacity leg, and final training loss parity within ±5% — lending
+    a rank to serving must not cost the training run.  The summary
+    line is the p99 completion delta the borrowed replica bought
+    (FIRST-CHIP-CONTACT checklist item 10)."""
+    import re
+    import socket
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "",
+            env["XLA_FLAGS"])
+    rows = []
+    for leg_convert in (0, 1):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--gloo-capacity-worker", str(pid), str(nprocs), str(port),
+             str(n_requests), str(leg_convert)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in range(nprocs)]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=600)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        assert all(p.returncode == 0 for p in procs), \
+            [(p.returncode, o[-2000:]) for p, o in zip(procs, outs)]
+        row = json.loads([ln for ln in outs[0].splitlines()
+                          if ln.startswith("{")][-1])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base, cap = rows
+    assert base["dropped"] == 0 and cap["dropped"] == 0, (base, cap)
+    assert cap["conversions"] == 1 and cap["role_transfers"] == 2, cap
+    parity = abs(cap["final_loss"] - base["final_loss"]) \
+        / max(abs(base["final_loss"]), 1e-9)
+    assert parity <= 0.05, \
+        f"capacity stint cost training: final loss {cap['final_loss']}" \
+        f" vs baseline {base['final_loss']} ({parity:.1%} > 5%)"
+    print(json.dumps({
+        "capacity_ab": True, "processes": nprocs,
+        "loss_parity_frac": round(parity, 4),
+        "conversions": cap["conversions"],
+        "role_transfers": cap["role_transfers"],
+        "convert_s": cap["convert_s"],
+        "weight_sync_s": cap["weight_sync_s"],
+        "p99_ms_saved_vs_training_priority": round(
+            (base["p99_completion_ms"] or 0)
+            - (cap["p99_completion_ms"] or 0), 2)}), flush=True)
+    return rows
+
+
 def _run_elastic_ab(nprocs, per_rank_bs, hidden, steps, preempt_rank):
     """The ≥2-host elastic A/B (ISSUE 10): one uninterrupted P-process
     run, one preempt-and-rejoin run, and the delta — the end-to-end
@@ -705,6 +960,20 @@ def main():
                         help=argparse.SUPPRESS)  # internal
     parser.add_argument("--gloo-fleet-worker", nargs=5, default=None,
                         help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--gloo-capacity-worker", nargs=5, default=None,
+                        help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--capacity", action="store_true",
+                        help="run the capacity-transfer A/B (ISSUE 16):"
+                             " one gloo leg where rank 1 keeps training"
+                             " through a serving burst (one replica), "
+                             "one where the CapacityBroker converts it "
+                             "into a second replica and retires it "
+                             "after the drain; gates zero drops + "
+                             "training loss parity (±5%); the summary "
+                             "line is the p99 completion delta the "
+                             "borrowed replica bought.  Request count "
+                             "from --fleet-requests; P = max of "
+                             "--gloo-procs (default 2)")
     parser.add_argument("--fleet-kill", type=int, default=None,
                         help="run the serving-fleet kill-under-load A/B"
                              " (ISSUE 15): an uninterrupted 2-replica "
@@ -774,6 +1043,14 @@ def main():
         return
     if args.gloo_fleet_worker:
         _gloo_fleet_worker(*map(int, args.gloo_fleet_worker))
+        return
+    if args.gloo_capacity_worker:
+        _gloo_capacity_worker(*map(int, args.gloo_capacity_worker))
+        return
+    if args.capacity:
+        nprocs = max(int(c) for c in args.gloo_procs.split(",")) \
+            if args.gloo_procs else 2
+        _run_capacity_ab(nprocs, args.fleet_requests)
         return
     if args.fleet_kill is not None:
         nprocs = max(int(c) for c in args.gloo_procs.split(",")) \

@@ -37,6 +37,7 @@ from .optimizers import create_multi_node_optimizer
 from .evaluators import create_multi_node_evaluator
 from . import extensions
 from .extensions import create_multi_node_checkpointer
+from . import elastic
 from .iterators import (create_multi_node_iterator,
                         create_synchronized_iterator)
 from . import global_except_hook

@@ -191,6 +191,7 @@ class ElasticMembership:
         if not self.role or "/" in self.role:
             raise ValueError(f"membership role must be a single path "
                              f"segment, got {role!r}")
+        self._ns = str(namespace)
         self._base = f"{namespace}/{self.role}"
         self.settle_s = float(settle_s)
         self.stall_s = float(stall_s)
@@ -309,21 +310,34 @@ class ElasticMembership:
         return MembershipView(epoch, members, role=self.role)
 
     # -- announcements (generation-keyed intents) ---------------------------
-    def announce_leave(self, note=""):
-        """Post this rank's departure (non-blocking, best-effort): the
-        next resolve excludes it without waiting out a timeout.  A
-        standing join intent is retracted."""
-        self._delete(f"{self._base}/join/{self.rank}")
-        self._set(f"{self._base}/leave/{self.rank}",
+    def announce_leave(self, note="", rank=None):
+        """Post a departure (non-blocking, best-effort): the next
+        resolve excludes the rank without waiting out a timeout.  A
+        standing join intent is retracted.  ``rank`` defaults to this
+        process; a survivor passes a DEAD rank's id when aborting an
+        orphaned capacity conversion (the journal proves the intent —
+        posting it merely spares everyone the timeout)."""
+        rank = self.rank if rank is None else int(rank)
+        self._delete(f"{self._base}/join/{rank}")
+        self._set(f"{self._base}/leave/{rank}",
                   f"{self.current_epoch()}:{note}")
 
-    def announce_join(self, note=""):
-        """Post this rank's wish to (re-)enter: survivors' join polls
-        see it and initiate a grow resolve.  Retracts any standing
-        leave (the spot host came back)."""
-        self._delete(f"{self._base}/leave/{self.rank}")
-        self._set(f"{self._base}/join/{self.rank}",
+    def announce_join(self, note="", rank=None):
+        """Post a wish to (re-)enter: survivors' join polls see it and
+        initiate a grow resolve.  Retracts any standing leave (the
+        spot host came back).  ``rank`` defaults to this process."""
+        rank = self.rank if rank is None else int(rank)
+        self._delete(f"{self._base}/leave/{rank}")
+        self._set(f"{self._base}/join/{rank}",
                   f"{self.current_epoch()}:{note}")
+
+    def retract_join(self, rank=None):
+        """Scrub a standing join intent without posting a leave — this
+        rank's own retraction, or a survivor scrubbing a DEAD rank's
+        intent while aborting an orphaned capacity conversion (a rank
+        that died at ``REJOINING`` must never be admitted)."""
+        rank = self.rank if rank is None else int(rank)
+        self._delete(f"{self._base}/join/{rank}")
 
     def pending_joins(self, view=None):
         """Ranks with a standing join announcement that are NOT in the
@@ -331,6 +345,58 @@ class ElasticMembership:
         view = view if view is not None else self.current_view()
         joins = self._scan(f"{self._base}/join", range(self.world))
         return tuple(r for r in sorted(joins) if r not in view)
+
+    # -- capacity-conversion journal (ISSUE 16) ------------------------------
+    # A rank changing ROLE (training <-> fleet, the capacity-transfer
+    # protocol in chainermn_tpu/elastic/capacity.py) journals each
+    # conversion step here BEFORE executing it, so a preempt landing
+    # mid-conversion leaves a typed record survivors can roll forward
+    # or abort.  The journal lives under ``<ns>/capacity`` — OUTSIDE
+    # both role groups' key prefixes, because a conversion by
+    # definition spans two groups: members of EITHER group must see
+    # the same journal through their own membership object.  Values
+    # are ``step:beat:note`` — the beat increments on every write by
+    # the converting rank, so an observer can distinguish a LIVE
+    # conversion (beat advancing) from an orphaned one (beat frozen,
+    # the stall_s idiom measured on the observer's clock).
+
+    def journal_conversion(self, step, note="", rank=None, beat=None):
+        """Write (or advance) the conversion-journal entry for ``rank``
+        (default: this rank).  ``beat`` defaults to previous+1."""
+        rank = self.rank if rank is None else int(rank)
+        if beat is None:
+            prev = self.read_conversion(rank)
+            beat = (prev[1] + 1) if prev is not None else 1
+        self._set(f"{self._ns}/capacity/{rank}", f"{step}:{int(beat)}:{note}")
+
+    def read_conversion(self, rank):
+        """``(step, beat, note)`` of ``rank``'s journal entry, or None."""
+        raw = self._try_get(f"{self._ns}/capacity/{int(rank)}")
+        if raw is None:
+            return None
+        parts = str(raw).split(":", 2)
+        if len(parts) != 3:
+            return None
+        try:
+            return (parts[0], int(parts[1]), parts[2])
+        except ValueError:
+            return None
+
+    def scan_conversions(self):
+        """``{rank: (step, beat, note)}`` of every standing journal
+        entry — the survivors' orphan-detection scan."""
+        found = self._scan(f"{self._ns}/capacity", range(self.world))
+        out = {}
+        for r in sorted(found):
+            entry = self.read_conversion(r)
+            if entry is not None:
+                out[r] = entry
+        return out
+
+    def clear_conversion(self, rank=None):
+        """Scrub the journal entry (conversion completed or aborted)."""
+        rank = self.rank if rank is None else int(rank)
+        self._delete(f"{self._ns}/capacity/{rank}")
 
     # -- consensus -----------------------------------------------------------
     def resolve(self, expect=None, require=None, timeout_ms=None):

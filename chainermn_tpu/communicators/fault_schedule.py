@@ -42,6 +42,14 @@ filtering happens *after* the probabilistic draw, so a rank-restricted
 spec consumes identical RNG stream positions on every rank — the
 cross-rank replay property survives targeting.
 
+``step`` restricts a spec to a NAMED protocol step: interception sites
+that are themselves multi-step protocols (the capacity-transfer
+conversion, ISSUE 16) pass ``on_call(op, step=...)`` and a
+step-restricted spec only fires when the names agree (the chaos shape
+"preempt exactly at CONVERTING").  Like ``rank``, step filtering
+happens after the draw, so cross-rank streams stay call-site-aligned
+regardless of which step each rank is currently executing.
+
 Host-channel ops are namespaced ``hc.<op>`` (``hc.put``, ``hc.get``,
 ``hc.barrier``, ``hc.chunk``) and carry transport-flavored actions
 (``lost_chunk``, ``stale_key``) interpreted by the host-channel fault
@@ -98,7 +106,8 @@ class FaultSpec:
     """One declarative fault: *when* (op + nth/prob) and *what* (action)."""
 
     def __init__(self, op, action="raise", nth=None, prob=None,
-                 delay_s=0.0, exc=None, count=1, note="", rank=None):
+                 delay_s=0.0, exc=None, count=1, note="", rank=None,
+                 step=None):
         if action not in _ACTIONS:
             raise ValueError(f"unknown fault action {action!r}; "
                              f"choose from {_ACTIONS}")
@@ -109,6 +118,9 @@ class FaultSpec:
         if rank is not None and int(rank) < 0:
             raise ValueError(f"rank must be a non-negative rank id, "
                              f"got {rank}")
+        if step is not None and (not isinstance(step, str) or not step):
+            raise ValueError(f"step must be a non-empty protocol-step "
+                             f"name, got {step!r}")
         self.op = op
         self.action = action
         self.nth = nth
@@ -118,6 +130,7 @@ class FaultSpec:
         self.count = count  # None = unbounded
         self.note = note
         self.rank = None if rank is None else int(rank)
+        self.step = step
         self.fired = 0
 
     def to_dict(self):
@@ -134,6 +147,8 @@ class FaultSpec:
             d["note"] = self.note
         if self.rank is not None:
             d["rank"] = self.rank
+        if self.step is not None:
+            d["step"] = self.step
         return d
 
     def __repr__(self):
@@ -208,13 +223,16 @@ class FaultSchedule:
                 "faults": [s.to_dict() for s in self.specs]}
 
     # -- the oracle ----------------------------------------------------------
-    def on_call(self, op):
+    def on_call(self, op, step=None):
         """Consult the schedule for one call of ``op``.
 
         Increments the op's call counter, then returns the first matching
         armed spec's decision (or None).  The RNG stream is advanced for
         every probabilistic spec naming this op — match or not — so the
-        draw sequence depends only on the op-call sequence.
+        draw sequence depends only on the op-call sequence.  ``step``
+        names the protocol step the caller is executing (capacity
+        conversion sites pass it); step-restricted specs only fire when
+        the names agree.
         """
         n = self._counters.get(op, 0) + 1
         self._counters[op] = n
@@ -237,6 +255,10 @@ class FaultSchedule:
                 # targeted at another rank (or unbound schedule): the
                 # draw above is already consumed, so every rank's
                 # stream stays aligned — the spec just doesn't fire here
+                matched = False
+            if matched and spec.step is not None and spec.step != step:
+                # step filtering mirrors rank filtering: post-draw, so
+                # ranks at different protocol steps stay stream-aligned
                 matched = False
             if matched and hit is None:
                 spec.fired += 1

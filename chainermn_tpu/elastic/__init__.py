@@ -1,0 +1,11 @@
+"""Elastic capacity transfer (ISSUE 16): the broker that lets one
+pool of chips follow the traffic between the training ``elastic``
+group and the serving ``fleet`` group.  See :mod:`.capacity` for the
+protocol and ``docs/resilience.md`` §8 for the design."""
+
+from .capacity import (CONVERSION_STEPS, CapacityBroker,
+                       CapacityFloorError, CapacityProtocolError,
+                       LocalTrainGroup)
+
+__all__ = ["CONVERSION_STEPS", "CapacityBroker", "CapacityFloorError",
+           "CapacityProtocolError", "LocalTrainGroup"]

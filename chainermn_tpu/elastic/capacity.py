@@ -1,0 +1,531 @@
+"""Capacity transfer — one pool of chips following the traffic.
+
+ISSUE 16 (ROADMAP item 5, the elastic story finished): PR 15's serving
+fleet deliberately stopped at "scale decisions are surfaced, not
+auto-applied" — :class:`~chainermn_tpu.serving.fleet
+.QueueDepthScalePolicy` reads the queue-depth gauges and emits +1/-1
+but nothing moves.  This module is the EXECUTOR: a
+:class:`CapacityBroker` that answers sustained queue pressure by
+moving a training rank into the serving fleet (clean leave → the PR 10
+shrink preserves the global batch → re-register under the ``fleet``
+role → adopt serving weights over the PR 15 multicast tree) and moves
+it back when the queues drain (retire → re-join training through the
+snapshot-sync grow path).
+
+The conversion is a typed multi-step state machine::
+
+    LEAVE_ANNOUNCED → CONVERTING → SERVING → RETIRING → REJOINING
+
+journaled in the KV store (``<ns>/capacity/<rank>``, shared by BOTH
+role groups — see
+:meth:`~chainermn_tpu.communicators.ElasticMembership
+.journal_conversion`) BEFORE each step executes, so a preempt landing
+at ANY step leaves a record survivors can act on:
+:meth:`CapacityBroker.recover_orphans` detects a journal entry whose
+beat has frozen past ``stale_s`` (the observer-clock staleness idiom
+the membership protocol's ``stall_s`` screen uses) and rolls the world
+forward — completing the step when its effects already landed,
+aborting it (scrubbing half-admitted replicas and standing join
+intents) when they did not.  The failure matrix is pinned step by step
+in ``tests/resilience_tests/test_capacity.py`` and documented in
+``docs/resilience.md`` §8.
+
+Safety rails:
+
+* **hysteresis** — the policy's high/low water marks + per-direction
+  re-arm collapse a sustained spike to one decision, and the broker
+  adds per-direction COOLDOWNS (``convert_cooldown_s`` /
+  ``retire_cooldown_s``) so oscillating load cannot thrash
+  conversions;
+* **floors for BOTH roles** — training never shrinks below
+  ``min_world``, the fleet never below one replica; a violating
+  request refuses with a typed :class:`CapacityFloorError` carrying
+  both role views;
+* **chaos hooks** — every conversion step consults the
+  :class:`~chainermn_tpu.communicators.fault_schedule.FaultSchedule`
+  (op ``"capacity.convert"``, ``step=`` the state name), so the chaos
+  suite kills mid-conversion deterministically
+  (``FaultSpec(op="capacity.convert", action="preempt",
+  step="CONVERTING", ...)``).
+
+Observability: spans ``capacity/leave`` / ``capacity/convert`` /
+``capacity/retire`` and the per-role world-size gauge
+``chainermn_tpu_role_world_size{role=...}``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import observability
+from ..communicators._membership import MembershipView
+
+__all__ = ["CONVERSION_STEPS", "CapacityFloorError",
+           "CapacityProtocolError", "CapacityBroker", "LocalTrainGroup"]
+
+#: the conversion state machine, in order.  ``LEAVE_ANNOUNCED`` /
+#: ``CONVERTING`` / ``SERVING`` belong to the training→fleet leg,
+#: ``RETIRING`` / ``REJOINING`` to the way back; ``SERVING`` is the
+#: steady state a converted rank parks in between the two legs.
+CONVERSION_STEPS = ("LEAVE_ANNOUNCED", "CONVERTING", "SERVING",
+                    "RETIRING", "REJOINING")
+
+#: legal journal transitions (``None`` = no standing entry)
+_NEXT = {None: ("LEAVE_ANNOUNCED",),
+         "LEAVE_ANNOUNCED": ("CONVERTING",),
+         "CONVERTING": ("SERVING",),
+         "SERVING": ("RETIRING",),
+         "RETIRING": ("REJOINING",),
+         "REJOINING": ()}
+
+#: the fault-schedule op every conversion step consults
+FAULT_OP = "capacity.convert"
+
+
+class CapacityFloorError(RuntimeError):
+    """A capacity transfer would breach a role's floor (training below
+    ``min_world``, or the fleet below one live replica).  Refused, not
+    clamped — carries BOTH role views so the operator reads the whole
+    world in one exception."""
+
+    def __init__(self, message, training_view=None, fleet_view=None):
+        self.training_view = training_view
+        self.fleet_view = fleet_view
+        detail = []
+        if training_view is not None:
+            detail.append(f"training={list(training_view.members)}")
+        if fleet_view is not None:
+            detail.append(f"fleet={list(fleet_view.members)}")
+        super().__init__(message + (f" ({', '.join(detail)})"
+                                    if detail else ""))
+
+
+class CapacityProtocolError(RuntimeError):
+    """An illegal conversion-state transition (a journal write that
+    skips or rewinds the state machine) — always a caller bug, never a
+    runtime condition, so it is typed separately from the floor
+    refusal."""
+
+
+class LocalTrainGroup:
+    """Single-controller stand-in for the TRAINING side of a capacity
+    transfer (the analog of the fleet's ``_LocalConsensus``): leaves
+    and joins apply immediately, the epoch bumps on every change, and
+    the conversion journal lives in a dict.  The bench's diurnal
+    scenario and the tier-1 broker tests drive this; the gloo leg
+    swaps in a real :class:`~chainermn_tpu.communicators
+    .ElasticMembership` pair sharing one KV store."""
+
+    role = "elastic"
+
+    def __init__(self, world=2, rank=0):
+        self.rank = int(rank)
+        self.world = int(world)
+        self._epoch = 0
+        self._members = tuple(range(self.world))
+        self._journal = {}
+
+    def current_epoch(self):
+        return self._epoch
+
+    def current_view(self):
+        return MembershipView(self._epoch, self._members, role=self.role)
+
+    def announce_leave(self, note="", rank=None):
+        r = self.rank if rank is None else int(rank)
+        if r in self._members:
+            self._members = tuple(m for m in self._members if m != r)
+            self._epoch += 1
+
+    def announce_join(self, note="", rank=None):
+        r = self.rank if rank is None else int(rank)
+        if r not in self._members:
+            self._members = tuple(sorted(self._members + (r,)))
+            self._epoch += 1
+
+    def retract_join(self, rank=None):
+        pass
+
+    def pending_joins(self, view=None):
+        return ()
+
+    # -- conversion journal (dict-backed mirror of the KV protocol) ----------
+    def journal_conversion(self, step, note="", rank=None, beat=None):
+        r = self.rank if rank is None else int(rank)
+        prev = self._journal.get(r)
+        if beat is None:
+            beat = (prev[1] + 1) if prev is not None else 1
+        self._journal[r] = (str(step), int(beat), str(note))
+
+    def read_conversion(self, rank):
+        return self._journal.get(int(rank))
+
+    def scan_conversions(self):
+        return dict(self._journal)
+
+    def clear_conversion(self, rank=None):
+        self._journal.pop(self.rank if rank is None else int(rank), None)
+
+
+class CapacityBroker:
+    """The capacity-transfer executor over one training group and one
+    serving fleet (see module docstring).
+
+    ``train``: the training side's membership — a real
+    :class:`~chainermn_tpu.communicators.ElasticMembership` (the
+    broker acts for its own rank, or for another rank when the
+    membership accepts ``rank=``) or the single-controller
+    :class:`LocalTrainGroup`.  Must expose the conversion-journal
+    surface (``journal_conversion`` / ``scan_conversions`` / ...).
+    ``fleet``: the :class:`~chainermn_tpu.serving.fleet.ReplicaFleet`
+    (held by reference; the broker uses only its public
+    join/retire/preempt/discard surface).
+    ``engine_factory``: ``factory(rank) -> ServingEngine`` for a
+    converting rank the caller hands no engine (the tree sync
+    overwrites its weights bit-identically from the fleet root).
+    ``recovery``: optional
+    :class:`~chainermn_tpu.extensions.ElasticRecovery` — when the
+    broker runs ON the converting rank, leaves/rejoins ride the
+    supervisor's own protocol helpers (``capacity_leave`` /
+    ``capacity_rejoin``) so the training side shrinks and grows
+    through the PR 10 paths.
+    ``min_world``: the training floor; the fleet floor is
+    ``max(1, fleet.min_replicas)``.
+    ``convert_cooldown_s`` / ``retire_cooldown_s``: per-direction
+    cooldowns :meth:`apply` enforces on top of the policy's own
+    hysteresis.
+    ``stale_s``: how long a journal entry's beat must be frozen (on
+    THIS observer's clock) before :meth:`recover_orphans` treats the
+    conversion as orphaned.
+    ``schedule``: optional fault schedule consulted at every step.
+    ``auto_apply``: ``False`` preserves PR 15's surfaced-only behavior
+    — :meth:`apply` records the decision and moves nothing.
+    """
+
+    def __init__(self, train, fleet, engine_factory=None, recovery=None,
+                 min_world=1, convert_cooldown_s=0.0,
+                 retire_cooldown_s=0.0, stale_s=2.0, schedule=None,
+                 auto_apply=True, donor=None, clock=time.monotonic,
+                 sleep=time.sleep):
+        self.train = train
+        self.fleet = fleet
+        self.engine_factory = engine_factory
+        self.recovery = recovery
+        self.min_world = int(min_world)
+        self.fleet_floor = max(1, getattr(fleet, "min_replicas", 1))
+        self.convert_cooldown_s = float(convert_cooldown_s)
+        self.retire_cooldown_s = float(retire_cooldown_s)
+        self.stale_s = float(stale_s)
+        self.schedule = schedule
+        self.auto_apply = bool(auto_apply)
+        self._donor = donor
+        self._clock = clock
+        self._sleep = sleep
+        self.converted = {}          # training rank -> fleet rid
+        self._last_convert = None
+        self._last_retire = None
+        self._orphan_seen = {}       # rank -> ((step, beat), first-seen t)
+        self.stats = {"conversions": 0, "retires": 0,
+                      "role_transfers": 0, "convert_s": 0.0,
+                      "floor_refusals": 0, "surfaced": 0,
+                      "aborted": 0, "rolled_forward": 0}
+        self._publish_gauges()
+
+    # -- plumbing ------------------------------------------------------------
+
+    @property
+    def train_role(self):
+        return getattr(self.train, "role", "elastic")
+
+    def _fleet_view(self):
+        view = getattr(self.fleet, "view", None)
+        if view is not None:
+            return view
+        return MembershipView(0, [r.rid for r in
+                                  self.fleet.live_replicas()],
+                              role="fleet")
+
+    def _hook(self, step):
+        """Fault-schedule hook: one consult per conversion step.  A
+        ``delay`` fault sleeps in place; everything else raises its
+        typed exception HERE — after the step was journaled, before it
+        executed — which is exactly the mid-conversion crash the
+        recovery matrix handles."""
+        if self.schedule is None:
+            return
+        fault = self.schedule.on_call(FAULT_OP, step=step)
+        if fault is None:
+            return
+        if fault.action == "delay":
+            self._sleep(fault.spec.delay_s)
+            return
+        raise fault.make_exception()
+
+    def _journal(self, rank, step, note=""):
+        prev = self.train.read_conversion(rank)
+        prev_step = prev[0] if prev is not None else None
+        if step not in _NEXT.get(prev_step, ()):
+            raise CapacityProtocolError(
+                f"illegal conversion transition {prev_step!r} -> "
+                f"{step!r} for rank {rank} (order: "
+                f"{' -> '.join(CONVERSION_STEPS)})")
+        self.train.journal_conversion(step, note=note, rank=rank)
+
+    def _train_leave(self, rank, note):
+        if self.recovery is not None \
+                and rank == self.recovery.stable_rank:
+            self.recovery.capacity_leave(note=note)
+        elif getattr(self.train, "rank", None) == rank:
+            self.train.announce_leave(note=note)
+        else:
+            self.train.announce_leave(note=note, rank=rank)
+
+    def _train_join(self, rank, note):
+        if self.recovery is not None \
+                and rank == self.recovery.stable_rank:
+            self.recovery.capacity_rejoin(note=note)
+        elif getattr(self.train, "rank", None) == rank:
+            self.train.announce_join(note=note)
+        else:
+            self.train.announce_join(note=note, rank=rank)
+
+    def _publish_gauges(self):
+        reg = observability.registry()
+        gauge = reg.gauge(
+            "chainermn_tpu_role_world_size",
+            help="controller ranks per role group (the capacity "
+                 "broker's two-role world view)")
+        gauge.set(self.train.current_view().size, role=self.train_role)
+        gauge.set(len(self.fleet.live_replicas()), role="fleet")
+
+    # -- the two legs --------------------------------------------------------
+
+    def convert_to_serving(self, rank=None, engine=None, now=None):
+        """training → fleet: clean leave, fleet admission, tree weight
+        sync.  Returns the converted training rank.  Raises
+        :class:`CapacityFloorError` when training would shrink below
+        ``min_world``; a fault-schedule preempt mid-way leaves the
+        journal at the step it reached (the recovery matrix's input).
+        """
+        t0 = self._clock()
+        train_view = self.train.current_view()
+        if rank is None:
+            rank = (self._donor(train_view) if self._donor is not None
+                    else max(train_view.members))
+        rank = int(rank)
+        fleet_view = self._fleet_view()
+        if rank not in train_view:
+            raise CapacityFloorError(
+                f"rank {rank} is not a training member",
+                training_view=train_view, fleet_view=fleet_view)
+        if train_view.size - 1 < self.min_world:
+            self.stats["floor_refusals"] += 1
+            raise CapacityFloorError(
+                f"converting rank {rank} would shrink training below "
+                f"min_world={self.min_world}",
+                training_view=train_view, fleet_view=fleet_view)
+        with observability.span("capacity/leave", tags={"rank": rank}):
+            self._journal(rank, "LEAVE_ANNOUNCED",
+                          note="queue pressure")
+            self._hook("LEAVE_ANNOUNCED")
+            self._train_leave(
+                rank, note="capacity transfer: converting to serving")
+        with observability.span("capacity/convert", tags={"rank": rank}):
+            self._journal(rank, "CONVERTING")
+            self._hook("CONVERTING")
+            if engine is None:
+                if self.engine_factory is None:
+                    raise ValueError("convert_to_serving needs engine= "
+                                     "or a broker engine_factory")
+                engine = self.engine_factory(rank)
+            rid = rank if rank not in self.fleet.replicas \
+                else max(self.fleet.replicas) + 1
+            self.fleet.join(engines={rid: engine})
+            self._journal(rank, "SERVING")
+            self._hook("SERVING")
+        self.converted[rank] = rid
+        self.stats["conversions"] += 1
+        self.stats["role_transfers"] += 1
+        self.stats["convert_s"] += self._clock() - t0
+        self._last_convert = now if now is not None else self._clock()
+        self._publish_gauges()
+        return rank
+
+    def retire_to_training(self, rank=None, now=None):
+        """fleet → training: graceful retire (in-flight work reroutes
+        first), then re-join through the training grow path.  Returns
+        the returned rank.  Raises :class:`CapacityFloorError` when
+        the retire would leave the fleet below one live replica."""
+        t0 = self._clock()
+        if rank is None:
+            if not self.converted:
+                raise CapacityFloorError(
+                    "no converted rank to retire",
+                    training_view=self.train.current_view(),
+                    fleet_view=self._fleet_view())
+            rank = next(reversed(self.converted))   # LIFO: newest
+            #                                         stint ends first
+        rank = int(rank)
+        rid = self.converted.get(rank, rank)
+        live = {r.rid for r in self.fleet.live_replicas()}
+        if rid in live and len(live) - 1 < self.fleet_floor:
+            self.stats["floor_refusals"] += 1
+            raise CapacityFloorError(
+                f"retiring replica {rid} would shrink the fleet below "
+                f"its floor of {self.fleet_floor}",
+                training_view=self.train.current_view(),
+                fleet_view=self._fleet_view())
+        with observability.span("capacity/retire",
+                                tags={"rank": rank, "rid": rid}):
+            self._journal(rank, "RETIRING")
+            self._hook("RETIRING")
+            if rid in live:
+                self.fleet.retire(rid, now=now)
+            self._journal(rank, "REJOINING")
+            self._hook("REJOINING")
+            self._train_join(
+                rank, note="capacity transfer: rejoining training")
+            self.train.clear_conversion(rank)
+        self.converted.pop(rank, None)
+        self.stats["retires"] += 1
+        self.stats["role_transfers"] += 1
+        self.stats["convert_s"] += self._clock() - t0
+        self._last_retire = now if now is not None else self._clock()
+        self._publish_gauges()
+        return rank
+
+    # -- auto-apply ----------------------------------------------------------
+
+    def apply(self, decision, now=None):
+        """Execute one scale decision (the policy's +1/-1/0).  Returns
+        ``("convert", rank)`` / ``("retire", rank)`` / ``None``.
+
+        ``auto_apply=False`` preserves PR 15: the decision is counted
+        (``stats["surfaced"]``) and nothing moves.  Per-direction
+        cooldowns and floor refusals also answer ``None`` — the broker
+        never half-applies; floors raise only on DIRECT calls where
+        the caller asked for that specific transfer."""
+        if not decision:
+            return None
+        if not self.auto_apply:
+            self.stats["surfaced"] += 1
+            return None
+        t = now if now is not None else self._clock()
+        if decision > 0:
+            if self._last_convert is not None \
+                    and t - self._last_convert < self.convert_cooldown_s:
+                return None
+            train_view = self.train.current_view()
+            if train_view.size - 1 < self.min_world:
+                self.stats["floor_refusals"] += 1
+                return None
+            rank = self.convert_to_serving(now=now)
+            return ("convert", rank)
+        if not self.converted:
+            return None   # nothing of ours to give back
+        if self._last_retire is not None \
+                and t - self._last_retire < self.retire_cooldown_s:
+            return None
+        try:
+            rank = self.retire_to_training(now=now)
+        except CapacityFloorError:
+            self.stats["floor_refusals"] += 1
+            return None
+        return ("retire", rank)
+
+    # -- orphan recovery -----------------------------------------------------
+
+    def recover_orphans(self, now=None):
+        """Survivor-side sweep: detect conversions whose journal beat
+        froze for ``stale_s`` and roll the world forward without them.
+        Returns a tuple of ``(rank, step, action)`` where ``action`` is
+        ``"roll-forward"`` (the step's effects landed; complete it) or
+        ``"abort"`` (they did not; scrub every trace).  A healthy
+        ``SERVING`` stint (rank live in the fleet) is never treated as
+        orphaned — that journal entry parks on purpose."""
+        t = now if now is not None else self._clock()
+        actions = []
+        standing = self.train.scan_conversions()
+        for rank in list(self._orphan_seen):
+            if rank not in standing:
+                del self._orphan_seen[rank]    # journal cleared: done
+        for rank, (step, beat, note) in sorted(standing.items()):
+            live = {r.rid for r in self.fleet.live_replicas()}
+            rid = self.converted.get(rank, rank)
+            if step == "SERVING" and rid in live:
+                self._orphan_seen.pop(rank, None)
+                continue                       # healthy stint, parked
+            prev = self._orphan_seen.get(rank)
+            if prev is None or prev[0] != (step, beat):
+                self._orphan_seen[rank] = ((step, beat), t)
+                continue                       # first sight / advancing
+            if t - prev[1] < self.stale_s:
+                continue                       # not stale yet
+            action = self._roll(rank, step, rid, live, now=now)
+            actions.append((rank, step, action))
+            self._orphan_seen.pop(rank, None)
+        if actions:
+            self._publish_gauges()
+        return tuple(actions)
+
+    def _roll(self, rank, step, rid, live, now=None):
+        """One orphaned conversion resolved — the failure matrix
+        (``docs/resilience.md`` §8): complete a step whose effects
+        already landed, abort one whose effects did not, and never
+        leave the rank present in either role group."""
+        observability.instant("capacity/orphan",
+                              tags={"rank": rank, "step": step})
+        if step == "LEAVE_ANNOUNCED":
+            # died before touching the fleet — and possibly before its
+            # own leave landed: post it on the dead rank's behalf
+            # (idempotent; the announced-leave fast path spares the
+            # survivors a timeout) and scrub
+            self._train_leave(rank, note="orphaned conversion abort")
+            action = "abort"
+        elif step == "CONVERTING":
+            if rid in live:
+                # the join fully landed, only the SERVING journal
+                # write was lost: complete the record and keep serving
+                self._journal(rank, "SERVING", note="rolled forward")
+                self.converted[rank] = rid
+                self.stats["rolled_forward"] += 1
+                return "roll-forward"
+            # half-admitted carcass (never went live): evict it
+            self.fleet.discard(rid)
+            action = "abort"
+        elif step == "SERVING":
+            # (rid not live here — live stints were skipped above) the
+            # replica died while serving: the fleet's shed already
+            # rerouted its work or will give up typed; nothing returns
+            # to training
+            if rid in self.fleet.replicas and rid in live:
+                self.fleet.preempt(rid, now=now)
+            action = "roll-forward"
+        elif step == "RETIRING":
+            # the retire stalled mid-flight: complete it (rerouting
+            # whatever the replica still held); the rank is dead, so
+            # NO training rejoin
+            if rid in live:
+                self.fleet.preempt(rid, now=now)
+            elif rid in self.fleet.replicas:
+                self.fleet.discard(rid)
+            action = "roll-forward"
+        elif step == "REJOINING":
+            # died between the retire and the training admission:
+            # scrub the standing join intent so a dead rank is never
+            # admitted
+            retract = getattr(self.train, "retract_join", None)
+            if retract is not None:
+                retract(rank=rank)
+            action = "abort"
+        else:
+            action = "abort"   # unknown step (future writer): scrub
+        self.converted.pop(rank, None)
+        self.train.clear_conversion(rank)
+        self.stats["aborted" if action == "abort"
+                   else "rolled_forward"] += 1
+        return action
+
+    def __repr__(self):
+        return (f"<CapacityBroker converted={sorted(self.converted)} "
+                f"transfers={self.stats['role_transfers']}>")

@@ -315,6 +315,61 @@ class ElasticRecovery(FailureRecovery):
             self.on_recover(trainer, exc, resumed)
         return resumed
 
+    # -- capacity transfer (ISSUE 16) ----------------------------------------
+    # The CapacityBroker's view of this supervisor: a training rank
+    # converting to a serving replica departs CLEANLY (no exception,
+    # no checkpoint rollback — the survivors' shrink preserves the
+    # global batch exactly like a preemption shrink) and later
+    # re-enters through the same guarded admission the
+    # preempt-and-return arc uses.
+
+    def capacity_leave(self, note="capacity transfer: to serving"):
+        """Announce this rank's clean departure for a role conversion.
+        Survivors shrink without burning a timeout (the announced-leave
+        fast path); returns the epoch at departure so the caller can
+        wait for the shrink decision before doing anything that races
+        it."""
+        epoch = self.membership.current_epoch()
+        self.membership.announce_leave(note=note)
+        observability.instant("capacity/leave_announced",
+                              tags={"rank": self.stable_rank})
+        self._log(f"clean leave announced ({note})")
+        return epoch
+
+    def capacity_rejoin(self, trainer=None,
+                        note="capacity transfer: rejoin"):
+        """Re-enter training after a serving stint — the same guarded
+        two-attempt admission the preempt-and-return arc uses
+        (``require=`` the survivors: a joiner never settles a world by
+        itself).  With a ``trainer``, the full adopt runs (rebuild,
+        snapshot sync, consensus load); without one, the decided view
+        is adopted and returned for callers that rebuild on their own
+        schedule.  Raises :class:`RecoveryGivingUp` when the survivors
+        never admit us."""
+        view = prev = self.membership.current_view()
+        for attempt in range(2):
+            self.membership.announce_join(note=note)
+            prev = self.membership.current_view()
+            self._log(f"capacity rejoin (current view "
+                      f"{list(prev.members)}, attempt {attempt + 1})")
+            with observability.span("elastic/resolve",
+                                    tags={"rejoin": True,
+                                          "capacity": True,
+                                          "attempt": attempt + 1}):
+                view = self.membership.resolve(
+                    expect=set(prev.members) | {self.stable_rank},
+                    require=set(prev.members) - {self.stable_rank},
+                    timeout_ms=self.resolve_timeout_ms)
+            if self.stable_rank in view:
+                break
+        if self.stable_rank not in view:
+            raise RecoveryGivingUp(
+                "capacity re-join was not admitted", membership=view)
+        if trainer is not None:
+            return self._adopt(trainer, view, prev_view=prev)
+        self.view = view
+        return view
+
     # -- the three moves -----------------------------------------------------
     def _preempted(self, trainer, exc):
         """This rank's capacity was reclaimed: announce the departure

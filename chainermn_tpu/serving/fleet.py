@@ -496,10 +496,10 @@ class _LocalConsensus:
     def pending_joins(self, view=None):
         return ()
 
-    def announce_leave(self, note=""):
+    def announce_leave(self, note="", rank=None):
         pass
 
-    def announce_join(self, note=""):
+    def announce_join(self, note="", rank=None):
         pass
 
 
@@ -509,34 +509,71 @@ class QueueDepthScalePolicy:
     """Scale decisions from the PR 14 metrics registry: reads the
     per-tenant ``chainermn_tpu_fleet_queue_depth`` gauges the fleet
     publishes every step and returns ``+1`` (any tenant's backlog above
-    ``scale_up_depth`` and room below ``max_replicas``), ``-1`` (every
-    tenant at or below ``scale_down_depth`` AND more than
-    ``min_replicas`` live), or ``0``.  Pure read — the fleet surfaces
-    the decision; applying it is the deployer's `join`/`retire` call
-    (capacity is granted, not conjured)."""
+    the ``scale_up_depth`` high-water mark and room below
+    ``max_replicas``), ``-1`` (every tenant at or below the
+    ``scale_down_depth`` low-water mark AND more than ``min_replicas``
+    live), or ``0``.  Pure read — the fleet surfaces the decision;
+    applying it is the deployer's `join`/`retire` call, or the ISSUE 16
+    :class:`~chainermn_tpu.elastic.CapacityBroker` (capacity is
+    granted, not conjured).
+
+    Hysteresis (ISSUE 16 satellite): one sustained excursion past a
+    water mark collapses to ONE decision.  After emitting in a
+    direction, that direction is DISARMED until the gauge first
+    returns inside the band (past the opposite side of its own mark),
+    and — when the caller supplies ``now`` — until that direction's
+    cooldown window has elapsed.  Distinct high/low marks plus the
+    per-direction re-arm rule mean oscillating load cannot thrash
+    +1/-1 every step the way the PR 15 stateless read did."""
 
     GAUGE = "chainermn_tpu_fleet_queue_depth"
 
     def __init__(self, scale_up_depth=8, scale_down_depth=0,
-                 min_replicas=1, max_replicas=8):
+                 min_replicas=1, max_replicas=8,
+                 up_cooldown_s=0.0, down_cooldown_s=0.0):
         self.scale_up_depth = float(scale_up_depth)
         self.scale_down_depth = float(scale_down_depth)
+        if self.scale_down_depth > self.scale_up_depth:
+            raise ValueError(
+                f"scale_down_depth ({self.scale_down_depth}) must not "
+                f"exceed scale_up_depth ({self.scale_up_depth})")
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self._armed = {1: True, -1: True}
+        self._last_emit = {1: None, -1: None}
 
-    def decide(self, registry, n_live):
+    def decide(self, registry, n_live, now=None):
         gauge = registry.gauge(self.GAUGE)
         depths = [gauge.value(**dict(key)) for key in gauge.labels()]
         depths = [d for d in depths if d is not None]
         if not depths:
             return 0
-        if max(depths) > self.scale_up_depth \
-                and n_live < self.max_replicas:
-            return 1
-        if max(depths) <= self.scale_down_depth \
-                and n_live > self.min_replicas:
-            return -1
-        return 0
+        peak = max(depths)
+        # re-arm: a direction only becomes eligible again once the
+        # gauge has crossed back past its own water mark
+        if peak <= self.scale_up_depth:
+            self._armed[1] = True
+        if peak > self.scale_down_depth:
+            self._armed[-1] = True
+        if peak > self.scale_up_depth and n_live < self.max_replicas:
+            want = 1
+        elif peak <= self.scale_down_depth and n_live > self.min_replicas:
+            want = -1
+        else:
+            return 0
+        if not self._armed[want]:
+            return 0  # same sustained excursion: already answered
+        cooldown = self.up_cooldown_s if want == 1 else self.down_cooldown_s
+        last = self._last_emit[want]
+        if now is not None and last is not None \
+                and now - last < cooldown:
+            return 0  # inside this direction's cooldown window
+        self._armed[want] = False
+        if now is not None:
+            self._last_emit[want] = now
+        return want
 
 
 # -- the fleet ---------------------------------------------------------------
@@ -666,7 +703,7 @@ class ReplicaFleet:
         self._publish_gauges()
         if self.scale_policy is not None:
             stats["scale_decision"] = self.scale_policy.decide(
-                observability.registry(), stats["replicas"])
+                observability.registry(), stats["replicas"], now=now)
         return stats
 
     def pending(self):
@@ -746,6 +783,22 @@ class ReplicaFleet:
                     unserveable = unserveable or exc
         if unserveable is not None:
             raise unserveable
+
+    def discard(self, rid):
+        """Remove a replica that never went LIVE — the carcass a
+        capacity conversion that died mid-``join`` leaves behind
+        (``live=False`` replicas are never routed to, so its queues
+        are empty by construction).  Live replicas must go through
+        :meth:`preempt`/:meth:`retire` so their work reroutes."""
+        replica = self.replicas.get(rid)
+        if replica is None:
+            return False
+        if replica.live:
+            raise ValueError(f"replica {rid} is live; use preempt() "
+                             f"or retire(), not discard()")
+        del self.replicas[rid]
+        self._publish_gauges()
+        return True
 
     def preempt(self, rid, exc=None, now=None):
         """Deployer/test-facing preemption: shed replica ``rid`` NOW
@@ -868,7 +921,11 @@ class ReplicaFleet:
         with observability.span("fleet/shed",
                                 tags={"replica": rid, "retire": True}):
             replica.live = False
-            self.membership.announce_leave(note=f"retire {rid}")
+            # the leave belongs to the RETIRING replica's rank, not the
+            # router's: over a real multi-controller membership, posting
+            # it for self would exclude the router from its own resolve
+            self.membership.announce_leave(note=f"retire {rid}",
+                                           rank=rid)
             self.view = self._resolve(survivors)
             reqs = replica.drain_for_reroute(now=now)
             self._reroute(reqs, exclude=(rid,))

@@ -154,6 +154,17 @@ class FleetRouter:
         return {r.rid: r.queue_depth(tenant)
                 for r in self.fleet.live_replicas()}
 
+    def pressure(self):
+        """The deepest per-tenant backlog summed over live replicas —
+        the same aggregation as the fleet's queue-depth gauge (what
+        the scale policy's water marks compare against), readable
+        without the metrics registry.  ``0`` with no pending work."""
+        totals = {}
+        for replica in self.fleet.live_replicas():
+            for tenant, d in replica.tenant_depths().items():
+                totals[tenant] = totals.get(tenant, 0) + d
+        return max(totals.values()) if totals else 0
+
     def placements(self, rid):
         """Request ids currently placed on replica ``rid`` (ledger
         view; completed requests are scrubbed by the fleet)."""

@@ -45,6 +45,8 @@ def main():
         run_elastic(pid, nprocs, tmpdir)
     elif scenario == "fleet":
         run_fleet(pid, nprocs, tmpdir)
+    elif scenario == "capacity":
+        run_capacity(pid, nprocs, tmpdir)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
 
@@ -996,6 +998,214 @@ def run_fleet(pid, nprocs, tmpdir):
     for rep in fleet.replicas.values():
         if rep.remote and rep.live:
             rep.stop()
+    print("ALL_OK", flush=True)
+
+
+def run_capacity(pid, nprocs, tmpdir):
+    """Capacity transfer over REAL 2-process gloo transport (the
+    ISSUE 16 chaos gate).  Process 0 is the router + replica 0 + the
+    :class:`CapacityBroker`; process 1 is the convertible rank.
+
+    Leg A (chaos): a seeded ``FaultSpec(op="capacity.convert",
+    action="preempt", step="CONVERTING")`` kills the conversion AFTER
+    rank 1's training leave landed but BEFORE its fleet admission.  The
+    survivor's ``recover_orphans`` sweep detects the frozen journal
+    beat through the REAL KV store, aborts the orphan (rank 1 ends in
+    NEITHER role group, journal scrubbed), and rank 1 re-enters
+    training through the ordinary elastic join — a consistent two-role
+    world after a mid-conversion death.
+
+    Leg B (clean arc): queue pressure on replica 0 trips the hysteresis
+    policy's +1, ``broker.apply`` converts rank 1 (training shrinks to
+    {0}, the fleet grows to {0, 1}, the joiner's deliberately-wrong
+    seed-1 weights are overwritten BIT-IDENTICALLY over the multicast
+    tree), the fleet serves the backlog across both replicas with zero
+    drops, the drained queues trip the -1, and ``apply`` retires rank 1
+    back into training — journal cleared, both role groups whole."""
+    import time
+
+    import numpy as np
+    import jax
+
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import (ElasticMembership,
+                                             FaultSchedule)
+    from chainermn_tpu.communicators.fault_schedule import RankPreempted
+    from chainermn_tpu.elastic import CapacityBroker
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.serving import (FleetWorker, RemoteReplica,
+                                       ReplicaFleet, Request,
+                                       ServingEngine)
+    from chainermn_tpu.serving.fleet import QueueDepthScalePolicy
+
+    CAP_TAG = 7003
+    comm = ct.create_communicator("jax_ici")
+    ch = comm._host_channel()
+    ch._timeout_ms = 8000
+    kv = ch._client
+    train = ElasticMembership(kv, rank=pid, world=nprocs, role="elastic",
+                              settle_s=2.0 if pid == 0 else 0.5,
+                              poll_s=0.02, timeout_ms=90_000)
+
+    def digest(engine):
+        return [np.asarray(x).tobytes()
+                for x in jax.tree.leaves(engine.state)]
+
+    # the joiner seeds DIFFERENT weights: the tree sync must overwrite
+    # them bit-identically from replica 0
+    engine = ServingEngine(TransformerLM(n_vocab=127, d_model=32,
+                                         n_heads=1, n_layers=1,
+                                         max_len=32, seed=pid),
+                           num_pages=32, page_size=16, max_batch=2,
+                           max_context=32, prefix_cache=False)
+    fleet_member = ElasticMembership(kv, rank=pid, world=nprocs,
+                                     role="fleet",
+                                     settle_s=2.0 if pid == 0 else 0.5,
+                                     poll_s=0.02, timeout_ms=90_000)
+
+    if pid == 1:
+        worker = FleetWorker(engine, ch, membership=fleet_member,
+                             router_process=0)
+        # -- leg A: the broker's conversion died mid-flight; after the
+        # survivor's abort this rank is in NEITHER group and comes back
+        # through the ordinary elastic join
+        msg = ch.recv_obj(0, tag=CAP_TAG)
+        assert msg == ("rejoin_training",), msg
+        train.announce_join(note="back after aborted conversion")
+        view = train.resolve(expect={0, 1}, require={0})
+        assert view.members == (0, 1), view
+        _ok("capacity_abort_rank_rejoined")
+        # -- leg B: become a fleet replica, adopt weights over the tree
+        msg = ch.recv_obj(0, tag=CAP_TAG)
+        assert msg == ("convert",), msg
+        fleet_member.announce_join(note="capacity transfer")
+        fview = fleet_member.resolve(expect={0, 1}, require={0})
+        assert 1 in fview and fview.role == "fleet", fview
+        rounds = worker.sync_weights(fview, joiners=(1,))
+        assert rounds == 1, rounds
+        ch.send_obj(digest(engine), 0, tag=CAP_TAG)
+        outcome = worker.serve()
+        assert outcome == "stopped", outcome
+        _ok("capacity_worker_served_and_stopped")
+        # the retire landed: rejoin training through the grow path
+        train.announce_join(note="capacity transfer: rejoin")
+        view = train.resolve(expect={0, 1}, require={0})
+        assert view.members == (0, 1), view
+        _ok("capacity_retire_rank_rejoined")
+        print("ALL_OK", flush=True)
+        return
+
+    # -- process 0: router + replica 0 + the broker --------------------------
+    policy = QueueDepthScalePolicy(scale_up_depth=2, scale_down_depth=0,
+                                   min_replicas=1, max_replicas=2)
+    fleet = ReplicaFleet(engines={0: engine}, membership=fleet_member,
+                         min_replicas=1, scale_policy=policy)
+    sched = FaultSchedule([dict(op="capacity.convert", action="preempt",
+                                prob=1.0, step="CONVERTING", rank=1,
+                                count=1)], seed=1234).bind_rank(1)
+    broker = CapacityBroker(train, fleet,
+                            engine_factory=lambda r: RemoteReplica(
+                                r, ch, r),
+                            min_world=1, stale_s=1.0, schedule=sched)
+
+    # -- leg A: seeded preempt mid-conversion --------------------------------
+    try:
+        broker.convert_to_serving(rank=1)
+        raise AssertionError("seeded mid-conversion preempt never fired")
+    except RankPreempted:
+        pass
+    entry = train.read_conversion(1)
+    assert entry is not None and entry[0] == "CONVERTING", entry
+    _ok("capacity_kill_mid_conversion")
+    broker.schedule = None
+    deadline = time.monotonic() + 30
+    actions = ()
+    while not actions and time.monotonic() < deadline:
+        actions = broker.recover_orphans()
+        time.sleep(0.25)
+    assert actions == ((1, "CONVERTING", "abort"),), actions
+    assert train.scan_conversions() == {}
+    # the world rolled forward consistent: training {0} (the announced
+    # leave landed), fleet {0}, the dead conversion in NEITHER role
+    tview = train.resolve(expect={0})
+    assert tview.members == (0,), tview
+    assert [r.rid for r in fleet.live_replicas()] == [0]
+    assert 1 not in fleet.replicas and 1 not in broker.converted
+    _ok("capacity_orphan_aborted")
+    ch.send_obj(("rejoin_training",), 1, tag=CAP_TAG)
+    deadline = time.monotonic() + 60
+    while not train.pending_joins() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert train.pending_joins() == (1,), "rank 1 never announced rejoin"
+    tview = train.resolve(expect={0, 1})
+    assert tview.members == (0, 1), tview
+    _ok("capacity_abort_rank_rejoined")
+
+    # -- leg B: pressure -> convert -> serve -> drain -> retire --------------
+    rng = np.random.RandomState(3)
+    N_REQS = 8
+    prompts = [rng.randint(1, 127, rng.randint(4, 9)).astype(np.int32)
+               for _ in range(N_REQS)]
+    reqs = [Request(p, 4, tenant=f"t{i % 2}", arrival_time=0.0,
+                    request_id=i) for i, p in enumerate(prompts)]
+    for r in reqs:
+        fleet.submit(r)
+    st = fleet.step()
+    assert st["scale_decision"] == 1, st
+    ch.send_obj(("convert",), 1, tag=CAP_TAG)
+    # wait for the worker's fleet join intent so the admission resolve
+    # can never settle without it
+    deadline = time.monotonic() + 60
+    while fleet_member._try_get(f"{fleet_member._base}/join/1") is None \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    res = broker.apply(st["scale_decision"])
+    assert res == ("convert", 1), res
+    assert train.read_conversion(1)[0] == "SERVING"
+    # one pool, two roles: training shrank to the survivor while the
+    # fleet grew
+    tview = train.resolve(expect={0})
+    assert tview.members == (0,), tview
+    assert sorted(r.rid for r in fleet.live_replicas()) == [0, 1]
+    _ok("capacity_auto_converted")
+    joiner_digest = ch.recv_obj(1, tag=CAP_TAG)
+    assert joiner_digest == digest(engine), \
+        "tree sync did not land bit-identical weights on the joiner"
+    _ok("capacity_sync_bit_identical")
+    # serve the backlog across BOTH replicas, zero drops
+    more = [Request(rng.randint(1, 127, 5).astype(np.int32), 3,
+                    tenant=f"t{i % 2}", arrival_time=0.0,
+                    request_id=100 + i) for i in range(6)]
+    placements = [fleet.submit(r) for r in more]
+    assert 1 in placements, placements
+    decision = 0
+    steps = 0
+    while fleet.pending() and steps < 10000:
+        st = fleet.step()
+        if st["scale_decision"]:
+            decision = st["scale_decision"]
+        steps += 1
+    done = sorted(r.request_id for r in fleet.completed)
+    assert done == sorted([r.request_id for r in reqs]
+                          + [r.request_id for r in more]), done
+    _ok("capacity_zero_drop")
+    # drained queues tripped the policy's -1: auto-applied retire
+    assert decision == -1, decision
+    res = broker.apply(decision)
+    assert res == ("retire", 1), res
+    assert train.read_conversion(1) is None
+    assert [r.rid for r in fleet.live_replicas()] == [0]
+    assert broker.stats["conversions"] == 1 \
+        and broker.stats["retires"] == 1 \
+        and broker.stats["role_transfers"] == 2, broker.stats
+    # re-admit the returning rank into training
+    deadline = time.monotonic() + 60
+    while not train.pending_joins() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert train.pending_joins() == (1,), "retired rank never rejoined"
+    tview = train.resolve(expect={0, 1})
+    assert tview.members == (0, 1), tview
+    _ok("capacity_retired_to_training")
     print("ALL_OK", flush=True)
 
 

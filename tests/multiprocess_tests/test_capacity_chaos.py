@@ -1,0 +1,38 @@
+"""Capacity transfer chaos over REAL 2-process gloo transport (the
+ISSUE 16 acceptance gate, see docs/resilience.md §8 "Capacity
+transfer").
+
+One run, two legs.  Leg A: a seeded
+``FaultSpec(op="capacity.convert", action="preempt",
+step="CONVERTING")`` kills the conversion AFTER rank 1's training
+leave landed but BEFORE its fleet admission — the survivor's
+``recover_orphans`` sweep detects the frozen journal beat through the
+real KV store, aborts the orphan (the rank ends in NEITHER role group,
+journal scrubbed), and the rank re-enters training through the
+ordinary elastic join.  Leg B: queue pressure trips the hysteresis
+policy's +1, ``CapacityBroker.apply`` converts rank 1 (training
+shrinks to {0}, the fleet grows to {0, 1}, the joiner's
+deliberately-wrong weights overwritten BIT-IDENTICALLY over the
+multicast tree), the fleet serves the backlog across both replicas
+with zero drops, the drained queues trip the -1, and the broker
+retires the rank back into training — both role groups whole."""
+
+import pytest
+
+from .test_two_process import _launch
+
+pytestmark = pytest.mark.chaos
+
+
+def test_two_process_capacity_transfer_chaos(tmp_path):
+    outs = _launch("capacity", 2, tmp_path, timeout=420)
+    for rc, out in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-6000:]}"
+        assert "ALL_OK" in out, out[-6000:]
+    combined = "\n".join(out for _, out in outs)
+    for name in ("capacity_kill_mid_conversion", "capacity_orphan_aborted",
+                 "capacity_abort_rank_rejoined", "capacity_auto_converted",
+                 "capacity_sync_bit_identical", "capacity_zero_drop",
+                 "capacity_worker_served_and_stopped",
+                 "capacity_retired_to_training"):
+        assert f"PASS {name}" in combined, (name, combined[-6000:])

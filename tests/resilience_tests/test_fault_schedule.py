@@ -213,3 +213,74 @@ def test_rank_filter_preserves_rng_stream_alignment():
 def test_rank_validation():
     with pytest.raises(ValueError):
         FaultSpec(op="x", nth=1, rank=-2)
+
+
+# -- conversion-step targeting (ISSUE 16 satellite) ---------------------------
+
+def test_step_spec_round_trips():
+    """FaultSpec.to_dict carries the capacity-protocol step target
+    through the dict/JSON round trip."""
+    spec = FaultSpec(op="capacity.convert", action="preempt", nth=1,
+                     rank=1, step="CONVERTING")
+    d = spec.to_dict()
+    assert d == {"op": "capacity.convert", "action": "preempt",
+                 "nth": 1, "rank": 1, "step": "CONVERTING"}
+    assert FaultSpec(**d).to_dict() == d
+    import json
+    s = FaultSchedule([spec], seed=5)
+    assert FaultSchedule.from_json(
+        json.dumps(s.to_dict())).to_dict() == s.to_dict()
+
+
+def test_step_targeted_spec_fires_only_at_named_step():
+    spec = dict(op="capacity.convert", action="preempt", prob=1.0,
+                step="RETIRING")
+    s = FaultSchedule([spec], seed=0)
+    assert s.on_call("capacity.convert", step="LEAVE_ANNOUNCED") is None
+    assert s.on_call("capacity.convert", step="CONVERTING") is None
+    fault = s.on_call("capacity.convert", step="RETIRING")
+    assert fault is not None and fault.action == "preempt"
+    # count=1 default was only consumed at the MATCHING step
+    assert s.on_call("capacity.convert", step="RETIRING") is None
+    # a step-free spec still fires at step-passing call sites
+    free = FaultSchedule([dict(op="capacity.convert", nth=1)])
+    assert free.on_call("capacity.convert", step="SERVING") is not None
+    # and a step-restricted spec never fires at a step-less call site
+    assert FaultSchedule([spec], seed=0).on_call("capacity.convert") \
+        is None
+
+
+def test_step_filter_preserves_rng_stream_alignment():
+    """Step filtering mirrors rank filtering: the draw is consumed on
+    every call regardless of the step match, so two ranks executing
+    DIFFERENT protocol steps consume identical RNG stream positions —
+    the shared schedule's other specs stay call-site-aligned."""
+    specs = [dict(op="op", action="preempt", prob=0.5,
+                  step="CONVERTING", count=None),
+             dict(op="op", prob=0.3, count=None)]
+
+    def fired_sites(step_sequence):
+        s = FaultSchedule(specs, seed=11)
+        out = []
+        for i, step in enumerate(step_sequence):
+            f = s.on_call("op", step=step)
+            if f is not None:
+                out.append((i, f.action))
+        return out
+
+    at_step = fired_sites(["CONVERTING"] * 40)
+    off_step = fired_sites(["RETIRING"] * 40)
+    assert not any(a == "preempt" for _, a in off_step)
+    preempts = {i for i, a in at_step if a == "preempt"}
+    assert preempts
+    # outside the sites the step-targeted preempt won, the shared
+    # 'raise' spec fires at IDENTICAL indices on both sequences
+    assert [i for i, a in off_step if a == "raise" and i not in preempts] \
+        == [i for i, a in at_step if a == "raise"]
+
+
+def test_step_validation():
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", nth=1, step="")
+    with pytest.raises(ValueError):
+        FaultSpec(op="x", nth=1, step=7)

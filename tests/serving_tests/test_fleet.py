@@ -401,6 +401,69 @@ def test_queue_depth_scale_policy_reads_registry_gauges():
     assert policy.decide(reg, 1) == 0     # at min_replicas: hold
 
 
+def test_queue_depth_scale_policy_hysteresis_one_spike_one_decision():
+    """ISSUE 16 satellite: a sustained excursion past a water mark
+    collapses to ONE decision — the direction re-arms only once the
+    gauge crosses back past its own mark (the PR 15 stateless read
+    re-emitted +1 on every step of one spike)."""
+    reg = observability.registry()
+    policy = QueueDepthScalePolicy(scale_up_depth=8, scale_down_depth=2,
+                                   min_replicas=1, max_replicas=4)
+    g = reg.gauge(QueueDepthScalePolicy.GAUGE)
+    g.set(9, tenant="a")
+    assert policy.decide(reg, 2) == 1
+    # the spike persists: NOT re-emitted
+    assert policy.decide(reg, 2) == 0
+    assert policy.decide(reg, 3) == 0
+    # dips below the HIGH mark but stays above the LOW mark: re-arms
+    # the up direction, emits nothing (inside the band)
+    g.set(5, tenant="a")
+    assert policy.decide(reg, 3) == 0
+    # a fresh spike is a fresh decision
+    g.set(12, tenant="a")
+    assert policy.decide(reg, 3) == 1
+    assert policy.decide(reg, 3) == 0
+    # drain past the low mark: one shrink, then silence while parked
+    g.set(1, tenant="a")
+    assert policy.decide(reg, 4) == -1
+    assert policy.decide(reg, 3) == 0
+    assert policy.decide(reg, 2) == 0
+    # back inside the band re-arms the down direction
+    g.set(5, tenant="a")
+    assert policy.decide(reg, 2) == 0
+    g.set(0, tenant="a")
+    assert policy.decide(reg, 2) == -1
+
+
+def test_queue_depth_scale_policy_cooldown_windows():
+    """Per-direction cooldowns (enforced only when the caller threads
+    ``now``): a re-armed direction still holds until its window
+    elapses; the legacy now-less call sites keep the re-arm rule
+    alone."""
+    reg = observability.registry()
+    policy = QueueDepthScalePolicy(scale_up_depth=8, scale_down_depth=0,
+                                   max_replicas=8, up_cooldown_s=10.0,
+                                   down_cooldown_s=20.0)
+    g = reg.gauge(QueueDepthScalePolicy.GAUGE)
+    g.set(9, tenant="a")
+    assert policy.decide(reg, 2, now=0.0) == 1
+    g.set(3, tenant="a")                   # re-arm up
+    assert policy.decide(reg, 2, now=1.0) == 0
+    g.set(9, tenant="a")
+    assert policy.decide(reg, 2, now=5.0) == 0    # re-armed, cooling
+    assert policy.decide(reg, 2, now=12.0) == 1   # window elapsed
+    # the down direction's window is independent
+    g.set(0, tenant="a")
+    assert policy.decide(reg, 3, now=13.0) == -1
+    g.set(9, tenant="a")                   # re-arm down on the way up
+    policy.decide(reg, 3, now=14.0)
+    g.set(0, tenant="a")
+    assert policy.decide(reg, 3, now=20.0) == 0   # still cooling
+    assert policy.decide(reg, 3, now=34.0) == -1
+    with pytest.raises(ValueError):
+        QueueDepthScalePolicy(scale_up_depth=2, scale_down_depth=5)
+
+
 # -- the fleet arc on real engines (tiny: the tier-1 compile budget) ---------
 
 def _make_engine(seed=0):

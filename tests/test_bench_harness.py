@@ -1113,6 +1113,42 @@ def test_fleet_knobs_invalidate_flagship_cache(monkeypatch):
             == bench._DEFAULT_FINGERPRINTS[model]
 
 
+def test_diurnal_knobs_invalidate_flagship_cache(monkeypatch):
+    """ISSUE 16 satellite: the diurnal capacity-transfer knobs
+    (BENCH_DIURNAL / BENCH_DIURNAL_PERIOD) are fingerprint knobs on
+    BOTH flagship models, a row whose world changed ROLE mid-window
+    (non-zero conversions/role_transfers) is payload-fenced even with
+    a clean environment, and legacy entries backfill the broker-less
+    defaults (backfill-safe schema bump)."""
+    # env half: the diurnal knobs defeat the flagship fingerprint
+    monkeypatch.setenv("BENCH_DIURNAL", "1")
+    assert bench._config_fingerprint("resnet50")["diurnal"] is True
+    assert bench._config_fingerprint("transformer")["diurnal"] is True
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_DIURNAL", raising=False)
+    monkeypatch.setenv("BENCH_DIURNAL_PERIOD", "30")
+    assert bench._config_fingerprint("resnet50")["diurnal_period"] == 30
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_DIURNAL_PERIOD", raising=False)
+    assert bench._cacheable(TPU_RESULT)
+    # payload half: planted rows that executed capacity transfers are
+    # refused (legacy rows lacking the keys had no broker — eligible)
+    assert not bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "conversions": 1})
+    assert not bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "conversions": 0, "role_transfers": 2})
+    assert bench._payload_flagship_ok(
+        "resnet50", {**TPU_RESULT, "conversions": 0, "role_transfers": 0})
+    assert bench._payload_flagship_ok("resnet50", TPU_RESULT)
+    # backfill: a stored pre-round-17 fingerprint gains the defaults
+    for model in ("resnet50", "transformer"):
+        fp = dict(bench._DEFAULT_FINGERPRINTS[model])
+        fp.pop("diurnal")
+        fp.pop("diurnal_period")
+        assert bench._backfill_fp(model, fp) \
+            == bench._DEFAULT_FINGERPRINTS[model]
+
+
 def test_compile_credit_math(tmp_path):
     """The supervisor's deadline extension: recorded compile seconds,
     plus the in-flight phase's elapsed time, capped at grace, zero for

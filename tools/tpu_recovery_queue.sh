@@ -73,6 +73,20 @@
 #     the `weight_sync_s` tree-sync cost in BENCH_NOTES.  Fleet rows are
 #     fingerprint- AND metric-fenced out of the flagship cache.
 #
+# 10. capacity-transfer diurnal A/B (ISSUE 16): the BENCH_DIURNAL=1
+#     serving row below (sinusoidal arrival rate; the hysteresis
+#     policy's +1/-1 auto-applied by the CapacityBroker as REAL
+#     training->serving->training role transfers — the row's
+#     conversions/role_transfers/convert_s columns) vs the flagship
+#     serving row, PLUS the 2-process gloo `bench_scaling --capacity`
+#     A/B (rank 1 keeps training through the burst vs rank 1 converted
+#     into a second replica and retired after the drain; gates zero
+#     drops + final-loss parity ±5%).  STAMP `convert_s` (full
+#     leave->admit->tree-sync conversion cost), `weight_sync_s`, and
+#     the summary `p99_ms_saved_vs_training_priority` in BENCH_NOTES.
+#     Diurnal rows are fingerprint- AND payload-fenced (any non-zero
+#     conversions/role_transfers) out of the flagship cache.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
@@ -264,6 +278,18 @@ run_one "serving tp=2 paged decode (A/B vs single-chip)" \
 run_one "serving fleet 2 replicas kill@40 (A/B: reroute + tree sync)" \
   BENCH_MODEL=serving BENCH_SERVE_REPLICAS=2 BENCH_FLEET_KILL_AT=40 \
   BENCH_DEADLINE_S=900
+# ISSUE 16: the capacity-transfer diurnal A/B — sinusoidal arrivals
+# (λ swings qps·(1±0.8) over a 30 s period) against a fleet whose
+# hysteresis policy decisions the CapacityBroker EXECUTES: the peak
+# converts a synthetic training rank into a second replica (clean
+# leave -> fleet admission -> multicast-tree weight sync), the trough
+# retires it back.  Deltas vs the flagship serving row = what the
+# borrowed replica buys at peak; `conversions`/`role_transfers`/
+# `convert_s` are the row's transfer accounting.  Diurnal rows are
+# fingerprint- AND payload-fenced out of the flagship cache.
+run_one "serving diurnal capacity transfer (A/B: borrowed replica)" \
+  BENCH_MODEL=serving BENCH_DIURNAL=1 BENCH_DIURNAL_PERIOD=30 \
+  BENCH_DEADLINE_S=900
 # ISSUE 12: the MoE dispatch A/B — the Switch-FFN expert-parallel
 # vertical under the flat single-axis dispatch, the two-stage ici×dcn
 # dispatch on the forced 2x4 split, and the two-stage dispatch with
@@ -353,6 +379,14 @@ stepf=$STEPDIR/step_commab.log
   # summary line's p99 spike vs the uninterrupted leg is the
   # detection-bounded number checklist item 9 stamps
   python bench_scaling.py --gloo-procs 1,2 --fleet-kill 2
+  # ISSUE 16: the >=2-host capacity-transfer A/B — one leg where rank 1
+  # keeps training through the serving burst (one replica), one where
+  # the CapacityBroker converts it into a second replica over the real
+  # KV membership + multicast tree and retires it after the drain;
+  # gates zero drops + final-loss parity (±5%); the summary line's
+  # p99_ms_saved_vs_training_priority is the number checklist item 10
+  # stamps
+  python bench_scaling.py --gloo-procs 1,2 --capacity
 } > "$stepf" 2>&1 || true
 cat "$stepf"
 if grep -q '^{' "$stepf"; then
