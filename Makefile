@@ -4,7 +4,7 @@
 
 PY := PYTHONPATH=$(CURDIR):$$PYTHONPATH python
 
-.PHONY: test chaos chaos-elastic chaos-fleet chaos-convert bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-serving probe-obs sweep-flash audit dryrun examples clean
+.PHONY: test chaos chaos-elastic chaos-fleet chaos-convert bench bench-smoke bench-prewarm bench-status bench-input scaling scaling-gloo watch watch-status probe-input probe-bytes probe-flash probe-comm probe-autotune probe-serving probe-obs sweep-flash audit dryrun examples clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -144,6 +144,17 @@ probe-comm:       ## committed gradient-exchange budgets + live per-bucket/per-h
 	@# per-hop table (hop, collective, bytes, dtype) on the simulated
 	@# 2-host split.  Trace property — chip-free.
 	PROBE=comm PROBE_PLATFORM=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 $$XLA_FLAGS" $(PY) tools/probe_perf.py
+
+probe-autotune:   ## committed autotune plan artifact + live micro-bench/derivation (no chip)
+	@# the startup fabric micro-bench on the simulated 8-device mesh,
+	@# the plan it derives (fingerprint, bucket_mb, stripe_ratio,
+	@# grad_dtype + derivation notes), the join against
+	@# tools/autotune_plan.json (the tier-1 gate
+	@# tests/test_autotune_plan.py's data), and the per-knob provenance
+	@# table (plan value / hand-set / applied).  CPU-sim numbers are
+	@# labeled mechanics-only — the artifact's numeric half is stamped
+	@# exclusively by the recovery queue's FIRST-CHIP-CONTACT item 11.
+	PROBE=autotune PROBE_PLATFORM=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8 $$XLA_FLAGS" $(PY) tools/probe_perf.py
 
 audit:            ## StableHLO dtype census, resnet + transformer (no chip)
 	PROBE=precision_audit $(PY) tools/probe_perf.py

@@ -405,7 +405,8 @@ _DEFAULT_FINGERPRINTS = {
                  "grad_dtype": "bfloat16", "error_feedback": True,
                  "preempt_rank": -1, "trace": "off",
                  "serve_replicas": 1, "fleet_kill_at": -1,
-                 "diurnal": False, "diurnal_period": 0.0},
+                 "diurnal": False, "diurnal_period": 0.0,
+                 "autotune": False},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -418,7 +419,8 @@ _DEFAULT_FINGERPRINTS = {
                     "grad_dtype": "bfloat16", "error_feedback": True,
                     "preempt_rank": -1, "trace": "off",
                     "serve_replicas": 1, "fleet_kill_at": -1,
-                    "diurnal": False, "diurnal_period": 0.0},
+                    "diurnal": False, "diurnal_period": 0.0,
+                    "autotune": False},
 }
 
 def _env_float(name, default):
@@ -516,6 +518,10 @@ def _config_fingerprint(model=None):
             # measurement, never flagship data
             "diurnal": os.environ.get("BENCH_DIURNAL", "0") == "1",
             "diurnal_period": _env_float("BENCH_DIURNAL_PERIOD", 0),
+            # the self-tuning A/B (ISSUE 19): an autotuned exchange
+            # executes whatever plan the micro-bench derived — a
+            # measurement of that plan, never flagship data
+            "autotune": os.environ.get("BENCH_AUTOTUNE", "0") == "1",
         }
     return {
         "model": "resnet50",
@@ -541,6 +547,7 @@ def _config_fingerprint(model=None):
         "fleet_kill_at": _env_int("BENCH_FLEET_KILL_AT", -1),
         "diurnal": os.environ.get("BENCH_DIURNAL", "0") == "1",
         "diurnal_period": _env_float("BENCH_DIURNAL_PERIOD", 0),
+        "autotune": os.environ.get("BENCH_AUTOTUNE", "0") == "1",
     }
 
 
@@ -892,18 +899,28 @@ def _make_bench_communicator(exchange, bucket_mb):
     over it).  Returns ``(comm, opt_exchange)``."""
     import chainermn_tpu as ct
     comm_name, bc, opt_exchange = ct.communicators.exchange_knobs(exchange)
+    autotune = os.environ.get("BENCH_AUTOTUNE", "0") == "1"
     inter_size = _env_int("BENCH_INTER_SIZE", 0) or None
     grad_dtype = os.environ.get("BENCH_GRAD_DTYPE", "bfloat16")
     grad_dtype = None if grad_dtype.lower() in ("none", "") else grad_dtype
+    if autotune and "BENCH_GRAD_DTYPE" not in os.environ:
+        # the autotune leg (ISSUE 19, queue item 11) leaves every knob
+        # the operator did not explicitly set free for the agreed plan
+        # to fill — applying the flagship bf16 default here would read
+        # as a hand knob and pin the dtype ladder shut
+        grad_dtype = None
     # the striped legs (ISSUE 11) need a NONZERO ratio or they would
     # silently measure the strict hierarchical schedule under the
-    # striped name: BENCH_STRIPE_RATIO, else the committed default
+    # striped name: BENCH_STRIPE_RATIO, else the committed default —
+    # except under autotune, where an unset ratio stays FREE for the
+    # derived plan (that is the measurement)
     stripe_ratio = None
     if exchange in ("striped", "striped_rs"):
         from chainermn_tpu.communicators._memory_utility import \
             DEFAULT_STRIPE_RATIO
-        stripe_ratio = _env_float("BENCH_STRIPE_RATIO", 0) \
-            or DEFAULT_STRIPE_RATIO
+        stripe_ratio = _env_float("BENCH_STRIPE_RATIO", 0) or None
+        if stripe_ratio is None and not autotune:
+            stripe_ratio = DEFAULT_STRIPE_RATIO
     comm = ct.create_communicator(comm_name,
                                   allreduce_grad_dtype=grad_dtype,
                                   batch_collectives=bc,
@@ -912,7 +929,8 @@ def _make_bench_communicator(exchange, bucket_mb):
                                   if comm_name == "hierarchical" else None,
                                   stripe_ratio=stripe_ratio,
                                   error_feedback=os.environ.get(
-                                      "BENCH_ERROR_FEEDBACK", "1") == "1")
+                                      "BENCH_ERROR_FEEDBACK", "1") == "1",
+                                  autotune=True if autotune else None)
     return comm, opt_exchange
 
 

@@ -935,6 +935,184 @@ def _run_elastic_ab(nprocs, per_rank_bs, hidden, steps, preempt_rank):
     return rows
 
 
+def _gloo_autotune_worker(pid, nprocs, port, per_rank_bs, hidden, steps,
+                          mode, ratio):
+    """One process of the ISSUE 19 autotune A/B: the same hierarchical
+    compiled DP step as ``_gloo_worker``'s striped legs, but leg
+    ``auto`` builds its communicator with ``autotune=True`` (the
+    startup micro-bench runs over the real gloo fabric and the agreed
+    plan fills the knobs the caller left free) while leg ``hand`` pins
+    ``stripe_ratio`` to the value the auto leg derived.  Every per-step
+    loss travels in the row as ``float.hex()`` — the parent gates
+    BITWISE equality between the two legs (the golden-trajectory
+    contract: a derived plan matching the hand knobs must compile the
+    identical program)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    from chainermn_tpu.communicators._communication_utility import (
+        initialize_distributed)
+    assert initialize_distributed(f"localhost:{port}",
+                                  num_processes=nprocs, process_id=pid)
+    import chainermn_tpu as ct
+    from chainermn_tpu.core.optimizer import MomentumSGD
+    from chainermn_tpu.models import MLP, Classifier
+
+    if mode == "auto":
+        # stripe_ratio deliberately NOT passed: the knob must stay free
+        # for the agreed plan to fill (hand knobs always win — a pinned
+        # ratio here would make the A/B compare hand vs hand)
+        comm = ct.create_communicator("hierarchical",
+                                      batch_collectives=True,
+                                      autotune=True)
+        assert comm.autotune_plan is not None
+        assert comm.striped, \
+            "autotune must have applied the derived stripe plan"
+    else:
+        comm = ct.create_communicator("hierarchical",
+                                      batch_collectives=True,
+                                      stripe_ratio=float(ratio))
+    assert comm.size == nprocs == jax.device_count()
+    model = Classifier(MLP(n_units=hidden, n_out=10, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.01, momentum=0.9), comm).setup(model)
+
+    gbs = per_rank_bs * nprocs
+    rng = np.random.RandomState(0)
+    x = np.asarray(rng.normal(0, 1, (gbs, 64)).astype(np.float32))
+    t = np.asarray(rng.randint(0, 10, gbs).astype(np.int32))
+
+    losses = []
+    for _ in range(3):  # trace+compile, then steady-state warmup
+        losses.append(float(opt.update(model, x, t)))
+    if nprocs > 1:
+        comm._host_channel().barrier()
+    start = time.perf_counter()
+    for _ in range(steps):
+        # the per-step float() sync is part of BOTH legs' measured
+        # loop, so the step_ms rows stay comparable — and the full
+        # loss trajectory is what the bitwise gate compares
+        losses.append(float(opt.update(model, x, t)))
+    dt = time.perf_counter() - start
+    if pid == 0:
+        row = {"mode": mode, "processes": nprocs,
+               "per_rank_bs": per_rank_bs,
+               "stripe_ratio": comm.stripe_ratio,
+               "step_ms": round(dt / steps * 1e3, 3),
+               "examples_per_sec": round(steps * gbs / dt, 1),
+               "losses_hex": [float(v).hex() for v in losses]}
+        if mode == "auto":
+            plan = comm.autotune_plan
+            dcn = plan["measurements"]["hops"].get("dcn") or {}
+            row["plan"] = {
+                "fingerprint": plan["fingerprint"],
+                "stripe_ratio": plan["stripe_ratio"],
+                "bucket_mb": plan["bucket_mb"],
+                "grad_dtype": plan["grad_dtype"],
+                "dcn_gbps": dcn.get("gbps"),
+                "dcn_lat_us": dcn.get("lat_us"),
+                "notes": plan["derivation"]["notes"]}
+        print(json.dumps(row), flush=True)
+
+
+#: sweep legs of the --autotune optimum-band gate, and how far (mean
+#: step_ms, relative) a ratio may sit above the sweep winner and still
+#: count as inside the band.  Generous on purpose: loopback gloo on a
+#: time-sliced host is noisy, and at one device per process the ICI hop
+#: is wireless, which flattens the ratio curve toward a tie
+AUTOTUNE_SWEEP_RATIOS = (0.25, 0.5, 0.75)
+AUTOTUNE_BAND_TOL = 0.35
+
+
+def _run_autotune_ab(nprocs, per_rank_bs, hidden, steps):
+    """The 2-process gloo autotune A/B (ISSUE 19) — the promotion of
+    the queued three-invocation striped ratio sweep into ONE
+    self-gating invocation.  Leg 1 builds its communicator with
+    ``autotune=True`` (startup micro-bench over the real gloo fabric,
+    agreed plan applied); leg 2 hand-pins ``stripe_ratio`` to the
+    derived value.  Gates: (a) BITWISE golden-trajectory equality
+    between the two legs — the derived plan must compile exactly the
+    program the equivalent hand knobs would; (b) the derived ratio
+    lands inside the measured optimum band of the
+    ``AUTOTUNE_SWEEP_RATIOS`` sweep (mean step_ms within
+    ``AUTOTUNE_BAND_TOL`` of the sweep winner).  In the gloo world the
+    ICI axis is size 1 (unmeasurable), so the derived ratio is the
+    documented DEFAULT_STRIPE_RATIO fallback — the band gate then
+    checks the fallback itself is not a measured pessimization."""
+    import re
+    import socket
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    if "XLA_FLAGS" in env:
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "",
+            env["XLA_FLAGS"])
+    # a leaked ratio env var would hand-pin the auto leg's knob and turn
+    # the golden gate into hand-vs-hand
+    env.pop("CHAINERMN_TPU_STRIPE_RATIO", None)
+
+    def leg(mode, ratio):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--gloo-autotune-worker", str(pid), str(nprocs), str(port),
+             str(per_rank_bs), str(hidden), str(steps), mode, str(ratio)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for pid in range(nprocs)]
+        outs = []
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=600)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0])
+        assert all(p.returncode == 0 for p in procs), \
+            [(p.returncode, o[-2000:]) for p, o in zip(procs, outs)]
+        row = json.loads([ln for ln in outs[0].splitlines()
+                          if ln.startswith("{")][-1])
+        print(json.dumps({k: v for k, v in row.items()
+                          if k != "losses_hex"}), flush=True)
+        return row
+
+    auto = leg("auto", "-")
+    derived = auto["plan"]["stripe_ratio"]
+    hand = leg("hand", derived)
+    assert auto["losses_hex"] == hand["losses_hex"], \
+        f"golden-trajectory gate FAILED: autotune (plan " \
+        f"{auto['plan']['fingerprint']}) diverged from hand knobs at " \
+        f"stripe_ratio={derived}"
+
+    sweep = {}
+    for r in AUTOTUNE_SWEEP_RATIOS:
+        # the hand leg already measured the derived ratio — reuse its
+        # datum rather than burning a fourth spawn on the same point
+        sweep[r] = hand["step_ms"] if abs(r - derived) < 1e-9 \
+            else leg("hand", r)["step_ms"]
+    winner_ms = min(sweep.values())
+    band = [r for r in AUTOTUNE_SWEEP_RATIOS
+            if sweep[r] <= winner_ms * (1.0 + AUTOTUNE_BAND_TOL)]
+    assert any(abs(derived - r) < 1e-9 for r in band), \
+        f"derived stripe_ratio {derived} is outside the measured " \
+        f"optimum band {band} (sweep step_ms {sweep}, winner " \
+        f"{winner_ms} ms, tol {AUTOTUNE_BAND_TOL:.0%})"
+
+    print(json.dumps({
+        "autotune_ab": True, "processes": nprocs,
+        "derived_stripe_ratio": derived,
+        "plan_fingerprint": auto["plan"]["fingerprint"],
+        "golden_trajectory_equal": True,
+        "sweep_step_ms": {str(r): sweep[r] for r in sorted(sweep)},
+        "optimum_band": band,
+        "derived_in_band": True,
+        "measured_dcn_gbps": auto["plan"]["dcn_gbps"],
+        "measured_dcn_lat_us": auto["plan"]["dcn_lat_us"]}), flush=True)
+    return auto, hand, sweep
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--per-chip-bs", type=int, default=8)
@@ -962,6 +1140,20 @@ def main():
                         help=argparse.SUPPRESS)  # internal
     parser.add_argument("--gloo-capacity-worker", nargs=5, default=None,
                         help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--gloo-autotune-worker", nargs=8, default=None,
+                        help=argparse.SUPPRESS)  # internal
+    parser.add_argument("--autotune", action="store_true",
+                        help="run the self-tuning A/B (ISSUE 19): one "
+                             "gloo leg builds its communicator with "
+                             "autotune=True (startup micro-bench over "
+                             "the real fabric, agreed plan applied), "
+                             "one hand-pins the derived knobs; gates "
+                             "BITWISE golden-trajectory equality plus "
+                             "'derived ratio inside the measured "
+                             "optimum band' of the {0.25, 0.5, 0.75} "
+                             "sweep — replaces the queue's three "
+                             "striped ratio-sweep invocations; P = max "
+                             "of --gloo-procs (default 2)")
     parser.add_argument("--capacity", action="store_true",
                         help="run the capacity-transfer A/B (ISSUE 16):"
                              " one gloo leg where rank 1 keeps training"
@@ -1046,6 +1238,19 @@ def main():
         return
     if args.gloo_capacity_worker:
         _gloo_capacity_worker(*map(int, args.gloo_capacity_worker))
+        return
+    if args.gloo_autotune_worker:
+        pid, nprocs, port, bs, hidden, steps = \
+            map(int, args.gloo_autotune_worker[:6])
+        _gloo_autotune_worker(pid, nprocs, port, bs, hidden, steps,
+                              args.gloo_autotune_worker[6],
+                              args.gloo_autotune_worker[7])
+        return
+    if args.autotune:
+        nprocs = max(int(c) for c in args.gloo_procs.split(",")) \
+            if args.gloo_procs else 2
+        _run_autotune_ab(nprocs, args.per_chip_bs, args.gloo_hidden,
+                         args.steps)
         return
     if args.capacity:
         nprocs = max(int(c) for c in args.gloo_procs.split(",")) \

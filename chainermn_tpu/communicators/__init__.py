@@ -48,6 +48,10 @@ from __future__ import annotations
 
 import jax
 
+from ._autotune import (agree_exchange_plan, derive_exchange_plan,
+                        measure_fabric, measurements_from_trace,
+                        plan_fingerprint, record_plan, reduce_measurements,
+                        retune_communicator, topology_summary)
 from ._host_channel import (ChannelError, ChannelTimeoutError, PeerLostError,
                             HostChannel, HeartbeatMonitor)
 from ._membership import (ElasticMembership, MembershipView,
@@ -70,7 +74,11 @@ __all__ = ["create_communicator", "CommunicatorBase", "MeshCommunicator",
            "ChannelError", "ChannelTimeoutError", "PeerLostError",
            "HostChannel", "HeartbeatMonitor",
            "ElasticMembership", "MembershipView", "multicast_tree_plan",
-           "EXCHANGES", "exchange_knobs"]
+           "EXCHANGES", "exchange_knobs",
+           "agree_exchange_plan", "derive_exchange_plan", "measure_fabric",
+           "measurements_from_trace", "plan_fingerprint", "record_plan",
+           "reduce_measurements", "retune_communicator",
+           "topology_summary"]
 
 _NAMES = ("naive", "flat", "hierarchical", "two_dimensional", "single_node",
           "non_cuda_aware", "pure_nccl", "jax_ici", "dummy", "debug",
@@ -125,7 +133,7 @@ def create_communicator(communicator_name="jax_ici", devices=None,
                         batch_collectives=None, bucket_mb=None,
                         fault_schedule=None, intra_size=None,
                         inter_size=None, error_feedback=True,
-                        stripe_ratio=None, **kwargs):
+                        stripe_ratio=None, autotune=None, **kwargs):
     """Create a communicator by reference name.
 
     ``allreduce_grad_dtype``: gradient-compression dtype for the collective
@@ -174,6 +182,20 @@ def create_communicator(communicator_name="jax_ici", devices=None,
     spec dict; defaults to ``CHAINERMN_TPU_FAULT_SCHEDULE`` from the
     environment — the chaos harness's entry point (see
     ``docs/resilience.md``).
+    ``autotune`` (ISSUE 19, docs/performance.md §12): self-tune the
+    exchange knobs from MEASURED fabric numbers instead of guesses.
+    ``True``/``"startup"`` runs the seconds-scale startup micro-bench
+    now (collective — every rank enters), agrees the plan (measurements
+    all-gathered + reduced deterministically, plan broadcast from rank
+    0) and returns the retuned communicator; ``"online"`` defers — the
+    multi-node optimizer re-tunes after its first N steps from the span
+    tracer's ``train/grad_exchange*`` payload-tagged spans; a dict is a
+    RECORDED plan (e.g. the committed ``tools/autotune_plan.json``
+    ``plan`` object) applied directly with no measurement.  The plan
+    only fills knobs not hand-set here (explicit argument or env var) —
+    hand knobs always win, so pinning ``bucket_mb=``/``stripe_ratio=``
+    alongside ``autotune=`` keeps those knobs yours and derives the
+    rest.
     """
     name = communicator_name
     if name not in _NAMES:
@@ -184,6 +206,17 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             f"fault_schedule= is only honored by the 'fault' "
             f"communicator, not {name!r} — a silently dropped schedule "
             f"would make a chaos run pass vacuously")
+    if autotune not in (None, False, True, "startup", "online") \
+            and not isinstance(autotune, dict):
+        raise ValueError(
+            f"autotune must be True/'startup' (micro-bench now), "
+            f"'online' (re-tune from the first N steps' trace), or a "
+            f"recorded plan dict; got {autotune!r}")
+    if autotune and name in ("dummy", "debug"):
+        raise ValueError(
+            f"autotune= is a mesh-communicator knob, not {name!r} — a "
+            f"silently dropped plan would make an autotune run pass "
+            f"vacuously")
     if name == "dummy":
         return DummyCommunicator()
     if name == "fault":
@@ -201,7 +234,7 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             batch_collectives=batch_collectives, bucket_mb=bucket_mb,
             intra_size=intra_size, inter_size=inter_size,
             error_feedback=error_feedback, stripe_ratio=stripe_ratio,
-            **kwargs)
+            autotune=autotune, **kwargs)
         # the hc.* transport hook gets its own schedule CLONE (same
         # specs + seed, separate RNG stream/counters): transport call
         # counts are inherently per-rank asymmetric (root puts,
@@ -297,14 +330,34 @@ def create_communicator(communicator_name="jax_ici", devices=None,
             # MoE two-stage dispatch) can warn precisely — a comm that
             # was never hierarchical must not trigger hatch warnings
             comm._hierarchy_flattened_by_env = True
-            return comm
-    return MeshCommunicator(devices=devices, axis_name=axis_name,
+            return _apply_autotune(comm, autotune)
+    comm = MeshCommunicator(devices=devices, axis_name=axis_name,
                             allreduce_grad_dtype=allreduce_grad_dtype,
                             batch_collectives=batch_collectives,
                             bucket_mb=bucket_mb, name=name,
                             intra_size=intra_size, inter_size=inter_size,
                             error_feedback=error_feedback,
                             stripe_ratio=stripe_ratio)
+    return _apply_autotune(comm, autotune)
+
+
+def _apply_autotune(comm, autotune):
+    """Resolve the factory's ``autotune=`` knob against a freshly built
+    mesh communicator: measure+agree+apply now (``"startup"``), defer
+    to the optimizer face (``"online"`` — the mode rides on the comm),
+    or apply a RECORDED plan dict directly.  Both the retune and the
+    clone it may build are collective, lock-step on every rank — the
+    plan is agreed before anyone rebuilds."""
+    if autotune in (None, False):
+        return comm
+    if isinstance(autotune, dict):
+        return comm.retuned(autotune)
+    if autotune == "online":
+        comm._autotune_mode = "online"
+        return comm
+    comm._autotune_mode = "startup"
+    from ._autotune import retune_communicator
+    return retune_communicator(comm, mode="startup")
 
 
 #: distinct degraded dicts already warned about (one-time per intent —

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 
 __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
            "plan_buckets", "bucket_table", "hop_schedule", "stripe_plan",
+           "derived_stripe_ratio", "derived_bucket_bytes",
+           "plan_buckets_from_measurement", "stripe_plan_from_measurement",
            "exchanged_bytes", "hierarchical_exchanged_bytes",
            "striped_exchanged_bytes", "moe_dispatch_exchanged_bytes",
            "pad_to_multiple", "QUANTIZED_DTYPES", "resolve_grad_dtype",
@@ -42,13 +44,17 @@ __all__ = ["pack_params", "unpack_params", "tree_pack", "tree_unpack",
 #: ring bandwidth while leaving several schedulable units per step)
 DEFAULT_BUCKET_MB = 4.0
 
-#: default DCN share of the striped exchange (ISSUE 11) —
+#: the DOCUMENTED FALLBACK stripe ratio (ISSUE 19), used only when no
+#: fabric measurement exists — NOT a silent always-answer.  The right
+#: value is the slow fabric's share of the mesh's aggregate bandwidth,
+#: ``derived_stripe_ratio(b_ici, b_dcn)`` (docs/performance.md §10's
+#: finish-together split r* = B_dcn / (B_ici + B_dcn)); ``autotune=``
+#: measures the two hops at startup and derives it per topology.  When
+#: a hop is unmeasurable (axis size 1, no measurement yet) the planner
+#: falls back HERE and records why in the plan's derivation notes.
+#: 0.25 is the 1:3 DCN:ICI seed ratio (DCN is the narrow fabric);
 #: ``CHAINERMN_TPU_STRIPE_RATIO`` / ``create_communicator(stripe_ratio=)``
-#: override.  Like ``bucket_mb`` this is a committed-per-topology knob:
-#: the right value is the slow fabric's share of the mesh's aggregate
-#: bandwidth, measured by the ``bench_scaling --gloo-exchange striped``
-#: ratio sweep {0.25, 0.5, 0.75} queued for first chip contact.  0.25
-#: is the conservative pre-measurement seed (DCN is the narrow fabric).
+#: hand-pin it and win over any derived plan.
 DEFAULT_STRIPE_RATIO = 0.25
 
 
@@ -234,6 +240,88 @@ def stripe_plan(n_elems, ratio):
         raise ValueError(f"n_elems must be >= 0, got {n_elems}")
     dcn_elems = int(round(ratio * n_elems))
     return n_elems - dcn_elems, dcn_elems
+
+
+# -- measurement-driven planning (ISSUE 19) ----------------------------------
+def derived_stripe_ratio(b_ici, b_dcn):
+    """The finish-together DCN share from MEASURED per-hop bandwidths —
+    docs/performance.md §10's ``r* = B_dcn / (B_ici + B_dcn)``: both
+    paths of the striped exchange drain at the same instant when each
+    fabric carries bytes in proportion to its bandwidth.
+
+    Deterministic pure function of the two bandwidths (any consistent
+    unit — only the ratio matters).  Properties, pinned by
+    tests/communicator_tests/test_autotune.py:
+
+    * monotone non-decreasing in ``b_dcn`` (a faster slow fabric earns
+      a larger share) and non-increasing in ``b_ici``;
+    * recovers :data:`DEFAULT_STRIPE_RATIO` (0.25) exactly at the 1:3
+      DCN:ICI seed ratio;
+    * clamped to the OPEN interval (0, 1): a derived plan never
+      collapses the striped exchange to a degenerate single path —
+      hand knobs may pin 0 or 1, the planner never does;
+    * non-finite or non-positive bandwidths raise (an unmeasured hop is
+      the caller's fallback branch, never a silent 0-bandwidth input).
+    """
+    b_ici, b_dcn = float(b_ici), float(b_dcn)
+    if not (np.isfinite(b_ici) and np.isfinite(b_dcn)) \
+            or b_ici <= 0 or b_dcn <= 0:
+        raise ValueError(
+            f"derived_stripe_ratio needs positive finite per-hop "
+            f"bandwidths, got b_ici={b_ici!r} b_dcn={b_dcn!r}; an "
+            f"unmeasured hop falls back to DEFAULT_STRIPE_RATIO "
+            f"explicitly at the call site")
+    ratio = b_dcn / (b_ici + b_dcn)
+    eps = 1e-6
+    return min(1.0 - eps, max(eps, ratio))
+
+
+def derived_bucket_bytes(gbps, lat_us, overhead_frac=0.125,
+                         floor_mb=1.0, cap_mb=32.0):
+    """Bucket bound (BYTES) from a measured hop's (bandwidth, latency):
+    the smallest bucket whose wire time keeps per-collective launch
+    overhead under ``overhead_frac`` of the transfer —
+    ``bytes = bandwidth × latency / overhead_frac`` — clamped to
+    [``floor_mb``, ``cap_mb``] MB and rounded to 2 significant digits
+    so the derived knob is a stable, human-readable census value
+    rather than a noisy float.
+
+    Deterministic pure function; small buckets stay schedulable (the
+    overlap property §7 measures), huge buckets would serialize the
+    exchange behind backward, hence the cap.
+    """
+    gbps, lat_us = float(gbps), float(lat_us)
+    if not (np.isfinite(gbps) and np.isfinite(lat_us)) \
+            or gbps <= 0 or lat_us < 0:
+        raise ValueError(
+            f"derived_bucket_bytes needs a positive finite bandwidth "
+            f"and a non-negative latency, got gbps={gbps!r} "
+            f"lat_us={lat_us!r}")
+    raw = gbps * 1e9 * (lat_us * 1e-6) / float(overhead_frac)
+    mb = min(float(cap_mb), max(float(floor_mb), raw / (1 << 20)))
+    if mb > 0:
+        from math import floor, log10
+        digits = 1 - int(floor(log10(abs(mb))))
+        mb = round(mb, digits)
+    return int(round(min(float(cap_mb), max(float(floor_mb), mb))
+                     * (1 << 20)))
+
+
+def plan_buckets_from_measurement(shapes, dtypes, gbps, lat_us,
+                                  overhead_frac=0.125):
+    """:func:`plan_buckets` with the bound DERIVED from a measured hop
+    (the measurement-driven entry point ``autotune=`` calls) — the
+    partition properties are exactly :func:`plan_buckets`'s."""
+    return plan_buckets(shapes, dtypes,
+                        derived_bucket_bytes(gbps, lat_us,
+                                             overhead_frac=overhead_frac))
+
+
+def stripe_plan_from_measurement(n_elems, b_ici, b_dcn):
+    """:func:`stripe_plan` with the ratio DERIVED from measured per-hop
+    bandwidths (the measurement-driven entry point ``autotune=``
+    calls) — the split properties are exactly :func:`stripe_plan`'s."""
+    return stripe_plan(n_elems, derived_stripe_ratio(b_ici, b_dcn))
 
 
 def pad_to_multiple(flat, multiple):

@@ -124,6 +124,23 @@ class MeshCommunicator(CommunicatorBase):
         self.name = name
         self.hierarchy = None
         self._hier_sizes = None
+        # knob PROVENANCE (ISSUE 19): which exchange knobs the caller
+        # hand-set (explicit argument here; the env-read sites below OR
+        # in their knobs).  The autotune planner only fills knobs left
+        # free — hand knobs always win, and :meth:`retuned` carries
+        # these flags onto clones and elastic rebuilds so a rebuilt
+        # communicator remembers what was a human decision vs a derived
+        # one (the elastic factory passes the OLD comm's knob values as
+        # explicit arguments, which must not launder them into "hand").
+        self._hand_knobs = {
+            "bucket_mb": bucket_mb is not None,
+            "stripe_ratio": stripe_ratio is not None,
+            "grad_dtype": allreduce_grad_dtype is not None,
+        }
+        #: the agreed autotune plan this communicator runs under (None
+        #: = hand-knobbed); attached by :meth:`retuned`
+        self.autotune_plan = None
+        self._autotune_mode = None
         want_hier = (name in ("hierarchical", "two_dimensional")
                      or intra_size is not None or inter_size is not None
                      or isinstance(axis_name, (tuple, list)))
@@ -164,6 +181,7 @@ class MeshCommunicator(CommunicatorBase):
             raw = os.environ.get("CHAINERMN_TPU_STRIPE_RATIO", "").strip()
             if raw:
                 stripe_ratio = float(raw)
+                self._hand_knobs["stripe_ratio"] = True
         if stripe_ratio is not None:
             stripe_ratio = float(stripe_ratio)
             if not 0.0 <= stripe_ratio <= 1.0:
@@ -243,8 +261,10 @@ class MeshCommunicator(CommunicatorBase):
         if bucket_mb is None and batch_collectives == "bucketed":
             import os
             from ._memory_utility import DEFAULT_BUCKET_MB
-            bucket_mb = float(os.environ.get("CHAINERMN_TPU_BUCKET_MB")
-                              or DEFAULT_BUCKET_MB)
+            raw = os.environ.get("CHAINERMN_TPU_BUCKET_MB")
+            if raw:
+                self._hand_knobs["bucket_mb"] = True
+            bucket_mb = float(raw or DEFAULT_BUCKET_MB)
         if bucket_mb is not None:
             bucket_mb = float(bucket_mb)
             if bucket_mb <= 0:
@@ -833,6 +853,86 @@ class MeshCommunicator(CommunicatorBase):
         hierarchical schedule — the degenerate collapse
         ``stripe_plan`` pins."""
         return self.hierarchy is not None and self.stripe_ratio > 0
+
+    # -- self-tuning (ISSUE 19) --------------------------------------------
+    def _clone_kwargs(self):
+        """Constructor kwargs that rebuild THIS communicator (same
+        devices, topology, knobs) — the base of :meth:`retuned`'s
+        knob-override clone.  Subclasses extend (the elastic variant
+        adds members/epoch/channel)."""
+        kwargs = dict(devices=list(self._devices),
+                      axis_name=self.axis_name,
+                      batch_collectives=self.batch_collectives,
+                      bucket_mb=self.bucket_mb,
+                      name=self.name,
+                      error_feedback=self.error_feedback)
+        if self.hierarchy is not None:
+            kwargs["axis_name"] = self.hierarchy
+            kwargs["inter_size"], kwargs["intra_size"] = self._hier_sizes
+            if self.allreduce_grad_dtype is not None \
+                    or self.dcn_grad_dtype is not None:
+                kwargs["allreduce_grad_dtype"] = {
+                    "ici": self.allreduce_grad_dtype,
+                    "dcn": self.dcn_grad_dtype}
+            if self.stripe_ratio > 0:
+                kwargs["stripe_ratio"] = self.stripe_ratio
+        else:
+            kwargs["allreduce_grad_dtype"] = self.allreduce_grad_dtype
+        return kwargs
+
+    def retuned(self, plan):
+        """Apply an agreed autotune plan: a clone with the plan's knobs
+        filled into every knob the caller did NOT hand-set (explicit
+        argument or env var — the provenance ``_hand_knobs`` records at
+        construction); hand knobs always win.  Returns ``self`` with
+        the plan attached when nothing the plan proposes differs from
+        the current knobs — the golden-trajectory contract: a plan that
+        matches the hand knobs changes no compiled program.
+
+        Collective when it rebuilds (communicator construction is a
+        collective point) — safe because the plan itself is agreed
+        (bcast from rank 0), so every rank takes the same branch.
+        """
+        hand = getattr(self, "_hand_knobs", {})
+        kwargs = self._clone_kwargs()
+        changed = False
+        if plan.get("bucket_mb") is not None \
+                and not hand.get("bucket_mb") \
+                and self.batch_collectives == "bucketed":
+            bucket = float(plan["bucket_mb"])
+            if bucket != self.bucket_mb:
+                kwargs["bucket_mb"] = bucket
+                changed = True
+        if plan.get("stripe_ratio") is not None \
+                and not hand.get("stripe_ratio") \
+                and self.hierarchy is not None:
+            ratio = float(plan["stripe_ratio"])
+            if ratio != self.stripe_ratio:
+                kwargs["stripe_ratio"] = ratio
+                changed = True
+        if plan.get("grad_dtype") is not None \
+                and not hand.get("grad_dtype") \
+                and self.hierarchy is not None:
+            from ._memory_utility import resolve_grad_dtype
+            want = {hop: resolve_grad_dtype(dt)
+                    for hop, dt in plan["grad_dtype"].items()}
+            have = {"ici": self.allreduce_grad_dtype,
+                    "dcn": self.dcn_grad_dtype}
+            if want != have:
+                kwargs["allreduce_grad_dtype"] = dict(plan["grad_dtype"])
+                changed = True
+        if not changed:
+            self.autotune_plan = plan
+            return self
+        clone = type(self)(**kwargs)
+        # provenance and plan CARRY FORWARD: the clone's constructor saw
+        # explicit arguments (the applied plan values), which must not
+        # read as hand-set on the next re-tune (elastic resizes re-tune
+        # through the same path)
+        clone._hand_knobs = dict(hand)
+        clone._autotune_mode = self._autotune_mode
+        clone.autotune_plan = plan
+        return clone
 
     # -- quantized wire (ISSUE 8) ------------------------------------------
     @property
@@ -1686,6 +1786,17 @@ class ElasticMeshCommunicator(MeshCommunicator):
 
     def _host_channel(self):
         return self._elastic_channel
+
+    def _clone_kwargs(self):
+        # a retuned elastic clone is the SAME incarnation (same members,
+        # same epoch, same channel template) with different exchange
+        # knobs — the epoch-suffixed axis name already rides in via the
+        # base kwargs, so the re-tuned plan artifact is per-epoch
+        kwargs = super()._clone_kwargs()
+        kwargs["members"] = self.members
+        kwargs["epoch"] = self.epoch
+        kwargs["channel"] = self._elastic_channel
+        return kwargs
 
     # -- topology: slots for collectives, stable ids for identity ----------
     @property

@@ -96,7 +96,8 @@ def _rehome_replicated(tree, communicator):
 
 def create_multi_node_optimizer(actual_optimizer, communicator,
                                 double_buffering=False, zero_fill=True,
-                                zero_sharding=False, exchange=None):
+                                zero_sharding=False, exchange=None,
+                                autotune=None):
     """Wrap an optimizer so updates average gradients over the communicator.
 
     Reference signature and delegation semantics preserved: the returned
@@ -134,7 +135,48 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
     serialized optimizer state is the flat sharded vector, not the
     per-parameter tree.  ``zero_sharding`` already implies the
     reduce-scatter exchange; passing both is a redundancy error.
+
+    ``autotune`` (ISSUE 19, docs/performance.md §12): self-tune the
+    communicator's exchange knobs.  ``True``/``"startup"`` runs the
+    startup micro-bench NOW (unless the communicator already carries an
+    agreed plan) and wraps the retuned communicator; ``"online"`` (or an
+    int N, default 3) re-tunes after the first N updates from the span
+    tracer's payload-tagged ``train/grad_exchange`` spans — online mode
+    needs tracing on (``CHAINERMN_TPU_TRACE=events``); with tracing off
+    it falls back to the startup micro-bench WITH a warning, never a
+    silent no-op.  The re-tune swap rides :meth:`change_communicator`,
+    which also re-tunes automatically on every elastic resize when the
+    outgoing communicator was autotuned.
     """
+    online_after = 0
+    if autotune not in (None, False, True, "startup", "online") \
+            and not (isinstance(autotune, int)
+                     and not isinstance(autotune, bool)
+                     and autotune > 0):
+        raise ValueError(
+            f"autotune must be True/'startup', 'online', or a positive "
+            f"int (online re-tune after N updates); got {autotune!r}")
+    if autotune:
+        from .communicators._autotune import retune_communicator
+        if autotune in (True, "startup"):
+            if getattr(communicator, "autotune_plan", None) is None:
+                communicator = retune_communicator(communicator,
+                                                   mode="startup")
+        else:
+            if observability.enabled():
+                online_after = autotune if isinstance(autotune, int) \
+                    and not isinstance(autotune, bool) else 3
+                communicator._autotune_mode = "online"
+            else:
+                import warnings
+                warnings.warn(
+                    "autotune='online' reads the span tracer's "
+                    "train/grad_exchange spans but tracing is off "
+                    "(CHAINERMN_TPU_TRACE): running the startup "
+                    "micro-bench instead", UserWarning, stacklevel=2)
+                if getattr(communicator, "autotune_plan", None) is None:
+                    communicator = retune_communicator(communicator,
+                                                       mode="startup")
     if exchange is None:
         exchange = "allreduce"
     if exchange not in ("allreduce", "reduce_scatter"):
@@ -197,12 +239,16 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
             raise ValueError(
                 "double buffering requires a fused-bucket communicator "
                 f"(reference: pure_nccl); got {communicator.name!r}")
-        return _DoubleBufferingOptimizer(actual_optimizer, communicator,
-                                         zero_fill, exchange=exchange,
-                                         db_mode=double_buffering)
-    return _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill,
-                               zero_sharding=zero_sharding,
-                               exchange=exchange)
+        opt = _DoubleBufferingOptimizer(actual_optimizer, communicator,
+                                        zero_fill, exchange=exchange,
+                                        db_mode=double_buffering)
+        opt._autotune_online_after = online_after
+        return opt
+    opt = _MultiNodeOptimizer(actual_optimizer, communicator, zero_fill,
+                              zero_sharding=zero_sharding,
+                              exchange=exchange)
+    opt._autotune_online_after = online_after
+    return opt
 
 
 class _MultiNodeOptimizer:
@@ -225,6 +271,11 @@ class _MultiNodeOptimizer:
     #: DCN-path gradients, so the slow path's latency hides entirely
     #: behind compute while the fast path stays exact
     _db_mode = False
+    #: online autotune (ISSUE 19): re-tune from the span tracer's
+    #: payload-tagged exchange spans after this many updates (0 = off;
+    #: armed by ``create_multi_node_optimizer(autotune='online')``)
+    _autotune_online_after = 0
+    _autotune_steps_done = 0
 
     @property
     def _db_dcn(self):
@@ -290,9 +341,30 @@ class _MultiNodeOptimizer:
         is additionally wrapped in ``jax.named_scope`` (see
         ``communicators.mesh_communicator._bucket_scope``) so an XProf
         capture attributes real device time to the SAME names."""
-        plan = self.__dict__.get("_obs_exchange_plan")
+        plan = self._exchange_plan_rows()
         comm = self.communicator
+        exchange = getattr(comm, "exchange", None) or self.exchange
+        counter = observability.registry().counter(
+            "chainermn_tpu_grad_exchange_payload_bytes_total",
+            help="planned per-bucket gradient wire payload (gradient "
+                 "dtype; the census prices the per-hop wire dtypes)")
+        for row in plan:
+            observability.instant(
+                f"train/grad_exchange/bucket{row['bucket']}",
+                tags=dict(row, exchange=str(exchange)))
+            counter.inc(row["payload_bytes"], bucket=str(row["bucket"]),
+                        exchange=str(exchange))
+
+    def _exchange_plan_rows(self):
+        """The cached per-bucket ``{bucket, leaves, elems,
+        payload_bytes}`` rows of the current exchange plan — shared by
+        the telemetry instants, the timed eager span's payload tags
+        (the ISSUE 19 small fix: bandwidth readable off a trace), and
+        nothing else; invalidated wherever ``_obs_exchange_plan``
+        resets (setup, change_communicator)."""
+        plan = self.__dict__.get("_obs_exchange_plan")
         if plan is None:
+            comm = self.communicator
             target = self.actual_optimizer.target
             try:
                 shapes, dtypes = comm.grad_leaf_specs(target)
@@ -307,17 +379,42 @@ class _MultiNodeOptimizer:
                 plan.append({"bucket": i, "leaves": len(idx),
                              "elems": elems, "payload_bytes": nbytes})
             super().__setattr__("_obs_exchange_plan", plan)
-        exchange = getattr(comm, "exchange", None) or self.exchange
-        counter = observability.registry().counter(
-            "chainermn_tpu_grad_exchange_payload_bytes_total",
-            help="planned per-bucket gradient wire payload (gradient "
-                 "dtype; the census prices the per-hop wire dtypes)")
-        for row in plan:
-            observability.instant(
-                f"train/grad_exchange/bucket{row['bucket']}",
-                tags=dict(row, exchange=str(exchange)))
-            counter.inc(row["payload_bytes"], bucket=str(row["bucket"]),
-                        exchange=str(exchange))
+        return plan
+
+    def _maybe_online_retune(self):
+        """Online autotune (ISSUE 19): after the armed number of
+        updates, derive a plan from the tracer's payload-tagged
+        ``train/grad_exchange*`` spans, agree it across ranks, and swap
+        in the retuned communicator through
+        :meth:`change_communicator`.  One-shot — the counter disarms
+        whether or not the plan changed anything.  A plan the sharded
+        striped layout cannot absorb in memory (ratio change without a
+        checkpointer) is WARNED about and skipped, never a crash in the
+        middle of training."""
+        n = self._autotune_online_after
+        if not n:
+            return
+        done = self._autotune_steps_done + 1
+        self._autotune_steps_done = done
+        if done < n:
+            return
+        self._autotune_online_after = 0
+        from .communicators._autotune import (agree_exchange_plan,
+                                              measurements_from_trace)
+        comm = self.communicator
+        measurement = measurements_from_trace(
+            observability.tracer().events())
+        plan = agree_exchange_plan(comm, measurement)
+        new_comm = comm.retuned(plan)
+        if new_comm is comm:
+            return
+        try:
+            self.change_communicator(new_comm)
+        except RuntimeError as e:
+            import warnings
+            warnings.warn(
+                f"online autotune plan {plan.get('fingerprint')} not "
+                f"applied: {e}", RuntimeWarning, stacklevel=2)
 
     # -- reference-style delegation ---------------------------------------
     def __getattr__(self, name):
@@ -376,6 +473,25 @@ class _MultiNodeOptimizer:
         old = self.communicator
         if communicator is old:
             return self
+        if getattr(old, "_autotune_mode", None) \
+                and getattr(communicator, "autotune_plan", None) is None \
+                and getattr(communicator, "axis_name", None) is not None:
+            # the OLD communicator was autotuned and the incoming one
+            # carries no agreed plan (an elastic rebuild): re-tune it —
+            # the plan tracks the world it actually runs on, one fresh
+            # plan artifact per epoch-suffixed mesh (ISSUE 19).  Knob
+            # PROVENANCE carries over from the old communicator first:
+            # the elastic factory passes the old knob VALUES as explicit
+            # constructor arguments, which must not read as hand-set.
+            hand = getattr(old, "_hand_knobs", None)
+            if hand is not None:
+                communicator._hand_knobs = dict(hand)
+            communicator._autotune_mode = old._autotune_mode
+            from .communicators._autotune import retune_communicator
+            # a resize always re-MEASURES (startup micro-bench): the
+            # old trace's spans timed the old world's fabric
+            communicator = retune_communicator(communicator,
+                                               mode="startup")
         actual = self.actual_optimizer
         if self._sharded_update and actual._opt_state is not None:
             leaves = jax.tree.leaves(actual._opt_state)
@@ -454,11 +570,24 @@ class _MultiNodeOptimizer:
         if lossfun is None:
             # eager path: grads already on Parameter.grad (reference flow:
             # backward → allreduce_grad → update) — the one exchange the
-            # host dispatches itself, so its span times the real thing
-            with observability.span("train/grad_exchange"):
+            # host dispatches itself, so its span times the real thing.
+            # The span carries the PLANNED wire payload (ISSUE 19 small
+            # fix): bandwidth = payload_bytes / duration is readable
+            # directly off the trace, which is what the online autotune
+            # mode (and humans in Perfetto) consume
+            tags = None
+            if observability.enabled():
+                rows = self._exchange_plan_rows()
+                if rows:
+                    tags = {"payload_bytes":
+                            sum(r["payload_bytes"] for r in rows),
+                            "buckets": len(rows)}
+            with observability.span("train/grad_exchange", tags=tags):
                 self.communicator.multi_node_mean_grad(
                     actual.target, zero_fill=self.zero_fill)
-            return actual.update()
+            out = actual.update()
+            self._maybe_online_retune()
+            return out
         if self.communicator.axis_name is None:
             # dummy communicator: plain local update
             return actual.update(lossfun, *args, **kwargs)
@@ -544,6 +673,7 @@ class _MultiNodeOptimizer:
         actual._opt_state = new_opt_state
         actual.t += 1
         reporter_module.report(obs)
+        self._maybe_online_retune()
         return loss
 
     # -- ZeRO-1 sharded optimizer state (beyond reference) -----------------

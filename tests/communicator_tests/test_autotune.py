@@ -1,0 +1,437 @@
+"""Self-tuning communicator (ISSUE 19): measure → agree → plan → apply.
+
+The contract these tests pin, layer by layer:
+
+* the PURE derivation pieces (``derived_stripe_ratio`` /
+  ``derived_bucket_bytes`` in ``_memory_utility`` — satellite 1's
+  extraction) obey their documented properties: §10's ``r*`` recovers
+  the committed 0.25 seed at the 1:3 ratio, is monotone in B_dcn, and
+  is clamped to the open interval; the bucket rule amortizes
+  bandwidth×latency with hard [1, 32] MB clamps;
+* ``derive_exchange_plan`` is DETERMINISTIC — byte-identical
+  fingerprints regardless of dict insertion order or a JSON round-trip
+  (the property the cross-rank gate rests on);
+* ``agree_exchange_plan`` over the real (simulated-mesh) comm records
+  the plan artifact, and under injected rank skew the RANK-0 broadcast
+  wins with a warning + divergence counter, never a silent
+  split-brain;
+* ``autotune=`` at the factory applies the agreed plan ONLY to knobs
+  the caller left free (hand knobs always win), and the golden
+  trajectory of an autotuned run is BITWISE equal to the equivalent
+  hand-knobbed run;
+* online mode reads bandwidth off the tracer's payload-tagged
+  ``train/grad_exchange`` spans (the satellite-6 attribute, asserted
+  here on a live eager trace);
+* an elastic ``change_communicator`` re-tunes: one fresh plan artifact
+  per mesh, new fingerprint.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as ct
+from chainermn_tpu import L
+from chainermn_tpu import observability as obs
+from chainermn_tpu.communicators import _autotune
+from chainermn_tpu.communicators._autotune import (agree_exchange_plan,
+                                                   derive_exchange_plan,
+                                                   measurements_from_trace,
+                                                   plan_fingerprint,
+                                                   reduce_measurements,
+                                                   topology_summary)
+from chainermn_tpu.communicators._memory_utility import (
+    DEFAULT_BUCKET_MB, DEFAULT_STRIPE_RATIO, derived_bucket_bytes,
+    derived_stripe_ratio)
+from chainermn_tpu.core.optimizer import MomentumSGD, SGD
+from chainermn_tpu.models import MLP, Classifier
+
+# the fixed reference measurements the derivation tests key off: ICI
+# 3x the DCN bandwidth (the committed 1:3 seed), DCN the slow hop
+FIXED_HIER = {"source": "startup", "probe_mb": 1.0, "iters": 4,
+              "hops": {"ici": {"size": 4, "gbps": 3.0, "lat_us": 50.0},
+                       "dcn": {"size": 2, "gbps": 1.0, "lat_us": 200.0}}}
+FIXED_FLAT = {"source": "startup", "probe_mb": 1.0, "iters": 4,
+              "hops": {"world": {"size": 8, "gbps": 2.0,
+                                 "lat_us": 100.0}}}
+
+
+@pytest.fixture
+def events_mode():
+    prev = obs.set_mode("events")
+    obs.reset_tracer()
+    obs.reset_registry()
+    yield
+    obs.set_mode(prev)
+    obs.reset_tracer()
+    obs.reset_registry()
+
+
+def _fake_measure(monkeypatch, measurement=FIXED_HIER):
+    monkeypatch.setattr(_autotune, "measure_fabric",
+                        lambda comm, **kw: measurement)
+
+
+# -- satellite 1: the extracted pure derivations -----------------------------
+
+def test_derived_stripe_ratio_recovers_committed_seed():
+    """The documented fallback is the 1:3 DCN:ICI point of the SAME
+    formula — r*(3, 1) is exactly the committed 0.25 seed."""
+    assert derived_stripe_ratio(3.0, 1.0) == DEFAULT_STRIPE_RATIO == 0.25
+
+
+def test_derived_stripe_ratio_monotone_in_dcn_bandwidth():
+    prev = 0.0
+    for b_dcn in (0.01, 0.1, 0.5, 1.0, 3.0, 10.0, 1000.0):
+        r = derived_stripe_ratio(3.0, b_dcn)
+        assert r > prev, "a faster DCN must earn a larger DCN share"
+        prev = r
+
+
+def test_derived_stripe_ratio_clamped_to_open_interval():
+    assert 0.0 < derived_stripe_ratio(1e12, 1e-12) < 1.0
+    assert 0.0 < derived_stripe_ratio(1e-12, 1e12) < 1.0
+
+
+@pytest.mark.parametrize("b_ici,b_dcn", [
+    (0.0, 1.0), (1.0, 0.0), (-1.0, 1.0), (1.0, -1.0),
+    (float("inf"), 1.0), (1.0, float("nan"))])
+def test_derived_stripe_ratio_rejects_unmeasurable(b_ici, b_dcn):
+    with pytest.raises(ValueError):
+        derived_stripe_ratio(b_ici, b_dcn)
+
+
+def test_derived_bucket_bytes_rule_and_clamps():
+    # 1 GB/s x 200 us / 0.125 = 1.6e6 B = 1.526 MiB -> 1.5 MiB (2 sig)
+    assert derived_bucket_bytes(1.0, 200.0) == int(1.5 * (1 << 20))
+    # launch latency ~0: floor at 1 MiB (a sub-MB bucket would thrash)
+    assert derived_bucket_bytes(0.001, 1.0) == 1 << 20
+    # fat, laggy fabric: capped at 32 MiB (overlap still needs K>1)
+    assert derived_bucket_bytes(1000.0, 10000.0) == 32 << 20
+    for bad in (0.0, -1.0, float("nan")):
+        with pytest.raises(ValueError):
+            derived_bucket_bytes(bad, 100.0)
+    with pytest.raises(ValueError):
+        derived_bucket_bytes(1.0, -5.0)
+
+
+# -- the pure planner --------------------------------------------------------
+
+def test_derive_plan_from_fixed_measurements():
+    plan = derive_exchange_plan(
+        FIXED_HIER, {"axis": "dcnxici", "kind": "hierarchical",
+                     "size": 8, "exchange": "allreduce",
+                     "inter": 2, "intra": 4})
+    assert plan["bucket_mb"] == 1.5          # slowest hop: dcn
+    assert plan["stripe_ratio"] == 0.25      # r* = 1 / (3 + 1)
+    assert plan["grad_dtype"] == {"ici": None, "dcn": "bfloat16"}
+    assert plan["fingerprint"] == plan_fingerprint(plan)
+    assert any("r* = B_dcn" in n or "finish-together" in n
+               for n in plan["derivation"]["notes"])
+
+
+def test_derive_plan_falls_back_with_notes_when_unmeasurable():
+    """A size-1 (or online, latency-free) hop never silently guesses:
+    the fallback is taken AND named in the derivation notes."""
+    m = {"source": "startup",
+         "hops": {"ici": {"size": 1, "gbps": None, "lat_us": None},
+                  "dcn": {"size": 2, "gbps": 1.0, "lat_us": None}}}
+    plan = derive_exchange_plan(
+        m, {"axis": "dcnxici", "kind": "hierarchical", "size": 2,
+            "exchange": "allreduce", "inter": 2, "intra": 1})
+    assert plan["bucket_mb"] is None         # no latency sample
+    assert plan["stripe_ratio"] == DEFAULT_STRIPE_RATIO
+    notes = " ".join(plan["derivation"]["notes"])
+    assert "falls back" in notes and str(DEFAULT_BUCKET_MB) in notes
+
+
+def test_derive_plan_deterministic_across_key_order_and_roundtrip():
+    topo = {"axis": "dcnxici", "kind": "hierarchical", "size": 8,
+            "exchange": "allreduce", "inter": 2, "intra": 4}
+    a = derive_exchange_plan(FIXED_HIER, topo)
+    shuffled = {"hops": {"dcn": dict(reversed(
+        list(FIXED_HIER["hops"]["dcn"].items()))),
+        "ici": FIXED_HIER["hops"]["ici"]},
+        "iters": 4, "probe_mb": 1.0, "source": "startup"}
+    b = derive_exchange_plan(shuffled, dict(reversed(list(topo.items()))))
+    assert a["fingerprint"] == b["fingerprint"]
+    c = json.loads(json.dumps(a))
+    assert plan_fingerprint(c) == a["fingerprint"]
+
+
+def test_reduce_measurements_median_with_fixed_tiebreak():
+    gathered = []
+    for gbps in (5.0, 1.0, 3.0, 4.0):   # 4 ranks, even count
+        g = {"source": "startup", "probe_mb": 1.0, "iters": 4,
+             "hops": {"world": {"size": 8, "gbps": gbps,
+                                "lat_us": 100.0 * gbps}}}
+        gathered.append(g)
+    agreed = reduce_measurements(gathered)
+    # sorted [1,3,4,5], fixed tie-break element (n-1)//2 -> 3.0
+    assert agreed["hops"]["world"]["gbps"] == 3.0
+    assert agreed["hops"]["world"]["lat_us"] == 300.0
+    assert agreed["ranks"] == 4
+    # order-insensitive: every rank holds the same allgathered list
+    assert reduce_measurements(list(reversed(gathered))) == agreed
+
+
+def test_measurements_from_trace_payload_spans():
+    """Online mode: Σbytes/Σduration per hop tag off payload-tagged
+    B/E pairs; spans without a payload attribute are not samples."""
+    mb = 1 << 20
+    events = [
+        {"name": "train/grad_exchange", "ph": "B", "ts": 0.0,
+         "pid": 0, "tid": 0, "args": {"payload_bytes": 8 * mb,
+                                      "hop": "dcn"}},
+        {"name": "train/grad_exchange", "ph": "E", "ts": 4000.0,
+         "pid": 0, "tid": 0},
+        {"name": "train/grad_exchange", "ph": "B", "ts": 5000.0,
+         "pid": 0, "tid": 0, "args": {"payload_bytes": 8 * mb,
+                                      "hop": "dcn"}},
+        {"name": "train/grad_exchange", "ph": "E", "ts": 7000.0,
+         "pid": 0, "tid": 0},
+        # no payload attribute: timing alone is not a bandwidth sample
+        {"name": "train/grad_exchange", "ph": "B", "ts": 8000.0,
+         "pid": 0, "tid": 0},
+        {"name": "train/grad_exchange", "ph": "E", "ts": 9000.0,
+         "pid": 0, "tid": 0},
+        # unrelated span: ignored
+        {"name": "train/optimizer_update", "ph": "B", "ts": 0.0,
+         "pid": 0, "tid": 0, "args": {"payload_bytes": 1}},
+        {"name": "train/optimizer_update", "ph": "E", "ts": 1.0,
+         "pid": 0, "tid": 0},
+    ]
+    m = measurements_from_trace(events)
+    assert m["source"] == "online"
+    assert set(m["hops"]) == {"dcn"}
+    hop = m["hops"]["dcn"]
+    assert hop["samples"] == 2
+    # 16 MiB over 6 ms
+    np.testing.assert_allclose(hop["gbps"],
+                               16 * mb / 6e-3 / 1e9, rtol=1e-6)
+    assert hop["lat_us"] is None   # a full-exchange span bounds launch
+    #                                overhead only loosely
+
+
+# -- agreement over the real comm --------------------------------------------
+
+def test_agree_over_real_comm_records_artifact(monkeypatch, tmp_path):
+    obs.reset_registry()
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_DIR", str(tmp_path))
+    comm = ct.create_communicator("flat")
+    plan = agree_exchange_plan(comm, FIXED_FLAT)
+    assert plan["fingerprint"] == plan_fingerprint(plan)
+    assert plan["topology"] == topology_summary(comm)
+    path = tmp_path / "autotune_plan_mn_world.json"
+    assert path.exists()
+    assert json.loads(path.read_text())["fingerprint"] \
+        == plan["fingerprint"]
+    g = obs.registry().get("chainermn_tpu_autotune_plan_fingerprint")
+    assert g is not None \
+        and g.value(axis="mn_world") == float(int(plan["fingerprint"][:12],
+                                                  16))
+
+
+def test_rank0_broadcast_wins_under_injected_skew(monkeypatch):
+    """A rank whose local derivation diverges executes rank 0's plan
+    anyway — warned and counted, never a silent split-brain
+    exchange."""
+    obs.reset_registry()
+    comm = ct.create_communicator("flat")
+    tampered = derive_exchange_plan(
+        reduce_measurements([FIXED_FLAT]), topology_summary(comm))
+    tampered["bucket_mb"] = 99.0   # rank 0 "derived" something else
+    tampered["fingerprint"] = plan_fingerprint(tampered)
+    monkeypatch.setattr(comm, "bcast_obj",
+                        lambda obj, root=0: tampered)
+    with pytest.warns(RuntimeWarning, match="diverged"):
+        plan = agree_exchange_plan(comm, FIXED_FLAT)
+    assert plan["fingerprint"] == tampered["fingerprint"]
+    c = obs.registry().get(
+        "chainermn_tpu_autotune_plan_divergence_total")
+    assert c is not None and c.value(axis="mn_world") == 1
+
+
+def test_real_microbench_measures_every_hop():
+    """The startup micro-bench over the real simulated mesh: every
+    hop of size > 1 gets finite bandwidth + latency samples."""
+    comm = ct.create_communicator("hierarchical", inter_size=2)
+    m = _autotune.measure_fabric(comm, probe_mb=0.125, iters=2)
+    assert set(m["hops"]) == {"ici", "dcn"}
+    for hop in m["hops"].values():
+        assert hop["size"] > 1
+        assert hop["gbps"] is not None and hop["gbps"] > 0
+        assert hop["lat_us"] is not None and hop["lat_us"] > 0
+
+
+# -- the factory knob and the golden-trajectory contract ---------------------
+
+def _data(seed=0, n=32, d=8, k=4):
+    rng = np.random.RandomState(seed)
+    return (rng.normal(0, 1, (n, d)).astype(np.float32),
+            rng.randint(0, k, n).astype(np.int32))
+
+
+def _losses(comm, steps=3):
+    model = Classifier(MLP(n_units=16, n_out=4, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+    x, t = _data()
+    return [float(opt.update(model, x, t)) for _ in range(steps)]
+
+
+def test_factory_autotune_fills_only_free_knobs(monkeypatch):
+    _fake_measure(monkeypatch)
+    comm = ct.create_communicator("hierarchical", inter_size=2,
+                                  autotune=True)
+    assert comm.autotune_plan is not None
+    assert comm.stripe_ratio == 0.25          # plan-applied
+    assert comm.dcn_grad_dtype == jnp.bfloat16
+    assert comm.striped
+    # hand knob wins: an explicit ratio is never overwritten, and its
+    # provenance survives onto the retuned clone
+    hand = ct.create_communicator("hierarchical", inter_size=2,
+                                  stripe_ratio=0.6, autotune=True)
+    assert hand.stripe_ratio == 0.6
+    assert hand.autotune_plan is not None     # plan still agreed
+    assert hand._hand_knobs["stripe_ratio"] is True
+    assert hand.dcn_grad_dtype == jnp.bfloat16  # free knob still filled
+
+
+def test_autotune_rejected_on_dummy_and_bad_mode():
+    with pytest.raises(ValueError, match="autotune"):
+        ct.create_communicator("dummy", autotune=True)
+    with pytest.raises(ValueError, match="autotune"):
+        ct.create_communicator("flat", autotune="sometimes")
+
+
+def test_golden_trajectory_autotune_equals_hand_knobs(monkeypatch):
+    """The gate the whole knob-provenance design serves: an autotuned
+    run whose derived plan matches the hand knobs executes the
+    IDENTICAL compiled program — losses bitwise equal, step for
+    step."""
+    _fake_measure(monkeypatch)
+    auto = ct.create_communicator("hierarchical", inter_size=2,
+                                  autotune=True)
+    hand = ct.create_communicator(
+        "hierarchical", inter_size=2, stripe_ratio=0.25,
+        allreduce_grad_dtype={"ici": None, "dcn": "bfloat16"})
+    assert _losses(auto) == _losses(hand)     # bitwise, not allclose
+
+
+def test_optimizer_level_autotune_startup(monkeypatch):
+    """``create_multi_node_optimizer(..., autotune=True)`` re-tunes the
+    communicator before any validation sees it — same plan, same
+    trajectory as the factory-level knob."""
+    _fake_measure(monkeypatch)
+    comm = ct.create_communicator("hierarchical", inter_size=2)
+    model = Classifier(MLP(n_units=16, n_out=4, seed=0))
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm, autotune=True)
+    assert opt.communicator is not comm
+    assert opt.communicator.autotune_plan is not None
+    assert opt.communicator.stripe_ratio == 0.25
+    opt.communicator.bcast_data(model)
+    opt.setup(model)
+    x, t = _data()
+    ref = _losses(ct.create_communicator(
+        "hierarchical", inter_size=2, stripe_ratio=0.25,
+        allreduce_grad_dtype={"ici": None, "dcn": "bfloat16"}))
+    assert [float(opt.update(model, x, t)) for _ in range(3)] == ref
+
+
+# -- online mode + the payload-tagged eager span (satellite 6) ---------------
+
+def _eager_opt(autotune=None):
+    comm = ct.create_communicator("flat")
+    model = L.Linear(4, 2, seed=0)
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm,
+                                         autotune=autotune).setup(model)
+    return opt, model
+
+
+def _set_grads(model):
+    model.W.grad = jnp.ones_like(model.W.array)
+    model.b.grad = jnp.ones_like(model.b.array)
+
+
+def test_eager_span_carries_payload_bytes(events_mode):
+    opt, model = _eager_opt()
+    _set_grads(model)
+    opt.update()
+    spans = [e for e in obs.tracer().events()
+             if e.get("name") == "train/grad_exchange"
+             and e.get("ph") == "B"]
+    assert spans, "eager update must emit the timed exchange span"
+    args = spans[0].get("args") or {}
+    # Linear(4, 2): W 8 + b 2 = 10 f32 elems on the wire
+    assert args.get("payload_bytes") == 40
+    assert args.get("buckets") == 1
+
+
+def test_online_autotune_derives_after_n_steps(events_mode):
+    opt, model = _eager_opt(autotune=2)
+    assert opt._autotune_online_after == 2
+    assert opt.communicator.autotune_plan is None
+    for _ in range(2):
+        _set_grads(model)
+        opt.update()
+    assert opt._autotune_online_after == 0    # one-shot: disarmed
+    plan = opt.communicator.autotune_plan
+    assert plan is not None
+    assert plan["measurements"]["source"] == "online"
+
+
+def test_online_autotune_without_tracing_falls_back_to_startup(
+        monkeypatch):
+    """autotune='online' with tracing off cannot read spans that don't
+    exist: warned, and the startup micro-bench runs instead."""
+    assert obs.mode() == "off"
+    _fake_measure(monkeypatch, FIXED_FLAT)
+    comm = ct.create_communicator("flat")
+    with pytest.warns(UserWarning, match="tracing is off"):
+        opt = ct.create_multi_node_optimizer(SGD(lr=0.1), comm,
+                                             autotune="online")
+    assert opt.communicator.autotune_plan is not None
+    assert opt._autotune_online_after == 0
+
+
+# -- elastic re-tune: one fresh plan per mesh --------------------------------
+
+def test_change_communicator_retunes_fresh_plan_per_mesh(monkeypatch,
+                                                         tmp_path):
+    monkeypatch.setenv("CHAINERMN_TPU_AUTOTUNE_DIR", str(tmp_path))
+    _fake_measure(monkeypatch)
+    comm = ct.create_communicator("hierarchical", inter_size=2,
+                                  autotune=True)
+    model = Classifier(MLP(n_units=16, n_out=4, seed=0))
+    comm.bcast_data(model)
+    opt = ct.create_multi_node_optimizer(
+        MomentumSGD(lr=0.1, momentum=0.9), comm).setup(model)
+    first = comm.autotune_plan
+    # a "resize": a rebuilt 4-device world under a fresh axis, no plan
+    # (the elastic factory passes the old knob VALUES as constructor
+    # args — provenance must carry over, not read as hand-set)
+    slow = {"source": "startup", "probe_mb": 1.0, "iters": 4,
+            "hops": {"ici": {"size": 2, "gbps": 3.0, "lat_us": 50.0},
+                     "dcn": {"size": 2, "gbps": 0.5, "lat_us": 400.0}}}
+    _fake_measure(monkeypatch, slow)
+    small = ct.create_communicator(
+        "hierarchical", devices=jax.devices()[:4], inter_size=2,
+        axis_name=("dcn_ep1", "ici_ep1"), stripe_ratio=comm.stripe_ratio)
+    opt.change_communicator(small)
+    second = opt.communicator.autotune_plan
+    assert second is not None
+    assert second["fingerprint"] != first["fingerprint"]
+    assert opt.communicator.stripe_ratio \
+        == pytest.approx(0.5 / 3.5, abs=1e-6)  # re-derived, not carried
+    # one artifact per mesh axis: the resized world's trail is its own
+    arts = sorted(p.name for p in tmp_path.glob("autotune_plan_*.json"))
+    assert len(arts) == 2, arts

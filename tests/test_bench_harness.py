@@ -1149,6 +1149,25 @@ def test_diurnal_knobs_invalidate_flagship_cache(monkeypatch):
             == bench._DEFAULT_FINGERPRINTS[model]
 
 
+def test_autotune_knob_invalidates_flagship_cache(monkeypatch):
+    """ISSUE 19 satellite: BENCH_AUTOTUNE is a fingerprint knob on BOTH
+    flagship models — an autotuned row executes whatever plan the
+    micro-bench derived, a measurement of that plan, never flagship
+    data; legacy entries backfill the hand-knobbed default
+    (backfill-safe schema bump)."""
+    monkeypatch.setenv("BENCH_AUTOTUNE", "1")
+    assert bench._config_fingerprint("resnet50")["autotune"] is True
+    assert bench._config_fingerprint("transformer")["autotune"] is True
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_AUTOTUNE", raising=False)
+    assert bench._cacheable(TPU_RESULT)
+    for model in ("resnet50", "transformer"):
+        fp = dict(bench._DEFAULT_FINGERPRINTS[model])
+        fp.pop("autotune")
+        assert bench._backfill_fp(model, fp) \
+            == bench._DEFAULT_FINGERPRINTS[model]
+
+
 def test_compile_credit_math(tmp_path):
     """The supervisor's deadline extension: recorded compile seconds,
     plus the in-flight phase's elapsed time, capped at grace, zero for

@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-sp${BENCH_SERVE_PREFIX:-d}-sd${BENCH_SERVE_DISAGG:-d}-stp${BENCH_SERVE_TP:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}-fr${BENCH_SERVE_REPLICAS:-d}-fk${BENCH_FLEET_KILL_AT:-d}-di${BENCH_DIURNAL:-d}-dp${BENCH_DIURNAL_PERIOD:-d}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-sp${BENCH_SERVE_PREFIX:-d}-sd${BENCH_SERVE_DISAGG:-d}-stp${BENCH_SERVE_TP:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}-fr${BENCH_SERVE_REPLICAS:-d}-fk${BENCH_FLEET_KILL_AT:-d}-di${BENCH_DIURNAL:-d}-dp${BENCH_DIURNAL_PERIOD:-d}-at${BENCH_AUTOTUNE:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,10 +78,11 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 32 bench steps recorded, each once, in queue order.  Every
-    # row's fingerprint tail carries the ISSUE 15 fleet knobs (-fr/-fk)
-    # and the ISSUE 16 diurnal knobs (-di/-dp), default 'd'; the fleet
-    # and diurnal A/B rows pin theirs explicitly below
+    # all 33 bench steps recorded, each once, in queue order.  Every
+    # row's fingerprint tail carries the ISSUE 15 fleet knobs (-fr/-fk),
+    # the ISSUE 16 diurnal knobs (-di/-dp) and the ISSUE 19 autotune
+    # knob (-at), default 'd'; the fleet, diurnal and autotune A/B rows
+    # pin theirs explicitly below
     expected = [
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # prewarm
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # flagship
@@ -105,6 +106,11 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical_rs-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         # ISSUE 11: striped multi-path exchange, 2x4 split at r=0.25
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exstriped-bkd-is2-sr0.25-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
+        # ISSUE 19: the autotuned striped leg (checklist item 11) — the
+        # BENCH_AUTOTUNE fingerprint knob pinned explicitly, the stripe
+        # ratio left free for the derived plan (srd)
+        "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exstriped-bkd-is2-srd"
+        "-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd-frd-fkd-did-dpd-at1",
         "transformer-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         "transformer-bs2-d-scand-seq8192-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         "transformer-bs2-d-scand-seq8192-ip0-rpdots-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
@@ -132,25 +138,28 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
         "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mk1",
     ]
-    expected = [e if e.endswith(("-fk40", "-dp30")) else e + "-frd-fkd"
+    expected = [e if e.endswith(("-fk40", "-dp30", "-at1"))
+                else e + "-frd-fkd" for e in expected]
+    expected = [e if e.endswith(("-dp30", "-at1")) else e + "-did-dpd"
                 for e in expected]
-    expected = [e if e.endswith("-dp30") else e + "-did-dpd"
+    expected = [e if e.endswith("-at1") else e + "-atd"
                 for e in expected]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
-    # exposed-comm A/B (ISSUE 5 + 6 + 10 + 11): four gloo exchange
-    # curves, the striped split-ratio sweep (its last CLI arg is the
-    # ratio — one invocation per sweep point), and the elastic
-    # preempt-and-rejoin A/B (its last CLI arg is the preempted rank —
-    # the BENCH_PREEMPT_RANK-class knob pinned above), folded in their
-    # own section after the main fold
+    # exposed-comm A/B (ISSUE 5 + 6 + 10 + 11 + 19): four gloo exchange
+    # curves, the ONE self-gating autotune invocation that replaced the
+    # three-point striped ratio sweep (its last CLI arg is the
+    # --autotune flag itself), and the elastic preempt-and-rejoin A/B
+    # (its last CLI arg is the preempted rank — the
+    # BENCH_PREEMPT_RANK-class knob pinned above), folded in their own
+    # section after the main fold
     # (ISSUE 15 adds the fleet kill-under-load curve — its last CLI arg
     # is the kill decode step; ISSUE 16 adds the capacity-transfer A/B —
     # its last CLI arg is the --capacity flag itself)
     assert [ln for ln in notes_text.splitlines() if '"gloo"' in ln] == [
         '{"gloo": "flat"}', '{"gloo": "bucketed"}',
         '{"gloo": "reduce_scatter"}', '{"gloo": "hierarchical"}',
-        '{"gloo": "0.25"}', '{"gloo": "0.5"}', '{"gloo": "0.75"}',
+        '{"gloo": "--autotune"}',
         '{"gloo": "1"}', '{"gloo": "2"}', '{"gloo": "--capacity"}']
     assert notes_text.index("On-chip results") \
         < notes_text.index("Exposed-comm A/B rows")
@@ -193,9 +202,9 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the thirty-two bench rows
-    must already be folded, and NO empty 'Flash-vs-XLA' section may be
-    appended."""
+    the queue must still complete (|| true), the thirty-three bench
+    rows must already be folded, and NO empty 'Flash-vs-XLA' section
+    may be appended."""
     shim = tmp_path / "bin"
     shim.mkdir()
     py = shim / "python"
@@ -217,5 +226,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 32
+                if '"final"' in ln]) == 33
     assert "Flash-vs-XLA" not in notes_text

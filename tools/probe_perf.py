@@ -48,6 +48,8 @@ _SCAN_PROBES = {"all", "matmul", "conv", "resnet"}
 
 HBM_BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "hbm_budgets.json")
+AUTOTUNE_PLAN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "autotune_plan.json")
 
 
 def sync(x):
@@ -732,6 +734,87 @@ def probe_comm():
                                   bucket_mb=bucket_mb)), flush=True)
 
 
+def probe_autotune():
+    """PROBE=autotune: the committed self-tuning plan artifact
+    (tools/autotune_plan.json, gated tier-1 by
+    tests/test_autotune_plan.py) joined with a LIVE startup micro-bench
+    + derivation on the simulated 8-device mesh (ISSUE 19).  Emits:
+
+    * one ``autotune_fabric`` row per measured hop (bandwidth, latency,
+      probe size) — cpu-sim numbers, labeled as mechanics-only: they
+      are NEVER stamped into the artifact (that is the recovery queue's
+      FIRST-CHIP-CONTACT item 11, on the real fabric);
+    * the derived plan (fingerprint, bucket_mb, stripe_ratio,
+      grad_dtype, derivation notes) with the artifact join: does the
+      committed derivation record still track the planner's constants,
+      and — once status is ``measured`` — the committed fingerprint;
+    * one ``autotune_knob`` row per knob after :meth:`retuned` applies
+      the plan to a free-knobbed hierarchical communicator — plan
+      value, hand-set flag, applied value — the provenance table
+      docs/performance.md §12 describes.
+
+    Chip-free: the micro-bench runs on the simulated mesh."""
+    # pin the 8-device simulated mesh BEFORE the backend initializes
+    # (same pin as probe_comm — a 1-device mesh has no DCN hop to probe)
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", ""))
+    import chainermn_tpu as ct
+    from chainermn_tpu.communicators import _autotune
+    if jax.device_count() < 8:
+        raise SystemExit(
+            "probe_autotune: the jax backend initialized before the "
+            "8-device pin took effect (device_count="
+            f"{jax.device_count()}); run via `make probe-autotune` or "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    with open(AUTOTUNE_PLAN_PATH) as f:
+        art = json.load(f)
+    comm = ct.create_communicator("hierarchical", inter_size=2)
+    probe_mb = float(os.environ.get("PROBE_MB", "1.0"))
+    m = _autotune.measure_fabric(
+        comm, probe_mb=probe_mb,
+        iters=int(os.environ.get("PROBE_ITERS", "4")))
+    for hop, h in sorted(m["hops"].items()):
+        print(json.dumps({
+            "probe": "autotune_fabric", "hop": hop, **h,
+            "probe_mb": probe_mb,
+            "note": "cpu-sim fabric: mechanics only, never stamped "
+                    "into tools/autotune_plan.json"}), flush=True)
+    plan = _autotune.agree_exchange_plan(comm, m)
+    row = {"probe": "autotune", "fingerprint": plan["fingerprint"],
+           "bucket_mb": plan["bucket_mb"],
+           "stripe_ratio": plan["stripe_ratio"],
+           "grad_dtype": plan["grad_dtype"],
+           "notes": plan["derivation"]["notes"],
+           "artifact_status": art["status"],
+           "derivation_tracks_planner":
+               art["plan_version"] == _autotune.PLAN_VERSION
+               and art["derivation"]["overhead_frac"]
+               == _autotune.OVERHEAD_FRAC
+               and art["derivation"]["formula"]
+               == plan["derivation"]["formula"]
+               and art["derivation"]["bucket_rule"]
+               == plan["derivation"]["bucket_rule"]}
+    if art["status"] == "measured" and art.get("plan"):
+        row["committed_fingerprint"] = art["plan"]["fingerprint"]
+        row["committed_delta_vs_hand"] = art["steps_per_sec_delta_vs_hand"]
+    print(json.dumps(row), flush=True)
+    tuned = comm.retuned(plan)
+    for knob, plan_val, applied in (
+            ("bucket_mb", plan["bucket_mb"], tuned.bucket_mb),
+            ("stripe_ratio", plan["stripe_ratio"], tuned.stripe_ratio),
+            ("grad_dtype", plan["grad_dtype"],
+             {"ici": str(jnp.dtype(tuned.allreduce_grad_dtype))
+              if tuned.allreduce_grad_dtype is not None else None,
+              "dcn": str(jnp.dtype(tuned.dcn_grad_dtype))
+              if tuned.dcn_grad_dtype is not None else None})):
+        print(json.dumps({
+            "probe": "autotune_knob", "knob": knob,
+            "plan_value": plan_val,
+            "hand_set": bool(tuned._hand_knobs.get(knob)),
+            "applied_value": applied}), flush=True)
+
+
 def probe_serving():
     """PROBE=serving: the committed serving budgets
     (tools/serving_budgets.json, gated tier-1 by
@@ -1054,6 +1137,8 @@ if __name__ == "__main__":
         probe_flash()
     if which == "comm":
         probe_comm()
+    if which == "autotune":
+        probe_autotune()
     if which == "serving":
         probe_serving()
     if which == "obs":

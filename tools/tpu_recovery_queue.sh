@@ -27,12 +27,13 @@
 #     `targets` (status -> measured) to arm tests/test_serving_budget.py's
 #     numeric half.
 #
-#  5. striped split-ratio sweep (ISSUE 11): the three gloo
-#     --gloo-exchange striped --stripe-ratio {0.25,0.5,0.75} curves below;
-#     commit the winning ratio as DEFAULT_STRIPE_RATIO
-#     (communicators/_memory_utility.py) + regenerate comm_budgets
-#     (tools/comm_census.py --write-budgets) so the per-path structure
-#     gates track the committed split.
+#  5. striped split-ratio sweep (ISSUE 11, promoted by ISSUE 19): the
+#     single gloo `bench_scaling --autotune` invocation below runs the
+#     {0.25,0.5,0.75} sweep AND self-gates it (derived ratio must land
+#     in the measured optimum band); commit the winning ratio as
+#     DEFAULT_STRIPE_RATIO (communicators/_memory_utility.py) +
+#     regenerate comm_budgets (tools/comm_census.py --write-budgets) so
+#     the per-path structure gates track the committed split.
 #
 #  6. MoE dispatch A/B (ISSUE 12): the three BENCH_MODEL=moe rows below
 #     (flat single-axis dispatch vs two-stage ici×dcn vs two-stage with
@@ -86,6 +87,19 @@
 #     the summary `p99_ms_saved_vs_training_priority` in BENCH_NOTES.
 #     Diurnal rows are fingerprint- AND payload-fenced (any non-zero
 #     conversions/role_transfers) out of the flagship cache.
+#
+# 11. autotune plan vs hand knobs A/B (ISSUE 19): the BENCH_AUTOTUNE=1
+#     resnet row below (communicator built with autotune=True: the
+#     startup micro-bench measures the REAL ICI/DCN hops and the agreed
+#     plan fills bucket_mb/stripe_ratio/grad_dtype) vs the hand-knobbed
+#     hierarchical 2x4 row.  STAMP tools/autotune_plan.json from the
+#     run's recorded plan artifact (CHAINERMN_TPU_AUTOTUNE_DIR below):
+#     plan + measurements (the first real B_ici/B_dcn/latency numbers)
+#     + steps_per_sec_delta_vs_hand, status -> measured — that arms
+#     tests/test_autotune_plan.py's numeric half (the committed plan
+#     must re-derive bit-identically from the stamped measurements).
+#     Autotune rows are fingerprint-excluded from the flagship cache
+#     like every exchange knob.
 #
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
@@ -214,6 +228,18 @@ run_one "resnet bs64 hierarchical_rs 2x4 int8 DCN (wire-dtype A/B)" \
 # fingerprint-excluded from the flagship cache like every exchange knob.
 run_one "resnet bs64 striped exchange 2x4 r=0.25 (multi-path A/B)" \
   BENCH_EXCHANGE=striped BENCH_INTER_SIZE=2 BENCH_STRIPE_RATIO=0.25 \
+  BENCH_DEADLINE_S=600 BENCH_TRIALS=3
+# ISSUE 19 (checklist item 11): the self-tuning A/B — the communicator
+# measures the REAL ICI/DCN hops at startup and executes the agreed
+# plan (bucket_mb/stripe_ratio/grad_dtype all left free).  Delta vs the
+# hand-knobbed hierarchical 2x4 row above = what the measured plan buys
+# (or costs) against the operator's guesses; the recorded plan artifact
+# ($REPO/tools/autotune_plans/) carries the first real B_ici/B_dcn/
+# latency numbers, which STAMP tools/autotune_plan.json (status ->
+# measured) and arm tests/test_autotune_plan.py's numeric half.
+run_one "resnet bs64 autotuned striped 2x4 (A/B: measured plan vs hand)" \
+  BENCH_AUTOTUNE=1 BENCH_EXCHANGE=striped BENCH_INTER_SIZE=2 \
+  CHAINERMN_TPU_AUTOTUNE_DIR=$REPO/tools/autotune_plans \
   BENCH_DEADLINE_S=600 BENCH_TRIALS=3
 run_one "transformer bs8 seq1024" \
   BENCH_MODEL=transformer BENCH_DEADLINE_S=900 BENCH_TRIALS=3
@@ -348,23 +374,18 @@ stepf=$STEPDIR/step_commab.log
   # cost across a genuine slow hop
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
     --gloo-exchange hierarchical
-  # ISSUE 11: the >=2-host STRIPED ratio sweep — the committed
-  # per-topology split ratio (DEFAULT_STRIPE_RATIO=0.25 is the
-  # pre-measurement seed) is decided by THIS measurement: the ratio
-  # whose curve wins is what a pod should commit, the way bucket_mb's
-  # winner came from the bucket sweep.  At one device per process the
-  # whole payload crosses the process boundary either way, so the gloo
-  # stand-in A/Bs the collective SHAPES (bulk rs+ag vs chunk
-  # allreduce); rerun on a pod with real ici>1 for the bandwidth split.
-  CHAINERMN_TPU_STRIPE_RATIO=0.25 \
+  # ISSUE 11, promoted by ISSUE 19: the >=2-host striped ratio sweep is
+  # now ONE self-gating invocation — leg 1 builds its communicator with
+  # autotune=True (startup micro-bench over the real gloo fabric,
+  # agreed plan applied), leg 2 hand-pins the derived knobs (gates
+  # BITWISE golden-trajectory equality), then the {0.25,0.5,0.75} sweep
+  # runs and the derived ratio must land inside the measured optimum
+  # band.  At one device per process the whole payload crosses the
+  # process boundary either way, so the gloo stand-in A/Bs the
+  # collective SHAPES (bulk rs+ag vs chunk allreduce); rerun on a pod
+  # with real ici>1 for the bandwidth split.
   python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
-    --gloo-exchange striped --stripe-ratio 0.25
-  CHAINERMN_TPU_STRIPE_RATIO=0.5 \
-  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
-    --gloo-exchange striped --stripe-ratio 0.5
-  CHAINERMN_TPU_STRIPE_RATIO=0.75 \
-  python bench_scaling.py --gloo-procs 1,2 --per-chip-bs 64 --steps 100 \
-    --gloo-exchange striped --stripe-ratio 0.75
+    --autotune
   # ISSUE 10: the >=2-host ELASTIC A/B — rank 1 hard-preempted a third
   # of the way in, survivors shrink and keep training, the rank
   # re-joins and the world grows back; the summary line (wall delta vs
