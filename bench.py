@@ -77,6 +77,14 @@ a variant that is excluded from the last-good cache):
                 cache — the A/B off leg), BENCH_SERVE_DISAGG (0|1:
                 disaggregated prefill/decode slices),
                 BENCH_SERVE_TP (1: tensor-parallel decode ways),
+                BENCH_SERVE_SPEC_K (0: speculative decoding — K n-gram
+                proposals verified per dispatch, bit-identical tokens;
+                rows grow spec_steps/accepted_tokens_per_dispatch/
+                spec_acceptance_rate/draft_overhead),
+                BENCH_SERVE_CHUNK (0: chunked prefill — C-token chunks
+                AND a mixed short/long load, every fourth prompt up to
+                4x BENCH_SERVE_PROMPT; rows grow chunked_admissions/
+                chunk_prefills),
                 BENCH_SERVE_REPLICAS (1: >1 serves through a
                 ReplicaFleet behind the router — rows grow replicas/
                 reroutes/weight_sync_s), BENCH_FLEET_KILL_AT (-1:
@@ -406,7 +414,8 @@ _DEFAULT_FINGERPRINTS = {
                  "preempt_rank": -1, "trace": "off",
                  "serve_replicas": 1, "fleet_kill_at": -1,
                  "diurnal": False, "diurnal_period": 0.0,
-                 "autotune": False},
+                 "autotune": False,
+                 "serve_spec_k": 0, "serve_chunk": 0},
     "transformer": {"model": "transformer", "bs": DEFAULT_TF_BS,
                     "seq_len": DEFAULT_SEQ, "d_model": DEFAULT_TF_D_MODEL,
                     "n_layers": DEFAULT_TF_LAYERS,
@@ -420,7 +429,8 @@ _DEFAULT_FINGERPRINTS = {
                     "preempt_rank": -1, "trace": "off",
                     "serve_replicas": 1, "fleet_kill_at": -1,
                     "diurnal": False, "diurnal_period": 0.0,
-                    "autotune": False},
+                    "autotune": False,
+                    "serve_spec_k": 0, "serve_chunk": 0},
 }
 
 def _env_float(name, default):
@@ -522,6 +532,12 @@ def _config_fingerprint(model=None):
             # executes whatever plan the micro-bench derived — a
             # measurement of that plan, never flagship data
             "autotune": os.environ.get("BENCH_AUTOTUNE", "0") == "1",
+            # the round-20 serving A/Bs (ISSUE 20): speculative decode
+            # (BENCH_SERVE_SPEC_K) and chunked prefill
+            # (BENCH_SERVE_CHUNK) reshape the dispatch schedule — A/B
+            # measurements, never flagship data
+            "serve_spec_k": _env_int("BENCH_SERVE_SPEC_K", 0),
+            "serve_chunk": _env_int("BENCH_SERVE_CHUNK", 0),
         }
     return {
         "model": "resnet50",
@@ -548,6 +564,8 @@ def _config_fingerprint(model=None):
         "diurnal": os.environ.get("BENCH_DIURNAL", "0") == "1",
         "diurnal_period": _env_float("BENCH_DIURNAL_PERIOD", 0),
         "autotune": os.environ.get("BENCH_AUTOTUNE", "0") == "1",
+        "serve_spec_k": _env_int("BENCH_SERVE_SPEC_K", 0),
+        "serve_chunk": _env_int("BENCH_SERVE_CHUNK", 0),
     }
 
 
@@ -1790,6 +1808,17 @@ def _run_bench_serving():
     prefix_len = _env_int("BENCH_SERVE_PREFIX", 16)
     disagg = os.environ.get("BENCH_SERVE_DISAGG", "0") == "1"
     tp = _env_int("BENCH_SERVE_TP", 1)
+    # round-20 knobs (ISSUE 20): BENCH_SERVE_SPEC_K=K turns on
+    # speculative decoding (n-gram self-draft, K proposals verified in
+    # one dispatch — bit-identical tokens, fewer dispatches);
+    # BENCH_SERVE_CHUNK=C turns on chunked prefill AND switches the
+    # load to mixed short/long — every fourth request carries a LONG
+    # prompt (up to 4x BENCH_SERVE_PROMPT) that admits in C-token
+    # chunks between decode steps, which is exactly the head-of-line
+    # blocking the p99 column measures
+    spec_k = max(0, _env_int("BENCH_SERVE_SPEC_K", 0))
+    chunk_env = max(0, _env_int("BENCH_SERVE_CHUNK", 0))
+    long_factor = 4 if chunk_env else 1
     # round-16 fleet knobs (ISSUE 15): BENCH_SERVE_REPLICAS > 1 serves
     # through a ReplicaFleet behind the router; BENCH_FLEET_KILL_AT=K
     # preempts the highest replica at decode step K (its in-flight
@@ -1828,11 +1857,25 @@ def _run_bench_serving():
         n_vocab = min(n_vocab, 512)
         n_heads = max(1, d_model // 32)
         num_pages = min(num_pages, 64)
+        # keep the chunk threshold below the clamped long prompts so
+        # the smoke actually exercises chunked admission
+        if chunk_env:
+            chunk_env = min(chunk_env, 16)
+    if cpu_smoke:
+        long_factor = min(long_factor, 2)
     # the shared prefix must leave room for a per-request tail
     prefix_len = max(0, min(prefix_len, prompt_max - 8))
+    long_max = prompt_max * long_factor
     max_context = 1
-    while max_context < prompt_max + max_new:
+    while max_context < long_max + max_new:
         max_context *= 2
+    # chunk size: page-multiple (the engine's admission contract),
+    # bounded by the context
+    chunk_tokens = None
+    if chunk_env:
+        chunk_tokens = min(max(page_size,
+                               (chunk_env // page_size) * page_size),
+                           max_context)
 
     model = TransformerLM(n_vocab=n_vocab, d_model=d_model,
                           n_heads=n_heads, n_layers=n_layers,
@@ -1845,7 +1888,8 @@ def _run_bench_serving():
                              max_context=max_context,
                              max_queue=n_requests + max_batch,
                              prefix_cache=prefix_len > 0, disagg=disagg,
-                             tp=tp)
+                             tp=tp, spec_k=spec_k,
+                             chunk_tokens=chunk_tokens)
 
     broker = None
     if replicas > 1 or diurnal:
@@ -1907,10 +1951,13 @@ def _run_bench_serving():
                               2.0 * np.pi * t / diurnal_period)))
             t += rng.exponential(1.0 / lam)
             ten = rng.randint(tenants)
+            hi = prompt_max - prefix_len + 1
+            if chunk_tokens is not None and len(reqs) % 4 == 3:
+                # the mixed-load long leg: a prompt past the chunk
+                # threshold, admitted in chunks between decode steps
+                hi = long_max - prefix_len + 1
             tail = rng.randint(
-                0, n_vocab,
-                rng.randint(4, prompt_max - prefix_len + 1)) \
-                .astype(np.int32)
+                0, n_vocab, rng.randint(4, hi)).astype(np.int32)
             reqs.append(Request(
                 np.concatenate([sys_prompts[ten], tail]),
                 max_new_tokens=max_new,
@@ -1930,6 +1977,7 @@ def _run_bench_serving():
     _COMPILE_CREDIT[0] += compile_s
     _stamp_compile("done", _COMPILE_CREDIT[0])
     traces_before = sum(e.prefill_traces + e.decode_traces
+                        + e.spec_traces + e.chunk_traces
                         for e in engines)
 
     # -- measured open-loop window
@@ -1939,7 +1987,8 @@ def _run_bench_serving():
     joined = False
     base = time.monotonic()
     while (fleet.pending() if fleet is not None
-           else engine.running or engine.scheduler.pending()):
+           else engine.running or engine.prefilling
+           or engine.scheduler.pending()):
         if _remaining() < 20:
             break  # cooperative: report the partial window honestly
         st = target.step(now=time.monotonic() - base)
@@ -2044,12 +2093,33 @@ def _run_bench_serving():
         "transferred_page_bytes": int(sum(e.transferred_page_bytes
                                           for e in all_engines)),
         "tp": engines[0].tp,
+        # round-20 surface (ISSUE 20): the speculative economics — the
+        # dispatch-count reduction IS accepted_tokens_per_dispatch; a
+        # draft model's extra dispatches show up as draft_overhead —
+        # and the chunked-prefill admission counters (present on EVERY
+        # serving row; zeros when the knobs are off)
+        "spec_k": spec_k,
+        "chunk_tokens": chunk_tokens or 0,
+        "spec_steps": sum(e.spec_steps for e in all_engines),
+        "accepted_tokens_per_dispatch": round(
+            sum(e.spec_emitted for e in all_engines)
+            / max(1, sum(e.spec_lane_steps for e in all_engines)), 3),
+        "spec_acceptance_rate": round(
+            sum(e.spec_accepted for e in all_engines)
+            / max(1, sum(e.spec_proposed for e in all_engines)), 3),
+        "draft_overhead": round(
+            sum(e.draft_dispatches for e in all_engines)
+            / max(1, sum(e.spec_steps for e in all_engines)), 3),
+        "chunked_admissions": sum(e.chunked_admissions
+                                  for e in all_engines),
+        "chunk_prefills": sum(e.chunk_prefills for e in all_engines),
         "compile_s": round(compile_s, 1),
         # the never-retrace contract, measured: bucket programs compiled
         # in warmup, zero traces during the window — counted over the
         # INITIAL replicas (a mid-window joiner compiles cold by
         # design; that cost is the join's, not the window's)
         "window_retraces": (sum(e.prefill_traces + e.decode_traces
+                                + e.spec_traces + e.chunk_traces
                                 for e in engines) - traces_before),
         # round-16 fleet surface (ISSUE 15): present on EVERY serving
         # row (single-engine rows backfill the fleet-less defaults, so

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["paged_decode_attention", "paged_prefill_attention",
-           "paged_attn_mode", "head_sharding"]
+           "paged_verify_attention", "paged_attn_mode", "head_sharding"]
 
 
 def paged_attn_mode(mode=None):
@@ -144,6 +144,55 @@ def paged_prefill_attention(q, k_pool, v_pool, block_table_row, start,
     out = jnp.einsum("htk,khd->thd", p.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_table, start,
+                           scale=None, tp_mesh=None, tp_axis="tp"):
+    """Multi-query verify attention for SPECULATIVE decoding (round 20).
+
+    ``q``: ``[B, K1, H, D]`` — ``K1 = K + 1`` query tokens per sequence
+    (the pending token plus K draft tokens), query ``j`` of lane ``b``
+    sitting at absolute position ``start[b] + j``.  The speculated
+    K/V must already be WRITTEN into the pools (``write_span_kv`` runs
+    first), so ONE gather per pool through ``block_table`` (``[B, N]``)
+    covers the whole context, and the per-query causal mask ``kpos <=
+    start + j`` makes query ``j`` score exactly the trajectory prefix
+    it would have seen in a vanilla decode step — which is what makes
+    greedy accept/reject bit-identical to one-token-at-a-time decode.
+    ``start[b] < 0`` marks an idle lane (all queries masked, output
+    zeros).  Scores are ``[B, H, K1, N·S]`` — K1 stays a small
+    constant, never the context length, so no ``[T, T]`` score matrix
+    ever forms (the committed ``spec_verify`` census config pins this
+    and the one-gather-per-pool fact).  Returns ``[B, K1, H, D]`` in
+    ``q.dtype``.
+
+    This is the whole speculative bargain in one shape: the dense-side
+    cost of scoring K extra tokens rides the SAME cache-byte reads the
+    single-query step already pays, so accepted tokens are (HBM-wise)
+    free — dispatch count per emitted token drops by ``1/(1 +
+    accepted)``.
+    """
+    B, K1, H, D = q.shape
+    S = k_pool.shape[1]
+    N = block_table.shape[1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    q = _constrain_heads(q, 2, tp_mesh, tp_axis)
+    k = _constrain_heads(k_pool[block_table], 3, tp_mesh, tp_axis)
+    v = _constrain_heads(v_pool[block_table], 3, tp_mesh, tp_axis)
+    k = k.reshape(B, N * S, H, D)
+    v = v.reshape(B, N * S, H, D)
+    s = jnp.einsum("bjhd,bkhd->bhjk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    kpos = lax.broadcasted_iota(jnp.int32, (1, 1, 1, N * S), 3)
+    st = start[:, None, None, None]
+    qpos = st + lax.broadcasted_iota(jnp.int32, (1, 1, K1, 1), 2)
+    # idle lanes (start < 0) mask EVERY query — start + j crosses zero
+    # for j >= |start|, so causality alone would leak
+    p, l = _masked_softmax_stats(s, (kpos <= qpos) & (st >= 0))
+    p = p / jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bhjk,bkhd->bjhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return _constrain_heads(out.astype(q.dtype), 2, tp_mesh, tp_axis)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_table, ctx_len,

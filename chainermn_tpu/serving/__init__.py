@@ -21,8 +21,13 @@ tensor-parallel paged decode.  Pieces, each its own module:
   ``EvictionStalledError`` livelock guard), typed backpressure;
 * :mod:`.engine` — the prefill/decode split wired together as bucketed
   jit programs over the shared pools, with the prefix cache, the
-  disaggregated slices (``CHAINERMN_TPU_SERVE_DISAGG``), and the ``tp``
-  mesh axis;
+  disaggregated slices (``CHAINERMN_TPU_SERVE_DISAGG``), the ``tp``
+  mesh axis, and — round 20 (ISSUE 20) — speculative decoding
+  (``spec_k``: n-gram or draft-model proposals verified K+1 positions
+  per dispatch, bit-identical to vanilla greedy;
+  ``CHAINERMN_TPU_SERVE_SPEC=off`` hatch) plus chunked prefill
+  (``chunk_tokens``: long prompts stream in page-multiple chunks
+  between decode steps instead of head-of-line-blocking them);
 * :mod:`.fleet` / :mod:`.router` — round 16 (ISSUE 15): the elastic
   serving fleet — decode replicas in a ``role="fleet"`` membership
   group behind a per-tenant fair router, preempted replicas' in-flight
@@ -39,14 +44,16 @@ a seeded chat-shaped open-loop load); structure committed in
 Design notes: ``docs/serving.md``.
 """
 
-from .engine import (ServingEngine, decode_program, prefill_program,
-                     prefix_prefill_program, serve_disagg_mode)
+from .engine import (ServingEngine, decode_program, ngram_propose,
+                     prefill_program, prefix_prefill_program,
+                     serve_disagg_mode, serve_spec_k, spec_verify_program)
 from .errors import (EvictionStalledError, PagePoolExhaustedError,
                      QueueSaturatedError, ServingError)
 from .fleet import (FleetWorker, LocalReplica, QueueDepthScalePolicy,
                     RemoteReplica, ReplicaFleet, fleet_mode)
 from .kv_cache import (PagedKVCache, copy_page, insert_pages,
-                       write_prompt_kv, write_prompt_kv_at, write_token_kv)
+                       write_prompt_kv, write_prompt_kv_at, write_span_kv,
+                       write_token_kv)
 from .page_allocator import BlockAllocator
 from .router import FleetRouter, NoLiveReplicaError
 from .scheduler import Request, RequestScheduler
@@ -54,6 +61,9 @@ from .scheduler import Request, RequestScheduler
 __all__ = [
     "ServingEngine", "prefill_program", "prefix_prefill_program",
     "decode_program", "serve_disagg_mode",
+    # round 20 (ISSUE 20): speculative decoding + chunked prefill
+    "spec_verify_program", "ngram_propose", "serve_spec_k",
+    "write_span_kv",
     "PagedKVCache", "write_prompt_kv", "write_prompt_kv_at",
     "write_token_kv", "copy_page", "insert_pages",
     "BlockAllocator", "Request", "RequestScheduler",

@@ -47,6 +47,40 @@ Round 14 (ISSUE 13) adds the production scale-out legs:
   (``ops.paged_attention.head_sharding`` pins the gathers).  Logits
   match the single-chip decode at fp32 tolerance (parity-gated).
 
+Round 20 (ISSUE 20) adds the raw per-chip speed legs:
+
+* **speculative decoding** (``spec_k=K``): a draft — the built-in
+  n-gram self-draft by default, or a small ``draft_model=`` — proposes
+  K tokens per sequence per step, and the target scores all ``K + 1``
+  positions in ONE dispatch through :func:`spec_verify_program`
+  (multi-query paged attention over the same block tables).  Greedy
+  accept/reject truncates at the first mismatch, so the output is
+  BIT-IDENTICAL to vanilla greedy decode — the draft only ever buys
+  speed, never changes a token.  Rollback of rejected speculative KV
+  is a position-counter rewind: the writes were ``mode="drop"``-fenced
+  scatters into pages the sequence already owns, stale slots are
+  masked by ``ctx_len``/causality, and the next step overwrites them.
+  Draft KV pages live in the same refcounted ``BlockAllocator`` pool
+  (the draft pool is indexed by the SAME block tables).
+  ``CHAINERMN_TPU_SERVE_SPEC=off`` is the escape hatch.
+* **chunked prefill** (``chunk_tokens=C``): prompts whose unmatched
+  remainder exceeds ``C`` admit in page-multiple chunks of ``C``
+  tokens, interleaved with decode steps under a per-step token budget
+  (``chunk_budget``, default one chunk per step) — a 16k prompt no
+  longer occupies whole engine steps while short chat requests queue
+  behind it.  Chunks reuse :func:`prefix_prefill_program`'s offset
+  writer (``start`` = the chunk cursor; chunk 0 degenerates to
+  ``start=0``), prefill buckets top out at ``C`` (prompts above the
+  largest bucket now route to chunking instead of the ``_bucket``
+  ValueError), and mid-chunk requests are evictable: pages freed,
+  chunk cursor reset by the scheduler's requeue (recompute from chunk
+  0 on re-admit — the eviction idiom, applied before any token
+  exists).  On the disagg split, prefix-miss chunks run on the
+  PREFILL slice against the scratch pool (at most one mid-chunk miss
+  in flight — single scratch) and the finished pages ship once, after
+  the last chunk; prefix-hit chunks run against the decode pool like
+  suffix prefills always have.
+
 Host work per step is scheduling metadata only (block tables, positions,
 sampled tokens — a few int32s per sequence); KV bytes never leave the
 device, and on real accelerators the pools are DONATED through both
@@ -79,15 +113,18 @@ from ..nn import functions as F
 from ..ops import attention as flash_attention_op
 from ..ops.paged_attention import (head_sharding, paged_attn_mode,
                                    paged_decode_attention,
-                                   paged_prefill_attention)
+                                   paged_prefill_attention,
+                                   paged_verify_attention)
 from .errors import PagePoolExhaustedError
 from .kv_cache import (PagedKVCache, copy_page, insert_pages,
-                       write_prompt_kv, write_prompt_kv_at, write_token_kv)
+                       write_prompt_kv, write_prompt_kv_at, write_span_kv,
+                       write_token_kv)
 from .page_allocator import BlockAllocator
 from .scheduler import RequestScheduler
 
 __all__ = ["ServingEngine", "prefill_program", "prefix_prefill_program",
-           "decode_program", "serve_disagg_mode"]
+           "decode_program", "spec_verify_program", "ngram_propose",
+           "serve_disagg_mode", "serve_spec_k"]
 
 
 def serve_disagg_mode(disagg=None):
@@ -103,6 +140,51 @@ def serve_disagg_mode(disagg=None):
     if disagg is not None:
         return bool(disagg)
     return env in ("on", "1")
+
+
+def serve_spec_k(spec_k=0):
+    """Resolve the speculative-decoding knob:
+    ``CHAINERMN_TPU_SERVE_SPEC=off`` forces vanilla one-token decode
+    regardless of the constructor (always safe — the spec-on trajectory
+    is pinned bit-identical to it).  Resolved ONCE at engine
+    construction, like the paged-attention and disagg modes."""
+    if os.environ.get("CHAINERMN_TPU_SERVE_SPEC", "").lower() == "off":
+        return 0
+    return int(spec_k or 0)
+
+
+def ngram_propose(history, k, n=3):
+    """The built-in self-speculative draft: prompt-lookup n-gram match.
+
+    Deterministic and pure host work: find the most recent EARLIER
+    occurrence of the trailing ``n``-gram of ``history`` (falling back
+    to shorter grams down to 1) and propose the ``k`` tokens that
+    followed it; pad by repeating the last token when the match runs
+    off the end (or nothing matches).  Draft quality only moves the
+    accept rate — greedy accept/reject makes the emitted trajectory
+    independent of WHAT is proposed, so this needs no model at all.
+    """
+    h = np.asarray(history, dtype=np.int64)
+    L = h.size
+    if k <= 0:
+        return np.zeros(0, dtype=np.int32)
+    out = None
+    for g in range(min(n, L - 1), 0, -1):
+        tail = h[L - g:]
+        # candidate gram ends at i + g (exclusive), strictly before L
+        for i in range(L - g - 1, -1, -1):
+            if np.array_equal(h[i:i + g], tail):
+                out = h[i + g:i + g + k]
+                break
+        if out is not None:
+            break
+    if out is None:
+        out = h[L - 1:]          # no match: repeat the last token
+    prop = np.empty(k, dtype=np.int32)
+    m = min(k, out.size)
+    prop[:m] = out[:m]
+    prop[m:] = int(out[m - 1]) if m else int(h[-1])
+    return prop
 
 
 def _embed_tokens(model, toks, positions):
@@ -239,6 +321,64 @@ def decode_program(model, state, k_pool, v_pool, toks, pos, bts, *,
             .astype(jnp.int32)
 
 
+def spec_verify_program(model, state, k_pool, v_pool, toks, start,
+                        n_valid, bts, *, tp_mesh=None):
+    """Pure speculative VERIFY step: score K+1 tokens per lane in one
+    dispatch (round 20).
+
+    ``toks``: ``[Bb, K1]`` int32 — lane ``b``'s pending token followed
+    by its K draft proposals; token ``j`` sits at absolute position
+    ``start[b] + j``.  ``start``: ``[Bb]`` int32 (``< 0`` = idle
+    lane).  ``n_valid``: ``[Bb]`` int32 — only the first ``n_valid[b]``
+    span slots write K/V (lanes near their emit budget speculate
+    short; surplus writes drop).  Per layer: ONE drop-fenced span
+    scatter per pool (``write_span_kv``), then ONE gather per pool and
+    a multi-query masked softmax over the block tables
+    (:func:`~chainermn_tpu.ops.paged_attention.paged_verify_attention`)
+    — query ``j`` sees exactly positions ``<= start + j``, i.e. the
+    context a vanilla decode step at that position would see, which is
+    why the returned argmax row ``g[b, j]`` equals what one-token
+    decode WOULD have produced had tokens ``0..j`` been emitted one at
+    a time.  The host then accepts the longest prefix where draft
+    ``j+1`` equals ``g[j]`` and emits ``g[0..a]`` — up to K+1 tokens
+    from one dispatch, bit-identical to vanilla greedy decode.
+    Returns ``(k_pool, v_pool, logits [Bb, K1, V] fp32, g [Bb, K1])``.
+    """
+    with bind_state(model, state):
+        Bb, K1 = toks.shape
+        safe_start = jnp.maximum(start, 0)
+        pos = safe_start[:, None] + jnp.arange(K1, dtype=jnp.int32)[None]
+        h = _embed_tokens(model, toks, pos)
+        scale = 1.0 / (model.blocks[0].attn.d_head ** 0.5)
+        for li, block in enumerate(model.blocks):
+            x = block.ln1(h)
+            qkv = block.attn.qkv(x.reshape(Bb * K1, -1)).reshape(
+                Bb, K1, 3, block.attn.n_heads, block.attn.d_head)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            k_pool = k_pool.at[li].set(write_span_kv(
+                k_pool[li], k, bts, start, n_valid))
+            v_pool = v_pool.at[li].set(write_span_kv(
+                v_pool[li], v, bts, start, n_valid))
+            att = paged_verify_attention(q, k_pool[li], v_pool[li], bts,
+                                         start, scale=scale,
+                                         tp_mesh=tp_mesh)
+            h = h + block.attn.proj(att.reshape(Bb * K1, -1)) \
+                .reshape(Bb, K1, -1)
+            m = block.fc2(F.gelu(block.fc1(block.ln2(h)
+                                           .reshape(Bb * K1, -1))))
+            h = h + m.reshape(Bb, K1, -1)
+        logits = model.head(model.ln_f(h.reshape(Bb * K1, -1))) \
+            .reshape(Bb, K1, -1).astype(jnp.float32)
+        return k_pool, v_pool, logits, jnp.argmax(logits, axis=-1) \
+            .astype(jnp.int32)
+
+
+class _AdmitDeferred(Exception):
+    """Internal: this request cannot admit THIS step (e.g. the single
+    disagg scratch pool is mid-chunk for another prompt) — requeue
+    front-of-line and retry next step.  Never escapes the engine."""
+
+
 def _bucket(n, buckets, what):
     for b in buckets:
         if n <= b:
@@ -274,13 +414,26 @@ class ServingEngine:
     decode slice, degenerating to the same device on one-device hosts).
     ``tp``: shard the KV pools (and both programs) over the head axis
     of a ``tp``-way mesh.
+    ``spec_k``: speculative decoding — K draft tokens verified per
+    sequence per decode dispatch (0 = vanilla one-token decode;
+    ``CHAINERMN_TPU_SERVE_SPEC=off`` forces 0).  ``draft_model``: a
+    small TransformerLM-shaped drafter (same vocabulary; its KV pages
+    are indexed by the SAME block tables, so it must accept the
+    engine's page geometry); ``None`` = the n-gram self-draft.
+    ``chunk_tokens``: chunked prefill — prompts whose unmatched
+    remainder exceeds this admit in page-multiple chunks interleaved
+    with decode steps (``None`` = off, one-shot prefill as before).
+    ``chunk_budget``: max prefill tokens advanced per engine step
+    (default ``chunk_tokens`` — one chunk per step).
     """
 
     def __init__(self, model, num_pages=256, page_size=16, max_batch=8,
                  max_context=256, page_dtype=None, max_queue=256,
                  scheduler=None, mode=None, eos_id=None,
                  prefix_cache=True, disagg=None, tp=1,
-                 prefill_device=None, decode_device=None):
+                 prefill_device=None, decode_device=None,
+                 spec_k=0, draft_model=None, chunk_tokens=None,
+                 chunk_budget=None):
         blk = model.blocks[0].attn
         n_layers = len(list(model.blocks))
         max_len = model.pos_embed.W.shape[0]
@@ -303,17 +456,42 @@ class ServingEngine:
         self.prefix_cache = bool(prefix_cache)
         self.disagg = serve_disagg_mode(disagg)
         self.tp = int(tp)
-        self.prefill_buckets = _pow2_buckets(min(16, self.max_context),
-                                             self.max_context)
+        self.spec_k = serve_spec_k(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k={spec_k} must be >= 0")
+        self.draft_model = draft_model if self.spec_k else None
+        self.chunk_tokens = int(chunk_tokens) if chunk_tokens else None
+        if self.chunk_tokens is not None:
+            if self.chunk_tokens % page_size:
+                raise ValueError(
+                    f"chunk_tokens={chunk_tokens} must be a multiple of "
+                    f"page_size={page_size} (chunks end on page "
+                    f"boundaries)")
+            if self.chunk_tokens > self.max_context:
+                raise ValueError(
+                    f"chunk_tokens={chunk_tokens} exceeds "
+                    f"max_context={max_context}")
+        self.chunk_budget = int(chunk_budget) if chunk_budget \
+            else (self.chunk_tokens or 0)
+        # prefill buckets top out at the chunk size when chunking: any
+        # prompt (or unmatched suffix) above the largest bucket routes
+        # to the chunk state machine, so _bucket's ValueError becomes
+        # unreachable for admitted work (the round-20 engine fix)
+        prefill_cap = self.chunk_tokens or self.max_context
+        self.prefill_buckets = _pow2_buckets(min(16, prefill_cap),
+                                             prefill_cap)
         self.batch_buckets = _pow2_buckets(1, self.max_batch)
         self.transfer_buckets = _pow2_buckets(1, self.n_block_entries)
         self.running = []       # admission order, oldest first
+        self.prefilling = []    # mid-chunk admissions, oldest first
         self.completed = []
         self.prefill_traces = 0
         self.prefix_prefill_traces = 0
         self.decode_traces = 0
         self.fork_traces = 0
         self.transfer_traces = 0
+        self.spec_traces = 0
+        self.chunk_traces = 0
         self.evictions = 0
         self.decode_steps = 0
         self.admissions = 0
@@ -322,6 +500,34 @@ class ServingEngine:
         self.forks = 0
         self.transfers = 0
         self.transferred_page_bytes = 0
+        self.spec_steps = 0
+        self.spec_lane_steps = 0   # lane-dispatches: sum of batch sizes
+        self.spec_proposed = 0     # over spec steps — the denominator
+        self.spec_accepted = 0     # of accepted_tokens_per_dispatch
+        self.spec_emitted = 0
+        self.draft_dispatches = 0
+        self.chunk_prefills = 0
+        self.chunked_admissions = 0
+
+        # draft KV pools: indexed by the SAME block tables as the target
+        # pools (same page geometry), so draft pages ride the same
+        # refcounted allocator — one accounting, one eviction story
+        if self.draft_model is not None:
+            dblk = self.draft_model.blocks[0].attn
+            d_max_len = self.draft_model.pos_embed.W.shape[0]
+            if d_max_len < self.max_context:
+                raise ValueError(
+                    f"draft_model max_len={d_max_len} below "
+                    f"max_context={max_context}")
+            self._draft_state = extract_state(self.draft_model)
+            self._kv_draft = PagedKVCache(
+                len(list(self.draft_model.blocks)), num_pages, page_size,
+                dblk.n_heads, dblk.d_head, dtype=page_dtype)
+            # the draft's full-prompt prefill buckets are UNCAPPED by
+            # chunking (the draft is small — one flash pass is cheaper
+            # than teaching it the chunk machinery)
+            self._draft_prefill_buckets = _pow2_buckets(
+                min(16, self.max_context), self.max_context)
 
         devices = jax.devices()
 
@@ -398,6 +604,36 @@ class ServingEngine:
                                   toks, pos, bts, mode=self.mode,
                                   tp_mesh=self._tp_mesh)
 
+        def _spec_verify(state, k_pool, v_pool, toks, start, n_valid,
+                         bts):
+            self.spec_traces += 1   # trace-time side effect only
+            return spec_verify_program(self.model, state, k_pool, v_pool,
+                                       toks, start, n_valid, bts,
+                                       tp_mesh=self._tp_mesh)
+
+        def _chunk(state, k_pool, v_pool, tokens, true_len, start,
+                   bt_row):
+            # the chunk program IS the suffix-prefill program — the
+            # chunk cursor rides the same offset writer — but with its
+            # own jit identity so chunk compiles are counted (and
+            # warmed) separately from prefix-hit suffix prefills
+            self.chunk_traces += 1
+            return prefix_prefill_program(self.model, state, k_pool,
+                                          v_pool, tokens, true_len,
+                                          start, bt_row)
+
+        def _draft_prefill(state, k_pool, v_pool, tokens, true_len,
+                           bt_row):
+            self.spec_traces += 1
+            return prefill_program(self.draft_model, state, k_pool,
+                                   v_pool, tokens, true_len, bt_row)
+
+        def _draft_decode(state, k_pool, v_pool, toks, pos, bts):
+            self.spec_traces += 1
+            return decode_program(self.draft_model, state, k_pool,
+                                  v_pool, toks, pos, bts, mode=self.mode,
+                                  tp_mesh=None)
+
         def _fork(k_pool, v_pool, src, dst):
             self.fork_traces += 1
             return copy_page(k_pool, v_pool, src, dst)
@@ -415,6 +651,13 @@ class ServingEngine:
         self._prefix_prefill_fn = jax.jit(_prefix_prefill,
                                           donate_argnums=donate)
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        self._spec_verify_fn = jax.jit(_spec_verify,
+                                       donate_argnums=donate)
+        self._chunk_fn = jax.jit(_chunk, donate_argnums=donate)
+        self._draft_prefill_fn = jax.jit(_draft_prefill,
+                                         donate_argnums=donate)
+        self._draft_decode_fn = jax.jit(_draft_decode,
+                                        donate_argnums=donate)
         self._fork_fn = jax.jit(_fork, donate_argnums=donate01)
         self._extract_fn = jax.jit(_extract, static_argnums=2)
         self._insert_fn = jax.jit(_insert, donate_argnums=donate01)
@@ -534,9 +777,17 @@ class ServingEngine:
         alive through their other holders), fold generated tokens into
         the prompt, re-queue front-of-line (recompute on re-admit).
         ``now`` stamps the requeue instant so the re-admission's queue
-        wait measures the re-queue dwell, not the running period."""
+        wait measures the re-queue dwell, not the running period.
+
+        A MID-CHUNK victim (round 20) frees its already-written chunk
+        pages the same way — the scheduler's requeue resets its chunk
+        cursor, so re-admission restarts from chunk 0 with no page
+        leaked and no stale cursor (the scheduler-fix satellite)."""
         self.allocator.free(req.request_id)
-        self.running.remove(req)
+        if req in self.running:
+            self.running.remove(req)
+        else:
+            self.prefilling.remove(req)
         req.requeue_time = now
         self.scheduler.requeue_front(req)
         self.evictions += 1
@@ -595,9 +846,18 @@ class ServingEngine:
             self._kv_prefill.v_pool, jnp.asarray(tokens), np.int32(L),
             self._scratch_bt)
         self._kv_prefill.k_pool, self._kv_prefill.v_pool = k, v
+        self._ship_pages(req, L)
+        return logits
+
+    def _ship_pages(self, req, L):
+        """Ship the first ``pages_for(L)`` scratch-pool pages into the
+        decode pool at the request's allocated page ids (the disagg
+        transfer leg, shared by one-shot and chunked prefills — a
+        chunked prompt ships ONCE, after its last chunk)."""
         n_pages = self.allocator.pages_for(L)
         nb = _bucket(n_pages, self.transfer_buckets, "transfer pages")
-        kb, vb = self._extract_fn(k, v, nb)
+        kb, vb = self._extract_fn(self._kv_prefill.k_pool,
+                                  self._kv_prefill.v_pool, nb)
         kb = jax.device_put(kb, self._block_placement)
         vb = jax.device_put(vb, self._block_placement)
         rows = np.full(nb, self.kv.num_pages, dtype=np.int32)
@@ -618,7 +878,6 @@ class ServingEngine:
                 "chainermn_tpu_serving_transferred_page_bytes_total",
                 help="KV page bytes shipped prefill slice -> decode "
                      "pool").inc(shipped)
-        return logits
 
     def _admit(self, req, clock):
         """Pages + prefill + first token.  Raises PagePoolExhaustedError
@@ -635,29 +894,47 @@ class ServingEngine:
         sid = req.request_id
         t_admit = clock()
         matched = 0
+        chunked = False
         prompt_t = tuple(int(t) for t in req.prompt) \
             if self.prefix_cache else ()
         if self.prefix_cache and L > 1:
             pages, matched, n_full, partial = \
                 self.allocator.match_prefix(prompt_t, L - 1)
             if matched:
+                chunked = self.chunk_tokens is not None \
+                    and (L - matched) > self.chunk_tokens
                 # all HOST-side allocation first (each call atomic, the
                 # composite rolled back below), the device page copy
                 # only once the admission cannot fail — a rollback must
-                # not burn a copy or inflate the forks counter
+                # not burn a copy or inflate the forks counter.  A
+                # chunked admission reserves only its FIRST chunk's
+                # pages (the point of chunking: a 16k prompt does not
+                # grab 16k positions of pool up front)
                 self.allocator.share(sid, pages)
                 old = new = None
                 try:
                     if partial:
                         old, new = self.allocator.fork(sid, n_full)
-                    self.allocator.ensure(sid, L + 1)  # +1: first decode
+                    self.allocator.ensure(
+                        sid, (matched + self.chunk_tokens) if chunked
+                        else L + 1)            # +1: first decode
                 except PagePoolExhaustedError:
                     self.allocator.free(sid)   # roll the share back
                     raise
                 if new is not None and old != new:
                     self._run_fork(old, new)
         if not matched:
-            self.allocator.ensure(sid, L + 1)
+            chunked = self.chunk_tokens is not None \
+                and L > self.chunk_tokens
+            if chunked and self.disagg \
+                    and any(r._chunk_scratch for r in self.prefilling):
+                # ONE scratch pool on the prefill slice: a second
+                # prefix-miss chunk stream would interleave into it —
+                # defer (prefix-HIT chunk streams run against the
+                # decode pool and admit freely)
+                raise _AdmitDeferred()
+            self.allocator.ensure(
+                sid, self.chunk_tokens if chunked else L + 1)
         # queue-wait accounting (always — the bench reads it trace-off):
         # this admission's wait is arrival → now, or requeue → now after
         # an eviction (the prior RUNNING period is decode time, not
@@ -673,6 +950,28 @@ class ServingEngine:
         rtid = self._req_tid(req) if obs_on else None
         if obs_on:
             self._obs_admitted(req, wait_s, readmit)
+        if chunked:
+            # chunk-admitted: the prompt enters the chunk state machine
+            # (cursor at the matched prefix; chunks advance in step()'s
+            # chunk pass under the per-step budget).  No logits, no
+            # first token, no prefix registration yet — those happen at
+            # the LAST chunk.  The hit stats book now: the shared pages
+            # are held from here on.
+            req._chunk_pos = matched
+            req._chunk_scratch = self.disagg and not matched
+            if matched:
+                self.prefix_hits += 1
+                self.prefix_tokens_matched += matched
+            req.admit_time = t_admit
+            req.requeue_time = None   # consumed: next eviction re-stamps
+            self.chunked_admissions += 1
+            self.prefilling.append(req)
+            if obs_on:
+                observability.instant(
+                    "serve/chunk_admit",
+                    tags={"request": sid, "prompt": L,
+                          "matched": matched}, tid=rtid)
+            return
         if matched:
             with observability.span(
                     "serve/suffix_prefill",
@@ -703,18 +1002,129 @@ class ServingEngine:
                     jnp.asarray(tokens), np.int32(L),
                     jnp.asarray(self._bt_row(sid)))
                 self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
-        self.admissions += 1
         req.admit_time = t_admit
         req.requeue_time = None   # consumed: next eviction re-stamps
+        self._complete_admission(req, logits, clock, prompt_t)
+
+    def _complete_admission(self, req, logits, clock, prompt_t):
+        """The bookkeeping shared by one-shot and LAST-chunk admission:
+        register the prefix, prefill the draft model's pools (its pages
+        are the same block tables), take the first token from the
+        prefill logits, and join the running batch."""
+        sid = req.request_id
+        self.admissions += 1
         if self.prefix_cache:
             self.allocator.register_prefix(sid, prompt_t)
+        if self.draft_model is not None:
+            self._run_draft_prefill(req)
         tok = int(np.asarray(jnp.argmax(logits)))
-        req._ctx = L            # positions whose KV is written
+        req._ctx = int(req.prompt.size)  # positions whose KV is written
         t = clock()
         self._record_token(req, tok, t)
         self.running.append(req)
         if self._finished(req):
             self._retire(req, t)
+
+    def _run_draft_prefill(self, req):
+        """Write the DRAFT model's KV for the whole prompt through the
+        request's block tables (one small flash pass; logits
+        discarded — the first token always comes from the target).
+        Positions inside shared prefix pages rewrite bytes the provider
+        already wrote — same draft model, same tokens, same positions,
+        so the bytes are identical and the refcounts never notice."""
+        L = int(req.prompt.size)
+        Tb = _bucket(L, self._draft_prefill_buckets, "draft prompt")
+        tokens = np.zeros((1, Tb), dtype=np.int32)
+        tokens[0, :L] = req.prompt
+        k, v, _ = self._draft_prefill_fn(
+            self._draft_state, self._kv_draft.k_pool,
+            self._kv_draft.v_pool, jnp.asarray(tokens), np.int32(L),
+            jnp.asarray(self._bt_row(req.request_id)))
+        self._kv_draft.k_pool, self._kv_draft.v_pool = k, v
+        req._draft_ctx = L
+
+    def _run_chunk(self, req, startp, size, final, clock):
+        """One chunk of a chunked prefill: ``size`` prompt tokens at
+        cursor ``startp`` through the chunk program (the offset-writer
+        suffix shape; chunk 0 is ``start=0``).  Prefix-miss chunks on
+        the disagg split run on the PREFILL slice against the scratch
+        pool (identity block table) and ship once, after the last
+        chunk; everything else runs against the decode pool."""
+        sid = req.request_id
+        L = int(req.prompt.size)
+        Tb = _bucket(size, self.prefill_buckets, "chunk length")
+        tokens = np.zeros((1, Tb), dtype=np.int32)
+        tokens[0, :size] = req.prompt[startp:startp + size]
+        scratch = getattr(req, "_chunk_scratch", False)
+        if scratch:
+            k, v, logits = self._chunk_fn(
+                self._state_prefill, self._kv_prefill.k_pool,
+                self._kv_prefill.v_pool, jnp.asarray(tokens),
+                np.int32(size), np.int32(startp), self._scratch_bt)
+            self._kv_prefill.k_pool, self._kv_prefill.v_pool = k, v
+        else:
+            k, v, logits = self._chunk_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.asarray(tokens), np.int32(size), np.int32(startp),
+                jnp.asarray(self._bt_row(sid)))
+            self.kv.k_pool, self.kv.v_pool = k, v
+        self.chunk_prefills += 1
+        req._chunk_pos = startp + size
+        if final:
+            if scratch:
+                self._ship_pages(req, L)
+            self.prefilling.remove(req)
+            prompt_t = tuple(int(t) for t in req.prompt) \
+                if self.prefix_cache else ()
+            self._complete_admission(req, logits, clock, prompt_t)
+
+    def _advance_chunks(self, clock):
+        """The chunk pass of one engine step: advance mid-chunk
+        prompts, oldest first, under the per-step token budget (the
+        interleave that keeps decode latency flat while long prompts
+        stream in).  A request whose next chunk cannot get pages
+        STALLS — it keeps the pages it has and retries next step;
+        admission-flavored work never preempts running sequences.  The
+        one exception is the all-prefilling deadlock (no running work,
+        two-plus mid-chunk prompts splitting a full pool): the
+        YOUNGEST other mid-chunk victim is evicted so the oldest can
+        finish.  Returns prefill tokens advanced."""
+        budget = self.chunk_budget
+        progressed = 0
+        obs_on = observability.enabled()
+        for req in list(self.prefilling):
+            if budget <= 0:
+                break
+            L = int(req.prompt.size)
+            while budget > 0 and req in self.prefilling:
+                startp = req._chunk_pos
+                remaining = L - startp
+                size = min(self.chunk_tokens, remaining)
+                final = size == remaining
+                try:
+                    self.allocator.ensure(
+                        req.request_id,
+                        startp + size + (1 if final else 0))
+                except PagePoolExhaustedError:
+                    break   # stall: keep pages, retry next step
+                with observability.span(
+                        "serve/chunk_prefill",
+                        tags={"request": req.request_id,
+                              "start": startp, "chunk": size,
+                              "final": final} if obs_on else None,
+                        tid=self._req_tid(req) if obs_on else None):
+                    self._run_chunk(req, startp, size, final, clock)
+                budget -= size
+                progressed += size
+        if not progressed and not self.running \
+                and len(self.prefilling) > 1:
+            # deadlock guard: evict the youngest OTHER mid-chunk prompt
+            # (they hold pages and produced no tokens — least work
+            # lost); the oldest inherits the freed pages next step
+            victim = self.scheduler.pick_victim(
+                [], self.allocator, prefilling=self.prefilling[1:])
+            self._evict(victim, clock())
+        return progressed
 
     def capacity_multiplier(self):
         """Effective-capacity multiplier prefix sharing is buying right
@@ -723,6 +1133,86 @@ class ServingEngine:
         is shared."""
         used = self.allocator.used_pages
         return self.allocator.logical_pages() / used if used else 1.0
+
+    def _spec_nv(self, req):
+        """Valid span length for this lane's verify step: the pending
+        token plus at most K drafts, clamped so the lane never emits
+        past its ``max_new_tokens`` budget — which (by the submit-time
+        fit bound) also keeps every speculative write inside
+        ``max_context`` and inside pages the capacity pass ensured."""
+        r = req.max_new_tokens - len(req.tokens)   # >= 1 while running
+        return 1 + min(self.spec_k, r - 1)
+
+    def _propose_drafts(self, nv):
+        """K draft tokens per running lane: the n-gram self-draft (pure
+        host), or the draft model — one conditional catch-up dispatch
+        (a fully-accepted lane's draft counter trails the target by
+        exactly one position) followed by K single-token draft decode
+        dispatches through the SAME block tables.  Draft writes land
+        only at positions the capacity pass already ensured; rejected
+        draft KV rewinds by counter exactly like the target's."""
+        K = self.spec_k
+        n = len(self.running)
+        if self.draft_model is None:
+            drafts = np.zeros((n, K), dtype=np.int32)
+            for j, req in enumerate(self.running):
+                hist = np.concatenate(
+                    [np.asarray(req.prompt, np.int64),
+                     np.asarray(req.tokens, np.int64)])
+                drafts[j] = ngram_propose(hist, K)
+            return drafts
+        Bb = _bucket(n, self.batch_buckets, "batch")
+        bts = np.zeros((Bb, self.n_block_entries), dtype=np.int32)
+        for j, req in enumerate(self.running):
+            bts[j] = self._bt_row(req.request_id)
+        bts_j = jnp.asarray(bts)
+        # catch-up: lanes at gap 1 write the history token the target
+        # accepted past them (everyone else idles at pos -1, dropped)
+        cu_tok = np.zeros(Bb, dtype=np.int32)
+        cu_pos = np.full(Bb, -1, dtype=np.int32)
+        any_gap = False
+        for j, req in enumerate(self.running):
+            if req._draft_ctx == req._ctx - 1:
+                any_gap = True
+                cu_pos[j] = req._ctx - 1
+                cu_tok[j] = req.tokens[-2] if len(req.tokens) >= 2 \
+                    else int(req.prompt[-1])
+                req._draft_ctx = req._ctx
+        if any_gap:
+            k, v, _, _ = self._draft_decode_fn(
+                self._draft_state, self._kv_draft.k_pool,
+                self._kv_draft.v_pool, jnp.asarray(cu_tok),
+                jnp.asarray(cu_pos), bts_j)
+            self._kv_draft.k_pool, self._kv_draft.v_pool = k, v
+            self.draft_dispatches += 1
+        drafts = np.zeros((n, K), dtype=np.int32)
+        cur = np.zeros(Bb, dtype=np.int32)
+        for j, req in enumerate(self.running):
+            cur[j] = req.tokens[-1]
+        for i in range(K):
+            pos = np.full(Bb, -1, dtype=np.int32)
+            live = False
+            for j, req in enumerate(self.running):
+                if i < nv[j] - 1:
+                    pos[j] = req._ctx + i
+                    live = True
+            if not live:
+                break
+            k, v, _, nxt = self._draft_decode_fn(
+                self._draft_state, self._kv_draft.k_pool,
+                self._kv_draft.v_pool, jnp.asarray(cur),
+                jnp.asarray(pos), bts_j)
+            self._kv_draft.k_pool, self._kv_draft.v_pool = k, v
+            self.draft_dispatches += 1
+            nxt = np.asarray(nxt)
+            keep = pos >= 0
+            drafts[:, i][keep[:n]] = nxt[:n][keep[:n]]
+            cur = np.where(keep, nxt, cur).astype(np.int32)
+        for j, req in enumerate(self.running):
+            # positions ctx .. ctx+nv-2 now hold draft KV; acceptance
+            # rewinds this to min(draft_ctx, new ctx) after the verify
+            req._draft_ctx = req._ctx + max(0, int(nv[j]) - 1)
+        return drafts
 
     def warmup(self):
         """Compile EVERY bucketed program up front: one dummy prefill
@@ -734,7 +1224,13 @@ class ServingEngine:
         drops), and one dummy decode per batch bucket (all lanes idle).
         Pool contents are unchanged; afterwards joins/leaves/forks/
         transfers never retrace (the serving bench asserts
-        ``window_retraces == 0``)."""
+        ``window_retraces == 0``).  Round 20 grids ride along: one
+        chunk program per prefill bucket (per pool shape on the disagg
+        split), one spec verify per batch bucket (all lanes idle,
+        every span write dropped), and the draft model's prefill +
+        decode grids — afterwards ``spec_traces``/``chunk_traces``
+        stay frozen across joins, forks, evictions and accept-length
+        swings (the round-20 retrace pin)."""
         for Tb in self.prefill_buckets:
             if self.disagg:
                 k, v, _ = self._prefill_fn(
@@ -772,6 +1268,25 @@ class ServingEngine:
             self.kv.k_pool, self.kv.v_pool = self._fork_fn(
                 self.kv.k_pool, self.kv.v_pool, jnp.int32(0),
                 jnp.int32(0))
+        if self.chunk_tokens is not None:
+            for Tb in self.prefill_buckets:
+                k_pool, v_pool, _ = self._chunk_fn(
+                    self.state, self.kv.k_pool, self.kv.v_pool,
+                    jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                    np.int32(0),
+                    jnp.zeros(self.n_block_entries, jnp.int32))
+                self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+                if self.disagg:
+                    # scratch-pool chunk shape (prefix-miss chunks run
+                    # on the prefill slice): distinct pool dims mean a
+                    # distinct compile — warm it too
+                    k, v, _ = self._chunk_fn(
+                        self._state_prefill, self._kv_prefill.k_pool,
+                        self._kv_prefill.v_pool,
+                        jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                        np.int32(0), self._scratch_bt)
+                    self._kv_prefill.k_pool = k
+                    self._kv_prefill.v_pool = v
         for Bb in self.batch_buckets:
             k_pool, v_pool, _, nxt = self._decode_fn(
                 self.state, self.kv.k_pool, self.kv.v_pool,
@@ -779,6 +1294,29 @@ class ServingEngine:
                 jnp.full(Bb, -1, jnp.int32),
                 jnp.zeros((Bb, self.n_block_entries), jnp.int32))
             self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            if self.spec_k:
+                k_pool, v_pool, _, nxt = self._spec_verify_fn(
+                    self.state, self.kv.k_pool, self.kv.v_pool,
+                    jnp.zeros((Bb, self.spec_k + 1), jnp.int32),
+                    jnp.full(Bb, -1, jnp.int32),
+                    jnp.zeros(Bb, jnp.int32),
+                    jnp.zeros((Bb, self.n_block_entries), jnp.int32))
+                self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+        if self.draft_model is not None:
+            for Tb in self._draft_prefill_buckets:
+                k, v, _ = self._draft_prefill_fn(
+                    self._draft_state, self._kv_draft.k_pool,
+                    self._kv_draft.v_pool,
+                    jnp.zeros((1, Tb), jnp.int32), np.int32(0),
+                    jnp.zeros(self.n_block_entries, jnp.int32))
+                self._kv_draft.k_pool, self._kv_draft.v_pool = k, v
+            for Bb in self.batch_buckets:
+                k, v, _, nxt = self._draft_decode_fn(
+                    self._draft_state, self._kv_draft.k_pool,
+                    self._kv_draft.v_pool, jnp.zeros(Bb, jnp.int32),
+                    jnp.full(Bb, -1, jnp.int32),
+                    jnp.zeros((Bb, self.n_block_entries), jnp.int32))
+                self._kv_draft.k_pool, self._kv_draft.v_pool = k, v
         np.asarray(nxt)  # sync: compiles really happened
 
     # -- the step loop -------------------------------------------------------
@@ -794,40 +1332,55 @@ class ServingEngine:
         everything in this step with that value."""
         clock = time.monotonic if now is None else (lambda: now)
         stats = {"admitted": 0, "evicted_before": self.evictions}
-        # capacity FIRST: secure this step's token page for every
+        # capacity FIRST: secure this step's token page(s) for every
         # running sequence (evicting youngest-first when the pool runs
         # dry) BEFORE admitting anyone — admission into pages the
         # running batch is about to need would get the just-prefilled
-        # newcomer evicted in the same step, burning its whole prefill
+        # newcomer evicted in the same step, burning its whole prefill.
+        # Speculative decode secures the whole verify SPAN (up to K+1
+        # positions); mid-chunk prompts are eviction candidates too —
+        # preferred victims, in fact: they hold pages and have produced
+        # zero tokens
         i = 0
         while i < len(self.running):
             req = self.running[i]
+            need = self._spec_nv(req) if self.spec_k else 1
             try:
-                self.allocator.ensure(req.request_id, req._ctx + 1)
+                self.allocator.ensure(req.request_id, req._ctx + need)
                 i += 1
             except PagePoolExhaustedError:
                 # refcount-aware victim choice: a victim must FREE
                 # something (EvictionStalledError otherwise — the
                 # prefix-sharing livelock guard)
-                victim = self.scheduler.pick_victim(self.running,
-                                                    self.allocator)
+                victim = self.scheduler.pick_victim(
+                    self.running, self.allocator,
+                    prefilling=self.prefilling)
                 self._evict(victim, clock())
                 # victim may be req: the slot under scrutiny vanished —
                 # re-check the same index (now the next request)
         # admission at decode-step granularity, into the pages left
-        # over (its growth page is secured by _admit's ensure(L + 1))
-        while len(self.running) < self.max_batch:
+        # over (its growth page is secured by _admit's ensure; a
+        # chunk-admitted prompt counts against max_batch from its
+        # FIRST chunk — the engine's concurrency bound covers work in
+        # flight, not just work decoding)
+        while len(self.running) + len(self.prefilling) < self.max_batch:
             req = self.scheduler.next_admission(arrived_by=clock())
             if req is None:
                 break
             try:
                 self._admit(req, clock)
                 stats["admitted"] += 1
-            except PagePoolExhaustedError:
-                # pool full: wait (admission never preempts running
-                # work — only decode growth does)
+            except (PagePoolExhaustedError, _AdmitDeferred):
+                # pool full (or the scratch slice is busy): wait
+                # (admission never preempts running work — only decode
+                # growth does)
                 self.scheduler.requeue_front(req, preempted=False)
                 break
+        # the chunk pass: long prompts stream in, budgeted, BETWEEN
+        # the admission pass and the decode dispatch — decode keeps
+        # running every step, which is the whole p99 story
+        if self.prefilling:
+            stats["chunk_tokens"] = self._advance_chunks(clock)
         n = len(self.running)
         stats["evicted"] = self.evictions - stats.pop("evicted_before")
         stats["running"] = n
@@ -839,6 +1392,8 @@ class ServingEngine:
         if n == 0:
             stats["decoded"] = 0
             return stats
+        if self.spec_k:
+            return self._spec_step(n, clock, stats)
         with observability.span(
                 "serve/decode_window",
                 tags={"batch": n, "step": self.decode_steps}
@@ -866,12 +1421,92 @@ class ServingEngine:
         stats["decoded"] = n
         return stats
 
+    def _spec_step(self, n, clock, stats):
+        """The speculative decode window: draft K tokens per lane,
+        verify all K+1 positions in ONE target dispatch, accept the
+        longest matching prefix.  The verify row ``g[j]`` IS the token
+        vanilla decode would emit at position ``start + j`` given the
+        preceding accepts — so emitting ``g[0..a]`` (a = accepted draft
+        count) is bit-identical to running a+1 vanilla steps, and the
+        a+1-th token comes free (the classic speculative bonus).
+        Rejected span positions hold garbage KV above the new counter:
+        never read (ctx_len masks them) and overwritten by the next
+        step's drop-fenced writes — rollback is the counter rewind
+        itself."""
+        K1 = self.spec_k + 1
+        nv = np.zeros(n, dtype=np.int32)
+        for j, req in enumerate(self.running):
+            nv[j] = self._spec_nv(req)
+            # lanes admitted THIS step were not in the capacity pass
+            # (it runs before admission): secure their span pages now,
+            # DEGRADING the window instead of evicting when the pool is
+            # dry — admission's own L+1 ensure guarantees nv >= 1, so
+            # the step never stalls, it just speculates less
+            try:
+                self.allocator.ensure(req.request_id,
+                                      req._ctx + int(nv[j]))
+            except PagePoolExhaustedError:
+                nv[j] = min(int(nv[j]),
+                            self.allocator.capacity(req.request_id)
+                            - req._ctx)
+        drafts = self._propose_drafts(nv)
+        with observability.span(
+                "serve/spec_window",
+                tags={"batch": n, "step": self.decode_steps}
+                if observability.enabled() else None):
+            Bb = _bucket(n, self.batch_buckets, "batch")
+            toks = np.zeros((Bb, K1), dtype=np.int32)
+            start = np.full(Bb, -1, dtype=np.int32)
+            nvb = np.zeros(Bb, dtype=np.int32)
+            bts = np.zeros((Bb, self.n_block_entries), dtype=np.int32)
+            for j, req in enumerate(self.running):
+                toks[j, 0] = req.tokens[-1]
+                toks[j, 1:] = drafts[j]
+                start[j] = req._ctx
+                nvb[j] = nv[j]
+                bts[j] = self._bt_row(req.request_id)
+            k_pool, v_pool, _logits, g = self._spec_verify_fn(
+                self.state, self.kv.k_pool, self.kv.v_pool,
+                jnp.asarray(toks), jnp.asarray(start), jnp.asarray(nvb),
+                jnp.asarray(bts))
+            self.kv.k_pool, self.kv.v_pool = k_pool, v_pool
+            g = np.asarray(g)       # device->host sync
+            self.decode_steps += 1  # ONE dispatch for up to K+1 tokens
+            self.spec_steps += 1
+            self.spec_lane_steps += n
+        t_tok = clock()
+        emitted_total = 0
+        for j, req in enumerate(list(self.running)):
+            nvj = int(nv[j])
+            a = 0
+            while a < nvj - 1 and int(toks[j, a + 1]) == int(g[j, a]):
+                a += 1
+            self.spec_proposed += nvj - 1
+            self.spec_accepted += a
+            for i in range(a + 1):
+                req._ctx += 1
+                self._record_token(req, int(g[j, i]), t_tok)
+                emitted_total += 1
+                self.spec_emitted += 1
+                if self._finished(req):
+                    break   # eos inside the accepted run: stop HERE
+            if self.draft_model is not None:
+                # rewind: draft KV above the accepted frontier is
+                # garbage; at full accept this leaves gap 1 (the bonus
+                # token's position), closed by next step's catch-up
+                req._draft_ctx = min(req._draft_ctx, req._ctx)
+            if self._finished(req):
+                self._retire(req, t_tok)
+        stats["decoded"] = n
+        stats["spec_emitted"] = emitted_total
+        return stats
+
     def drain(self, max_steps=10000, now=None):
         """Run steps until queues and the running batch are empty (test
         and bench convenience).  Returns the number of steps taken."""
         steps = 0
-        while (self.running or self.scheduler.pending()) \
-                and steps < max_steps:
+        while (self.running or self.prefilling
+               or self.scheduler.pending()) and steps < max_steps:
             self.step(now=now)
             steps += 1
         return steps

@@ -183,7 +183,10 @@ class LocalReplica:
         self.engine.scheduler.requeue_front(request, preempted=False)
 
     def busy(self):
+        # getattr: test doubles and pre-round-20 engine stand-ins have
+        # no chunked-prefill pool
         return bool(self.engine.running
+                    or getattr(self.engine, "prefilling", ())
                     or self.engine.scheduler.pending())
 
     def pop_completed(self):
@@ -213,6 +216,14 @@ class LocalReplica:
             self.engine.running.remove(req)
             req.requeue_time = t_requeue
             sched.requeue_front(req)   # folds tokens, preemptions += 1
+        for req in list(getattr(self.engine, "prefilling", ())):
+            # mid-chunk prompts on the dead replica: no tokens yet, so
+            # the fold is a no-op — the requeue resets their chunk
+            # cursor and the survivor re-admits from chunk 0
+            self.engine.allocator.free(req.request_id)
+            self.engine.prefilling.remove(req)
+            req.requeue_time = t_requeue
+            sched.requeue_front(req)
         reqs = []
         while True:
             req = sched.next_admission(arrived_by=None)

@@ -23,7 +23,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["PagedKVCache", "write_prompt_kv", "write_prompt_kv_at",
-           "write_token_kv", "copy_page", "insert_pages"]
+           "write_token_kv", "write_span_kv", "copy_page", "insert_pages"]
 
 
 def write_prompt_kv(pool_l, kv, block_table_row, true_len):
@@ -91,6 +91,34 @@ def write_token_kv(pool_l, kv, block_tables, pos):
     b = jnp.arange(pos.shape[0])
     safe = jnp.maximum(pos, 0)
     pages = jnp.where(pos >= 0, block_tables[b, safe // S], P)
+    return pool_l.at[pages, safe % S].set(kv.astype(pool_l.dtype),
+                                          mode="drop")
+
+
+def write_span_kv(pool_l, kv, block_tables, start, n_valid):
+    """Write a SPAN of speculative tokens per batch lane (round 20).
+
+    ``kv``: ``[B, K1, H, D]`` — token ``j`` of lane ``b`` lands at
+    absolute position ``start[b] + j``.  ``start``: ``[B]`` int32;
+    ``start < 0`` marks an idle lane (every write dropped).
+    ``n_valid``: ``[B]`` int32 — only the first ``n_valid[b]`` span
+    slots write (a lane near its emit budget or the context edge
+    speculates fewer than K tokens; the surplus scatters to the
+    out-of-range page and drops).  This drop-fencing is ALSO the
+    rollback story: rejected speculative writes are never un-written —
+    the engine just rewinds the lane's position counter, the stale
+    slots are masked out of every later read by ``ctx_len``/causality,
+    and the next step's writes overwrite them before they are ever
+    visible.
+    """
+    P, S = pool_l.shape[0], pool_l.shape[1]
+    B, K1 = kv.shape[0], kv.shape[1]
+    b = jnp.arange(B, dtype=jnp.int32)[:, None]
+    j = jnp.arange(K1, dtype=jnp.int32)[None, :]
+    posn = start[:, None] + j
+    live = (start[:, None] >= 0) & (j < n_valid[:, None])
+    safe = jnp.maximum(posn, 0)
+    pages = jnp.where(live, block_tables[b, safe // S], P)
     return pool_l.at[pages, safe % S].set(kv.astype(pool_l.dtype),
                                           mode="drop")
 

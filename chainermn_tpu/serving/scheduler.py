@@ -82,6 +82,12 @@ class Request:
         self.admit_time = None
         self.requeue_time = None
         self.queue_wait_s = 0.0
+        # chunked-prefill state machine (round 20): the cursor counts
+        # prompt positions whose KV is written by completed chunks; a
+        # mid-chunk eviction resets it (requeue_front), so re-admission
+        # restarts from chunk 0 against freshly allocated pages — no
+        # stale cursor can ever address freed pages
+        self._chunk_pos = 0
 
     @property
     def total_len(self):
@@ -119,13 +125,21 @@ class RequestScheduler:
         prefill; each token keeps its one production timestamp), and the
         request jumps the line WITHIN its tenant — fairness across
         tenants is unaffected.  ``preempted=False`` is the admission
-        back-off path (pool momentarily full, nothing was evicted)."""
+        back-off path (pool momentarily full, nothing was evicted).
+
+        A MID-CHUNK victim (round 20) re-enters with its chunk cursor
+        RESET: its pages were freed by the eviction, so the cursor
+        would otherwise point re-admission at positions whose KV no
+        longer exists.  Chunk 0 re-runs on re-admit — the same
+        recompute-on-readmit contract evicted DECODING sequences have
+        always had, applied before the first token exists."""
         if request.tokens:
             request.prompt = np.concatenate(
                 [request.prompt,
                  np.asarray(request.tokens, dtype=np.int32)])
             request.max_new_tokens -= len(request.tokens)
             request.tokens = []
+        request._chunk_pos = 0
         if preempted:
             request.preemptions += 1
         self._queues.setdefault(request.tenant, deque()) \
@@ -165,10 +179,16 @@ class RequestScheduler:
         return None
 
     @staticmethod
-    def pick_victim(running, allocator=None):
+    def pick_victim(running, allocator=None, prefilling=None):
         """Eviction policy: the YOUNGEST running request (last admitted
         — least service consumed, least recompute wasted).  ``running``
         is admission-ordered oldest-first, as the engine keeps it.
+
+        ``prefilling`` (round 20): mid-chunk prompts are PREFERRED
+        victims, scanned youngest-first BEFORE any decoding sequence —
+        they hold chunk pages but have produced zero tokens, so
+        evicting one wastes the least completed work (its requeue
+        resets the chunk cursor; chunks recompute on re-admit).
 
         With prefix sharing an ``allocator`` must be passed: a victim is
         only useful if evicting it RETURNS pages to the pool, and a
@@ -177,14 +197,18 @@ class RequestScheduler:
         policy therefore accounts only UNIQUELY-owned pages, escalating
         youngest -> oldest past zero-unique candidates, and raises the
         typed :class:`~chainermn_tpu.serving.errors.EvictionStalledError`
-        when no running sequence would free a single page (the round-14
+        when no candidate would free a single page (the round-14
         livelock guard, pinned by test)."""
-        if not running:
+        if not running and not prefilling:
             return None
         if allocator is None:
+            if prefilling:
+                return prefilling[-1]
             return running[-1]
-        for req in reversed(running):
-            if allocator.unique_pages(req.request_id) > 0:
-                return req
+        for pool in (prefilling or (), running):
+            for req in reversed(pool):
+                if allocator.unique_pages(req.request_id) > 0:
+                    return req
         from .errors import EvictionStalledError
-        raise EvictionStalledError(len(running))
+        raise EvictionStalledError(len(running)
+                                   + len(prefilling or ()))

@@ -153,8 +153,22 @@ def test_mid_stream_join_preserves_running_sequence():
         joined.append(logits[0])
         np.testing.assert_allclose(logits[1], ref_b[pb], atol=1e-5)
     for n, (s, j) in enumerate(zip(solo, joined)):
-        np.testing.assert_array_equal(
-            s, j, err_msg=f"A's step {n} disturbed by B's join")
+        if n < 3:
+            # same compiled program (A alone) on both sides: bitwise
+            np.testing.assert_array_equal(
+                s, j, err_msg=f"A's step {n} disturbed by B's join")
+        else:
+            # after the join A rides the 2-lane bucket: a DIFFERENT
+            # compiled program, whose codegen XLA does not promise is
+            # bitwise-equal to the 1-lane program's (the tier-1 O0
+            # backend makes the ulp-level divergence visible).  The
+            # product contract is per-lane isolation — fp32-rounding
+            # logits and the identical greedy token.
+            np.testing.assert_allclose(
+                s, j, atol=1e-5,
+                err_msg=f"A's step {n} disturbed by B's join")
+            assert np.argmax(s) == np.argmax(j), (
+                f"A's step {n} token bent by B's join")
 
 
 def test_dense_hatch_parity_and_trajectory():

@@ -305,6 +305,58 @@ def test_seeded_trace_with_sharing_is_deterministic():
     assert replay(11) != replay(12)
 
 
+# -- round 20: chunk-stride churn --------------------------------------------
+
+
+def test_chunk_stride_grow_and_mid_chunk_eviction_conserve_pool():
+    """Round-20 churn shape: chunked admissions grow in page-multiple
+    chunk strides (``ensure`` at chunk boundaries, exactly the engine's
+    admission pattern) and may be evicted MID-chunk — freed in full,
+    re-admitted later from position zero.  Seeded grow/evict/restart
+    cycles must conserve the pool and keep every ownership invariant at
+    each step; a leaked chunk page here is the silent-corruption bug
+    the mid-chunk eviction satellite exists to prevent."""
+    rng = np.random.RandomState(20)
+    a = BlockAllocator(32, 4)
+    chunk = 8                                  # 2 pages per stride
+    live = {}                                  # sid -> covered positions
+    for step in range(400):
+        op = rng.randint(4)
+        if op == 0 and len(live) < 6:          # admit: first chunk
+            sid = f"c{step}"
+            try:
+                a.ensure(sid, chunk)
+                live[sid] = chunk
+            except PagePoolExhaustedError:
+                pass
+        elif op == 1 and live:                 # advance one chunk
+            sid = rng.choice(sorted(live))
+            try:
+                a.ensure(sid, live[sid] + chunk)
+                live[sid] += chunk
+            except PagePoolExhaustedError:     # pool dry mid-advance:
+                a.free(sid)                    # the mid-chunk eviction
+                del live[sid]
+        elif op == 2 and live:                 # forced mid-chunk evict
+            sid = rng.choice(sorted(live))
+            a.free(sid)
+            del live[sid]                      # cursor resets host-side
+        elif op == 3 and live:                 # re-admit a fresh cycle
+            sid = rng.choice(sorted(live))
+            a.free(sid)
+            del live[sid]
+            try:
+                a.ensure(sid, chunk)           # restart from chunk 0
+                live[sid] = chunk
+            except PagePoolExhaustedError:
+                pass
+        assert a.check()                       # invariants EVERY op
+        assert a.used_pages == sum(a.pages_for(n) for n in live.values())
+    for sid in list(live):
+        a.free(sid)
+    assert a.free_pages == 32 and a.check()
+
+
 def test_eviction_accounting_unique_pages():
     """The livelock guard's accounting surface: a sequence whose pages
     are ALL shared would free nothing; unique_pages says so."""

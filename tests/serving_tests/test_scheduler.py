@@ -108,6 +108,69 @@ def test_pick_victim_is_youngest():
     assert RequestScheduler.pick_victim([]) is None
 
 
+class _FakeAlloc:
+    """unique_pages stub: the only allocator surface pick_victim uses."""
+
+    def __init__(self, unique):
+        self._u = unique
+
+    def unique_pages(self, sid):
+        return self._u.get(sid, 0)
+
+
+def test_pick_victim_prefers_mid_chunk_prefilling_youngest_first():
+    """Round 20: a mid-chunk prompt holds pages but has produced zero
+    tokens — evicting it wastes the least completed work, so the
+    prefilling pool is scanned youngest-first BEFORE any decoding
+    sequence is considered."""
+    running = [_req("a"), _req("b")]
+    pre = [_req("p"), _req("q")]
+    assert RequestScheduler.pick_victim(running, prefilling=pre) is pre[-1]
+    assert RequestScheduler.pick_victim([], prefilling=pre) is pre[-1]
+    # empty prefilling degrades to the classic youngest-running policy
+    assert RequestScheduler.pick_victim(running, prefilling=[]) \
+        is running[-1]
+
+
+def test_pick_victim_allocator_aware_across_both_pools():
+    """The round-14 zero-unique escalation composes with the round-20
+    prefilling preference: fully-shared candidates are skipped through
+    BOTH pools (prefilling first), and when nobody would free a page
+    the typed stall counts every candidate."""
+    from chainermn_tpu.serving import EvictionStalledError
+    running = [_req("a"), _req("b")]
+    pre = [_req("p"), _req("q")]
+    unique = {running[0].request_id: 1, running[1].request_id: 1,
+              pre[0].request_id: 2, pre[1].request_id: 0}
+    # q holds only shared pages: p is next in the prefilling scan
+    assert RequestScheduler.pick_victim(
+        running, _FakeAlloc(unique), pre) is pre[0]
+    unique[pre[0].request_id] = 0
+    # both prefilling candidates sterile: fall through to running
+    assert RequestScheduler.pick_victim(
+        running, _FakeAlloc(unique), pre) is running[-1]
+    with pytest.raises(EvictionStalledError) as ei:
+        RequestScheduler.pick_victim(
+            running, _FakeAlloc({}), pre)
+    assert ei.value.n_running == 4   # counts BOTH pools
+
+
+def test_requeue_front_resets_chunk_cursor():
+    """Round 20: the chunk cursor is only meaningful while the engine
+    holds the chunk pages — ANY path back to the queue (preemption or
+    admission back-off) must reset it so re-admission restarts from
+    chunk zero against freshly-allocated pages."""
+    s = RequestScheduler()
+    r = _req("t", n=3, new=6)
+    r._chunk_pos = 24
+    s.requeue_front(r)
+    assert r._chunk_pos == 0
+    r2 = _req("t")
+    r2._chunk_pos = 8
+    s.requeue_front(r2, preempted=False)
+    assert r2._chunk_pos == 0
+
+
 # -- engine-level consequences ------------------------------------------------
 
 

@@ -173,6 +173,71 @@ def test_fleet_rows_are_fenced_and_knobs_defeat_flagship(monkeypatch):
     assert bench._cacheable(fleet_row) is False
 
 
+def test_spec_chunk_rows_are_fenced(monkeypatch):
+    """ISSUE 20 satellite (env half, serving side): the spec/chunk
+    knobs defeat the flagship cache exactly like the fleet knobs — a
+    speculative or chunked serving run can never be re-served as
+    training throughput — and a spec-shaped serving row is
+    metric-fenced on every cache path."""
+    from tests.test_bench_harness import TPU_RESULT
+    for knob, value in (("BENCH_SERVE_SPEC_K", "4"),
+                        ("BENCH_SERVE_CHUNK", "64")):
+        monkeypatch.setenv(knob, value)
+        assert not bench._cacheable(TPU_RESULT), knob
+        monkeypatch.delenv(knob)
+    assert bench._cacheable(TPU_RESULT)
+    spec_row = dict(SERVING_ROW, spec_k=4, spec_steps=78,
+                    accepted_tokens_per_dispatch=2.4)
+    assert bench._cacheable(spec_row) is False
+
+
+@pytest.mark.slow
+def test_cpu_smoke_spec_and_chunk_leg(tmp_path):
+    """End-to-end subprocess (slow tier — the tier-1 fence tests above
+    keep the knob fingerprinting gated), ISSUE 20 leg: BENCH_SERVE_SPEC_K=4 +
+    BENCH_SERVE_CHUNK=64 on the CPU smoke — the chunk threshold clamps
+    to 16 so the smoke's long prompts actually chunk, speculation and
+    chunking are BOTH exercised (non-zero spec_steps /
+    chunked_admissions), the row carries the full round-20 metric
+    surface, the measured window stays retrace-free with the verify and
+    chunk grids in the warmup set, and the caches stay untouched."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NO_SUPERVISE="1",
+               BENCH_MODEL="serving",
+               BENCH_SERVE_REQUESTS="64",      # clamps to 12
+               BENCH_SERVE_QPS="200",
+               BENCH_SERVE_TENANTS="3",
+               BENCH_SERVE_SPEC_K="4",
+               BENCH_SERVE_CHUNK="64",         # clamps to 16
+               BENCH_CACHE_PATH=str(tmp_path / "cache.json"),
+               BENCH_REPO_CACHE_PATH=str(tmp_path / "repo.json"),
+               BENCH_PREWARM_SENTINEL=str(tmp_path / "prewarm"),
+               BENCH_START_STAMP=str(tmp_path / "started"),
+               BENCH_DEADLINE_S="480")
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         env=env, capture_output=True, text=True,
+                         timeout=420, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "serving_engine_throughput"
+    assert row["cpu_smoke"] is True
+    assert row["spec_k"] == 4
+    assert row["chunk_tokens"] == 16           # the smoke clamp (64 -> 16)
+    # speculation ran: dispatches counted, and every dispatch emitted
+    # at least its pending token (== 1.0 exactly at zero accepts)
+    assert row["spec_steps"] > 0
+    assert row["accepted_tokens_per_dispatch"] >= 1.0
+    assert 0.0 <= row["spec_acceptance_rate"] <= 1.0
+    assert row["draft_overhead"] == 0.0        # n-gram draft: no dispatches
+    # chunking ran: the smoke's long prompts admitted in chunks
+    assert row["chunked_admissions"] > 0
+    assert row["chunk_prefills"] > row["chunked_admissions"]
+    assert row["completed"] == 12
+    assert row["value"] and row["value"] > 0
+    assert row["window_retraces"] == 0         # verify+chunk grids warmed
+    assert not os.path.exists(tmp_path / "cache.json")
+    assert not os.path.exists(tmp_path / "repo.json")
+
+
 def test_cpu_smoke_fleet_kill_reroutes_with_zero_drops(tmp_path):
     """End-to-end subprocess, fleet leg (ISSUE 15): 2 replicas behind
     the router, the highest killed at decode step 3 — the row carries

@@ -1168,6 +1168,33 @@ def test_autotune_knob_invalidates_flagship_cache(monkeypatch):
             == bench._DEFAULT_FINGERPRINTS[model]
 
 
+def test_spec_and_chunk_knobs_invalidate_flagship_cache(monkeypatch):
+    """ISSUE 20 satellite: the speculative-decode / chunked-prefill
+    knobs (BENCH_SERVE_SPEC_K / BENCH_SERVE_CHUNK) are fingerprint
+    knobs on BOTH flagship models — a serving regime with a different
+    dispatch shape can never be cached or re-served as flagship data,
+    and legacy entries backfill the off defaults (backfill-safe schema
+    bump)."""
+    monkeypatch.setenv("BENCH_SERVE_SPEC_K", "4")
+    assert bench._config_fingerprint("resnet50")["serve_spec_k"] == 4
+    assert bench._config_fingerprint("transformer")["serve_spec_k"] == 4
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_SERVE_SPEC_K", raising=False)
+    monkeypatch.setenv("BENCH_SERVE_CHUNK", "64")
+    assert bench._config_fingerprint("resnet50")["serve_chunk"] == 64
+    assert bench._config_fingerprint("transformer")["serve_chunk"] == 64
+    assert not bench._cacheable(TPU_RESULT)
+    monkeypatch.delenv("BENCH_SERVE_CHUNK", raising=False)
+    assert bench._cacheable(TPU_RESULT)
+    # backfill: a stored pre-round-20 fingerprint gains the defaults
+    for model in ("resnet50", "transformer"):
+        fp = dict(bench._DEFAULT_FINGERPRINTS[model])
+        fp.pop("serve_spec_k")
+        fp.pop("serve_chunk")
+        assert bench._backfill_fp(model, fp) \
+            == bench._DEFAULT_FINGERPRINTS[model]
+
+
 def test_compile_credit_math(tmp_path):
     """The supervisor's deadline extension: recorded compile seconds,
     plus the in-flight phase's elapsed time, capped at grace, zero for

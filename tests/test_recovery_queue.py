@@ -24,7 +24,7 @@ STUB = """#!/bin/bash
 case "$*" in
   *bench.py*)
     echo '{"prelim": true}'
-    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-sp${BENCH_SERVE_PREFIX:-d}-sd${BENCH_SERVE_DISAGG:-d}-stp${BENCH_SERVE_TP:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}-fr${BENCH_SERVE_REPLICAS:-d}-fk${BENCH_FLEET_KILL_AT:-d}-di${BENCH_DIURNAL:-d}-dp${BENCH_DIURNAL_PERIOD:-d}-at${BENCH_AUTOTUNE:-d}"'"}'
+    echo '{"final": "'"${BENCH_MODEL:-resnet50}-bs${BENCH_BS:-d}-${BENCH_LAYOUT:-d}-scan${BENCH_SCAN:-d}-seq${BENCH_SEQ:-d}-ip${BENCH_INPUT_PIPELINE:-0}-rp${BENCH_REMAT_POLICY:-n}-dn${BENCH_DONATE:-1}-ex${BENCH_EXCHANGE:-d}-bk${BENCH_BUCKET_MB:-d}-is${BENCH_INTER_SIZE:-d}-sr${BENCH_STRIPE_RATIO:-d}-gd${BENCH_GRAD_DTYPE:-d}-ef${BENCH_ERROR_FEEDBACK:-1}-sq${BENCH_SERVE_QPS:-d}-st${BENCH_SERVE_TENANTS:-d}-sp${BENCH_SERVE_PREFIX:-d}-sd${BENCH_SERVE_DISAGG:-d}-stp${BENCH_SERVE_TP:-d}-pr${BENCH_PREEMPT_RANK:-d}-me${BENCH_MOE_EXPERTS:-d}-mk${BENCH_MOE_TOPK:-d}-fr${BENCH_SERVE_REPLICAS:-d}-fk${BENCH_FLEET_KILL_AT:-d}-di${BENCH_DIURNAL:-d}-dp${BENCH_DIURNAL_PERIOD:-d}-at${BENCH_AUTOTUNE:-d}-sk${BENCH_SERVE_SPEC_K:-d}-ch${BENCH_SERVE_CHUNK:-d}"'"}'
     ;;
   *bench_scaling.py*)
     echo "gloo curve header text"
@@ -78,11 +78,12 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
 
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
-    # all 33 bench steps recorded, each once, in queue order.  Every
+    # all 35 bench steps recorded, each once, in queue order.  Every
     # row's fingerprint tail carries the ISSUE 15 fleet knobs (-fr/-fk),
-    # the ISSUE 16 diurnal knobs (-di/-dp) and the ISSUE 19 autotune
-    # knob (-at), default 'd'; the fleet, diurnal and autotune A/B rows
-    # pin theirs explicitly below
+    # the ISSUE 16 diurnal knobs (-di/-dp), the ISSUE 19 autotune knob
+    # (-at) and the ISSUE 20 speculative/chunked serving knobs
+    # (-sk/-ch), default 'd'; the fleet, diurnal, autotune, spec and
+    # chunk A/B rows pin theirs explicitly below
     expected = [
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # prewarm
         "resnet50-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",  # flagship
@@ -131,6 +132,13 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
         # explicitly; fleet knobs default)
         "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1"
         "-sqd-std-spd-sdd-stpd-prd-med-mkd-frd-fkd-di1-dp30",
+        # ISSUE 20: speculative-decode and chunked-prefill A/B rows (the
+        # BENCH_SERVE_SPEC_K / BENCH_SERVE_CHUNK fingerprint knobs
+        # pinned explicitly, one per row)
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1"
+        "-sqd-std-spd-sdd-stpd-prd-med-mkd-frd-fkd-did-dpd-atd-sk4-chd",
+        "serving-bsd-d-scand-seqd-ip0-rpn-dn1-exd-bkd-isd-srd-gdd-ef1"
+        "-sqd-std-spd-sdd-stpd-prd-med-mkd-frd-fkd-did-dpd-atd-skd-ch64",
         # ISSUE 12: MoE dispatch A/B rows (flat vs two-stage vs
         # two-stage+int8; BENCH_MOE_* fingerprint knobs pinned — the
         # int8 row sets BENCH_MOE_TOPK explicitly)
@@ -138,11 +146,14 @@ def test_queue_records_only_this_runs_authoritative_lines(tmp_path):
         "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdd-ef1-sqd-std-spd-sdd-stpd-prd-med-mkd",
         "moe-bsd-d-scand-seqd-ip0-rpn-dn1-exhierarchical-bkd-is2-srd-gdint8-ef1-sqd-std-spd-sdd-stpd-prd-med-mk1",
     ]
-    expected = [e if e.endswith(("-fk40", "-dp30", "-at1"))
+    expected = [e if e.endswith(("-fk40", "-dp30", "-at1",
+                                 "-chd", "-ch64"))
                 else e + "-frd-fkd" for e in expected]
-    expected = [e if e.endswith(("-dp30", "-at1")) else e + "-did-dpd"
+    expected = [e if e.endswith(("-dp30", "-at1", "-chd", "-ch64"))
+                else e + "-did-dpd" for e in expected]
+    expected = [e if e.endswith(("-at1", "-chd", "-ch64")) else e + "-atd"
                 for e in expected]
-    expected = [e if e.endswith("-at1") else e + "-atd"
+    expected = [e if e.endswith(("-chd", "-ch64")) else e + "-skd-chd"
                 for e in expected]
     finals = [ln for ln in notes_text.splitlines() if '"final"' in ln]
     assert [f'{{"final": "{e}"}}' for e in expected] == finals
@@ -202,7 +213,7 @@ FLASHCMP_NO_JSON_STUB = STUB.replace(
 @pytest.mark.slow
 def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     """When the flash-vs-xla probe wedges/crashes before printing JSON,
-    the queue must still complete (|| true), the thirty-three bench
+    the queue must still complete (|| true), the thirty-five bench
     rows must already be folded, and NO empty 'Flash-vs-XLA' section
     may be appended."""
     shim = tmp_path / "bin"
@@ -226,5 +237,5 @@ def test_queue_flashcmp_failure_appends_no_empty_section(tmp_path):
     notes_text = notes.read_text()
     assert "On-chip results" in notes_text
     assert len([ln for ln in notes_text.splitlines()
-                if '"final"' in ln]) == 33
+                if '"final"' in ln]) == 35
     assert "Flash-vs-XLA" not in notes_text

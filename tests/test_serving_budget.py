@@ -34,7 +34,8 @@ def test_budget_schema():
     b = _budgets()
     assert set(b["structure"]) == {"decode", "prefill", "prefix_prefill",
                                    "disagg_decode_slice",
-                                   "transfer_insert"}
+                                   "transfer_insert", "spec_verify",
+                                   "chunked_prefill"}
     g = b["geometry"]
     # the full-T detector's soundness precondition: T strictly exceeds
     # every feature dimension of the census vertical, so two T-sized
@@ -169,6 +170,60 @@ def test_transfer_insert_gate():
     assert census["bwd_kernels"] == 0
 
 
+def test_spec_verify_gate():
+    """The round-20 speculative-verify contract, machine-checked: ONE
+    dispatch scores spec_k + 1 positions per lane
+    (``queries_per_dispatch`` — the dispatch-per-token reduction is
+    structural, not a tuning claim), the K extra queries ride the SAME
+    one-gather-per-pool-per-layer cache reads the single-query step
+    pays, K/V land as one drop-fenced span scatter per pool per layer,
+    and NO [T, T] score dot forms — a verify that degenerates into a
+    per-token dense re-prefill is the regression this gate exists to
+    catch."""
+    b = _budgets()
+    census = serving_census.spec_verify_census()
+    assert census == b["structure"]["spec_verify"], (
+        f"spec_verify structure drifted: traced {census}, committed "
+        f"{b['structure']['spec_verify']}")
+    g = b["geometry"]
+    L = g["n_layers"]
+    assert census["queries_per_dispatch"] == g["spec_k"] + 1
+    assert census["pool_gathers"] == 2 * L    # same reads as decode
+    assert census["pool_scatters"] == 2 * L   # one span write per pool
+    assert census["full_t_score_dots"] == 0   # never a dense re-prefill
+    assert census["flash_fwd_kernels"] == 0
+    assert census["bwd_kernels"] == 0
+    # detector soundness for the [B, H, K1, ctx] score: the span stays
+    # a small constant, strictly below the context dimension
+    assert g["spec_k"] + 1 < g["max_context"]
+
+
+def test_chunked_prefill_gate():
+    """The round-20 chunk contract: one mid-prompt chunk is an offset
+    suffix-prefill — one gather per pool per layer (written context
+    read through the block table), one offset scatter per pool per
+    layer, zero flash kernels over already-written pages, and zero
+    [T, T] dots: chunking a long prompt never re-materializes the
+    monolithic score matrix, so per-chunk cost is budget-bounded by
+    construction."""
+    b = _budgets()
+    census = serving_census.chunked_prefill_census()
+    assert census == b["structure"]["chunked_prefill"], (
+        f"chunked_prefill structure drifted: traced {census}, committed "
+        f"{b['structure']['chunked_prefill']}")
+    g = b["geometry"]
+    L = g["n_layers"]
+    assert census["pool_gathers"] == 2 * L
+    assert census["pool_scatters"] == 2 * L
+    assert census["full_t_score_dots"] == 0
+    assert census["flash_fwd_kernels"] == 0
+    assert census["bwd_kernels"] == 0
+    # chunk geometry soundness: page-multiple (the admission contract)
+    # and strictly below the full-T threshold (detector stays sound)
+    assert g["chunk_T"] % g["page_size"] == 0
+    assert g["chunk_T"] < g["max_context"]
+
+
 def test_targets_armed_when_measured():
     b = _budgets()
     t = b["targets"]
@@ -195,7 +250,7 @@ def test_census_tool_cli_smoke():
     rows = [json.loads(l) for l in out.stdout.strip().splitlines()]
     assert {r["phase"] for r in rows} == {
         "decode", "prefill", "prefix_prefill", "disagg_decode_slice",
-        "transfer_insert"}
+        "transfer_insert", "spec_verify", "chunked_prefill"}
     committed = _budgets()["structure"]
     for r in rows:
         facts = {k: v for k, v in r.items() if k not in ("probe", "phase")}

@@ -65,11 +65,18 @@ BUDGETS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: bucket — suffix strictly below the full-T threshold, so the no-[T,T]
 #: detector stays sound for the suffix-by-context score), and
 #: transfer_pages sizes the disaggregation ship's page block.
+#: round-20 additions: spec_k sizes the speculative verify span (K + 1
+#: queries per lane — a small constant, far below the full-T threshold,
+#: so the no-[T,T] detector stays sound for the [B, H, K1, ctx] score),
+#: and chunk_T is the chunked-prefill chunk size (a page multiple; the
+#: chunk trace runs the offset suffix-prefill program at a page-aligned
+#: mid-prompt start).
 GEOMETRY = {
     "n_vocab": 128, "d_model": 48, "n_heads": 2, "n_layers": 2,
     "max_len": 256, "page_size": 16, "num_pages": 32,
     "max_context": 256, "prefill_T": 256, "decode_B": 4,
     "prefix_start": 128, "prefix_suffix_T": 32, "transfer_pages": 8,
+    "spec_k": 4, "chunk_T": 32,
 }
 
 
@@ -276,12 +283,76 @@ def transfer_insert_census():
                          g["max_context"])
 
 
+def spec_verify_census():
+    """Facts of the speculative VERIFY program (round 20): ``spec_k +
+    1`` positions scored per lane in ONE dispatch.  The headline facts
+    are ``queries_per_dispatch == spec_k + 1`` — the dispatch-count
+    reduction is structural, each verify prices up to K+1 emitted
+    tokens — and the decode-step invariants carried over unchanged: one
+    gather per pool per layer (the speculative queries ride the SAME
+    cache-byte reads the single-query step pays), one drop-fenced span
+    scatter per pool per layer, zero flash kernels, and NO [T, T]
+    score dot (scores are ``[B, H, K1, ctx]`` — K1 is a small
+    constant, never the context, so speculation never degenerates into
+    a per-token dense re-prefill)."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving import spec_verify_program
+
+    model, state, (k_pool, v_pool), N, rng = _vertical()
+    g = GEOMETRY
+    B, K1 = g["decode_B"], g["spec_k"] + 1
+    toks = jnp.zeros((B, K1), jnp.int32)
+    start = jnp.full(B, g["page_size"], jnp.int32)  # mid-sequence span
+    n_valid = jnp.full(B, K1, jnp.int32)
+    bts = jnp.zeros((B, N), jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda s, k, v, t, st, nv, b: spec_verify_program(
+            model, s, k, v, t, st, nv, b))(
+        state, k_pool, v_pool, toks, start, n_valid, bts)
+    pool_shape = tuple(k_pool.shape[1:])
+    facts = _census_facts(jaxpr.jaxpr, pool_shape, g["max_context"])
+    facts["queries_per_dispatch"] = K1
+    return facts
+
+
+def chunked_prefill_census():
+    """Facts of ONE mid-prompt chunk of a chunked prefill (round 20):
+    the offset suffix-prefill program at ``chunk_T`` tokens starting at
+    a page-aligned mid-prompt position.  The committed facts: one
+    gather per pool per layer, one offset scatter per pool per layer,
+    and zero [T, T] score dots — each chunk attends chunk-by-written-
+    context, so chunking a T-token prompt into T/C chunks never
+    re-materializes the dense [T, T] score a monolithic prefill pays,
+    and the per-chunk cost stays bounded by the chunk budget."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.serving import prefix_prefill_program
+
+    model, state, (k_pool, v_pool), N, rng = _vertical()
+    g = GEOMETRY
+    T = g["chunk_T"]
+    tokens = jnp.zeros((1, T), jnp.int32)
+    bt_row = jnp.zeros(N, jnp.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda s, k, v, t, tl, st, b: prefix_prefill_program(
+            model, s, k, v, t, tl, st, b))(
+        state, k_pool, v_pool, tokens, jnp.int32(T),
+        jnp.int32(g["chunk_T"]), bt_row)
+    pool_shape = tuple(k_pool.shape[1:])
+    return _census_facts(jaxpr.jaxpr, pool_shape, g["max_context"])
+
+
 def structure():
     return {"decode": decode_census("paged"),
             "prefill": prefill_census(),
             "prefix_prefill": prefix_prefill_census(),
             "disagg_decode_slice": disagg_decode_slice_census(),
-            "transfer_insert": transfer_insert_census()}
+            "transfer_insert": transfer_insert_census(),
+            "spec_verify": spec_verify_census(),
+            "chunked_prefill": chunked_prefill_census()}
 
 
 def write_budgets():

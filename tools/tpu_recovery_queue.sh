@@ -101,6 +101,24 @@
 #     Autotune rows are fingerprint-excluded from the flagship cache
 #     like every exchange knob.
 #
+# 12. speculative decode + chunked prefill A/Bs (ISSUE 20): (a) the
+#     BENCH_SERVE_SPEC_K=4 row below vs the flagship serving row —
+#     same tokens bit-identically (greedy spec is lossless), fewer
+#     dispatches: STAMP tokens/sec, p50/p99 per-token latency,
+#     `spec_steps`, `accepted_tokens_per_dispatch` (>1 is the win —
+#     each verify prices its accepted run of tokens in one dispatch),
+#     `spec_acceptance_rate`, and `draft_overhead` (0 for the n-gram
+#     self-draft; a draft-model leg adds its per-step dispatch cost
+#     here) in BENCH_NOTES, and fold accepted_tokens_per_dispatch into
+#     tools/serving_budgets.json targets alongside the first serving
+#     numbers.  (b) the BENCH_SERVE_CHUNK=64 row vs flagship — a mixed
+#     short/long load (every fourth prompt up to 4x BENCH_SERVE_PROMPT)
+#     where long prompts admit in 64-token chunks BETWEEN decode steps:
+#     STAMP p99 per-token latency vs what the same mixed load does with
+#     chunking off (the head-of-line-blocking delta IS the feature),
+#     plus `chunked_admissions`/`chunk_prefills`.  Both knobs are
+#     fingerprint-fenced out of the flagship cache.
+#
 # Also queued (no committed gate, record in BENCH_NOTES): hierarchical 2x4
 # split A/B, striped 2x4 multi-path A/B, int8/bf16/lossless DCN wire A/B +
 # EF-off ablation, the gloo exposed-comm curves, and the seq-8192 remat
@@ -316,6 +334,20 @@ run_one "serving fleet 2 replicas kill@40 (A/B: reroute + tree sync)" \
 run_one "serving diurnal capacity transfer (A/B: borrowed replica)" \
   BENCH_MODEL=serving BENCH_DIURNAL=1 BENCH_DIURNAL_PERIOD=30 \
   BENCH_DEADLINE_S=900
+# ISSUE 20: raw per-chip serving speed.  (a) speculative decoding at
+# K=4 (n-gram self-draft, one verify dispatch scores 5 positions per
+# lane) vs the flagship serving row — the SAME tokens, fewer
+# dispatches; accepted_tokens_per_dispatch > 1 is the payoff and
+# stamps the serving budgets' round-20 target.  (b) chunked prefill
+# at 64-token chunks under the mixed short/long load (every fourth
+# prompt up to 4x BENCH_SERVE_PROMPT) — long prompts stream in
+# between decode steps instead of head-of-line-blocking the batch;
+# the p99 delta vs the same load unchunked IS the feature.  Both
+# knobs are fingerprint-fenced out of the flagship cache.
+run_one "serving speculative decode K=4 (A/B: dispatches per token)" \
+  BENCH_MODEL=serving BENCH_SERVE_SPEC_K=4 BENCH_DEADLINE_S=900
+run_one "serving chunked prefill 64 mixed load (A/B: long-prompt p99)" \
+  BENCH_MODEL=serving BENCH_SERVE_CHUNK=64 BENCH_DEADLINE_S=900
 # ISSUE 12: the MoE dispatch A/B — the Switch-FFN expert-parallel
 # vertical under the flat single-axis dispatch, the two-stage ici×dcn
 # dispatch on the forced 2x4 split, and the two-stage dispatch with
